@@ -1,0 +1,31 @@
+"""AMP — bf16-first mixed precision.
+
+Reference: dygraph `amp_guard` (`fluid/dygraph/amp/auto_cast.py:95`), C++ op
+allow/block lists (`imperative/amp_auto_cast.h:31`), `GradScaler`
+(`paddle/amp/grad_scaler.py:20`), loss-scaling ops (`operators/amp/`).
+
+On TPU bf16 has the fp32 exponent range, so dynamic loss scaling is
+mathematically unnecessary for the 'O1 bf16' path — GradScaler keeps the full
+reference API (scale/step/update/minimize) and becomes a cheap no-op when
+scaling is disabled, while still implementing real dynamic scaling for fp16.
+"""
+from .auto_cast import auto_cast, amp_guard, white_list, black_list, get_amp_state  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate: O2 casts the model parameters to the AMP dtype.
+
+    With bf16 on TPU, master weights default to fp32 copies kept by the
+    optimizer accumulators (multi_precision analog).
+    """
+    if level == "O2":
+        if not isinstance(models, (list, tuple)):
+            models = [models]
+        for m in models:
+            m.to(dtype=dtype)
+        models = models[0] if len(models) == 1 else models
+    if optimizers is None:
+        return models
+    return models, optimizers

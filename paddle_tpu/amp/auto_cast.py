@@ -1,0 +1,81 @@
+"""auto_cast context: op-level autocast to bf16/fp16.
+
+The reference keeps C++ allow/block lists consulted inside Tracer::TraceOp
+(`imperative/amp_auto_cast.cc`); here the dispatch seam is
+`paddle_tpu.core.dispatch.call_op`, which consults this module's active state
+and casts float32 inputs of allow-listed ops to the AMP dtype before calling
+the jnp lowering. Matmuls/convs run in bf16 (MXU native); reductions,
+norms, softmax/losses stay fp32.
+"""
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+
+# Mirrors the reference's default lists (amp_auto_cast.cc / fp16_lists.py):
+white_list = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "scaled_dot_product_attention", "addmm", "dot",
+}
+black_list = {
+    "softmax", "log_softmax", "cross_entropy", "bce", "bce_with_logits",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "sum", "mean", "logsumexp", "norm", "exp", "log", "mse_loss", "l1_loss",
+    "kl_div", "cumsum", "softmax_with_cross_entropy",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def get_amp_state():
+    return _state
+
+
+def amp_cast_inputs(op_name, values):
+    """Called from dispatch: cast fp32 arrays for allow-listed ops."""
+    if not _state.enabled:
+        return values
+    name = op_name or ""
+    if name in _state.custom_black or name in black_list:
+        # run in fp32: promote any low-precision inputs
+        return [v.astype(jnp.float32)
+                if hasattr(v, "dtype") and v.dtype == _state.dtype else v
+                for v in values]
+    if name in _state.custom_white or name in white_list or _state.level == "O2":
+        return [v.astype(_state.dtype)
+                if hasattr(v, "dtype") and v.dtype == jnp.float32 else v
+                for v in values]
+    return values
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = convert_dtype(dtype).type
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = prev
+
+
+amp_guard = auto_cast

@@ -1,0 +1,119 @@
+"""GradScaler (reference: `python/paddle/amp/grad_scaler.py:20`, kernels
+`operators/amp/check_finite_and_unscale_op` + `update_loss_scaling_op`).
+
+bf16 (the TPU default) needs no loss scaling — `GradScaler(enable=False)`
+keeps the API while compiling to nothing. fp16 mode implements the
+reference's dynamic scaling state machine.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        # scaling state lives in tensors so scaled training steps compile once
+        self._scale = Tensor(jnp.asarray(init_loss_scaling if enable else 1.0,
+                                         jnp.float32))
+        self._scale._mark_stateful()
+        self._good_steps = Tensor(jnp.zeros((), jnp.int32))
+        self._good_steps._mark_stateful()
+        self._bad_steps = Tensor(jnp.zeros((), jnp.int32))
+        self._bad_steps._mark_stateful()
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def get_init_loss_scaling(self):
+        return float(self._scale._value)
+
+    def set_init_loss_scaling(self, v):
+        self._scale.set_value(jnp.asarray(v, jnp.float32))
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..ops.math import multiply
+        return multiply(var, Tensor(self._scale._value))
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale._value
+        found = jnp.zeros((), jnp.bool_)
+        for p in optimizer._parameters():
+            if p._grad is not None:
+                g = p._grad * inv.astype(p._grad.dtype)
+                found = found | ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+                p._grad = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._found_inf is False:
+            self.unscale_(optimizer)
+        found = self._found_inf
+        # check_finite_and_unscale: skip the update when non-finite
+        params = [p for p in optimizer._parameters()
+                  if not p.stop_gradient and p._grad is not None]
+        saved = [p._value for p in params]
+        optimizer.step()
+        for p, old in zip(params, saved):
+            p._value = jnp.where(found, old, p._value)
+        self._update(found)
+
+    def _update(self, found):
+        """update_loss_scaling state machine, branch-free (traceable)."""
+        if not self._use_dynamic:
+            self._found_inf = False
+            return
+        good = self._good_steps._value
+        bad = self._bad_steps._value
+        scale = self._scale._value
+        new_bad = jnp.where(found, bad + 1, 0)
+        new_good = jnp.where(found, 0, good + 1)
+        dec = new_bad >= self._decr_every
+        inc = new_good >= self._incr_every
+        new_scale = jnp.where(dec, jnp.maximum(scale * self._decr_ratio, 1.0),
+                              jnp.where(inc, scale * self._incr_ratio, scale))
+        self._bad_steps._value = jnp.where(dec, 0, new_bad)
+        self._good_steps._value = jnp.where(inc, 0, new_good)
+        self._scale._value = new_scale
+        self._found_inf = False
+
+    def update(self):
+        pass  # folded into step()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def state_dict(self):
+        return {"scale": Tensor(self._scale._value),
+                "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": Tensor(self._good_steps._value),
+                "bad_steps": Tensor(self._bad_steps._value)}
+
+    def load_state_dict(self, state):
+        self._scale.set_value(state["scale"].numpy())
+        self._good_steps.set_value(state["good_steps"].numpy())
+        self._bad_steps.set_value(state["bad_steps"].numpy())
+
+
+AmpScaler = GradScaler

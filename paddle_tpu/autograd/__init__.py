@@ -1,0 +1,4 @@
+"""paddle_tpu.autograd — PyLayer + functional grad (reference:
+`python/paddle/autograd/`, C++ `imperative/py_layer_fwd.h`)."""
+from ..core.autograd import backward, grad, no_grad, enable_grad  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401

@@ -1,0 +1,92 @@
+"""PyLayer: user-defined autograd ops.
+
+Reference: `python/paddle/autograd/py_layer.py` + `imperative/py_layer_fwd.h`.
+forward runs eagerly under no_grad; a TapeNode is recorded whose vjp calls the
+user's backward. Used by fleet recompute (activation checkpointing).
+"""
+from ..core import autograd
+from ..core.dispatch import unwrap, wrap
+from ..core.dtype import is_floating
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        diff_inputs = [t for t in tensor_args
+                       if not t.stop_gradient and is_floating(t.dtype)
+                       and autograd.grad_enabled()]
+
+        with autograd.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        if not autograd.grad_enabled():
+            return outs
+        # Record even with no differentiable *inputs*: the user's backward may
+        # produce grads for parameters closed over inside forward (recompute).
+
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+        out_meta = [(tuple(o.shape), o.dtype) for o in out_tensors]
+        diff_pos = {id(t): i for i, t in enumerate(tensor_args)}
+
+        def vjp_fn(cotangents):
+            cots = [wrap(c) for c in cotangents]
+            grads = cls.backward(ctx, *(cots if len(cots) > 1 else cots))
+            if isinstance(grads, Tensor):
+                grads = (grads,)
+            grads = list(grads)
+            # map: backward returns one grad per *tensor* input of forward
+            result = []
+            for t in diff_inputs:
+                g = grads[diff_pos[id(t)]] if diff_pos[id(t)] < len(grads) else None
+                result.append(None if g is None else unwrap(g))
+            return tuple(result)
+
+        node = autograd.TapeNode(vjp_fn, diff_inputs, out_meta,
+                                 name=cls.__name__)
+        wrapped = []
+        i = 0
+        for o in out_list:
+            if isinstance(o, Tensor):
+                t = Tensor(o._value, stop_gradient=False)
+                t._tape_node = node
+                t._tape_index = i
+                i += 1
+                wrapped.append(t)
+            else:
+                wrapped.append(o)
+        return wrapped[0] if single else tuple(wrapped)

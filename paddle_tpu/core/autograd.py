@@ -1,0 +1,241 @@
+"""Eager autograd engine.
+
+The TPU-native analog of the reference dygraph engine
+(`paddle/fluid/imperative/basic_engine.cc:39/235/305` + `tracer.cc:144` +
+`gradient_accumulator.cc`): every differentiable op call records a TapeNode
+holding a `jax.vjp` closure; `backward()` walks nodes in reverse topological
+order and accumulates cotangents. Because the closures are pure jax functions,
+the same tape works on concrete arrays (eager) and on tracers (inside
+`to_static`), which is what lets the whole imperative training step compile to
+one XLA computation.
+"""
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+__all__ = [
+    "TapeNode",
+    "grad_enabled",
+    "no_grad",
+    "enable_grad",
+    "backward",
+    "grad",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def grad_enabled() -> bool:
+    return _state.enabled
+
+
+@contextmanager
+def no_grad():
+    prev = _state.enabled
+    _state.enabled = False
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+@contextmanager
+def enable_grad():
+    prev = _state.enabled
+    _state.enabled = True
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+class TapeNode:
+    """One recorded op: vjp closure + graph edges.
+
+    ``inputs``: the differentiated input Tensors (strong refs — the eager graph
+    lives until backward, as with the reference's GradOpNode chain).
+    ``out_meta``: (shape, dtype) per output so missing cotangents can be zeros.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "cotangents", "pending", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_meta, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.out_meta = out_meta
+        self.name = name
+        self.cotangents = None  # filled during backward
+        self.pending = 0
+
+    def seed(self, index, value):
+        if self.cotangents is None:
+            self.cotangents = [None] * len(self.out_meta)
+        cur = self.cotangents[index]
+        self.cotangents[index] = value if cur is None else cur + value
+
+    def materialized_cotangents(self):
+        cots = self.cotangents or [None] * len(self.out_meta)
+        out = []
+        for c, (shape, dtype) in zip(cots, self.out_meta):
+            if c is None:
+                c = jnp.zeros(shape, dtype)
+            elif c.dtype != dtype:
+                # AMP boundary: downstream ran in a different precision
+                c = c.astype(dtype)
+            out.append(c)
+        return tuple(out)
+
+
+def _topo_order(root_node):
+    """Reverse topological order over the tape graph reachable from root."""
+    order, visited = [], set()
+    stack = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._tape_node is not None and id(t._tape_node) not in visited:
+                stack.append((t._tape_node, False))
+    order.reverse()
+    return order
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    """Run reverse accumulation from `tensor` (reference: basic_engine.cc:305)."""
+    from .tensor import Tensor
+
+    node = tensor._tape_node
+    if node is None:
+        return
+    if grad_tensor is None:
+        seed = jnp.ones(tensor.shape, dtype=tensor.dtype)
+    else:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    node.seed(tensor._tape_index, seed)
+
+    for n in _topo_order(node):
+        if n.cotangents is None or all(c is None for c in n.cotangents):
+            continue
+        if n.vjp_fn is None:
+            raise RuntimeError(
+                "autograd graph has been freed (backward already ran); "
+                "pass retain_graph=True to keep it")
+        in_cots = n.vjp_fn(n.materialized_cotangents())
+        for t, cot in zip(n.inputs, in_cots):
+            if cot is None:
+                continue
+            child = t._tape_node
+            if child is not None:
+                child.seed(t._tape_index, cot)
+            if child is None or t._retain_grads:
+                t._accumulate_grad(cot)
+        n.cotangents = None
+        if not retain_graph:
+            n.vjp_fn = None
+            n.inputs = ()
+
+    if not retain_graph:
+        tensor._tape_node = None
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         allow_unused=False):
+    """`paddle.grad` analog (reference: imperative/partial_grad_engine.cc).
+
+    Computes d(outputs)/d(inputs) without touching `.grad` on other leaves.
+    `create_graph` is not yet supported (tape closures are jax.vjp closures,
+    so a double-backward needs re-tracing; planned via jax.grad composition).
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError("create_graph=True not supported yet")
+    if retain_graph is None:
+        retain_graph = True  # repeated paddle.grad calls over the same graph
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outs)
+
+    # Seed output cotangents.
+    roots = []
+    for o, g in zip(outs, grad_outputs):
+        if o._tape_node is None:
+            continue
+        seed = (
+            jnp.ones(o.shape, o.dtype)
+            if g is None
+            else (g._value if isinstance(g, Tensor) else jnp.asarray(g))
+        )
+        o._tape_node.seed(o._tape_index, seed)
+        roots.append(o._tape_node)
+
+    # Collect per-input grads (not into .grad — into a side table).
+    table = {id(t): None for t in ins}
+    wanted = {id(t): t for t in ins}
+
+    visited, order = set(), []
+    stack = [(r, False) for r in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._tape_node is not None and id(t._tape_node) not in visited:
+                stack.append((t._tape_node, False))
+    order.reverse()
+
+    for n in order:
+        if n.cotangents is None or all(c is None for c in n.cotangents):
+            continue
+        if n.vjp_fn is None:
+            raise RuntimeError(
+                "autograd graph has been freed (backward/grad already ran); "
+                "pass retain_graph=True to keep it")
+        in_cots = n.vjp_fn(n.materialized_cotangents())
+        for t, cot in zip(n.inputs, in_cots):
+            if cot is None:
+                continue
+            if id(t) in wanted:
+                table[id(t)] = cot if table[id(t)] is None else table[id(t)] + cot
+            child = t._tape_node
+            if child is not None:
+                child.seed(t._tape_index, cot)
+        n.cotangents = None
+        if not retain_graph:
+            n.vjp_fn = None
+            n.inputs = ()
+
+    results = []
+    for t in ins:
+        g = table[id(t)]
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; "
+                    "pass allow_unused=True to return None for it."
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    if isinstance(inputs, (list, tuple)):
+        return results
+    return results[0]

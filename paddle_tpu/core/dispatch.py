@@ -1,0 +1,122 @@
+"""Op dispatch: the eager/traced execution seam.
+
+TPU-native analog of the reference's `imperative::Tracer::TraceOp`
+(`paddle/fluid/imperative/tracer.cc:144`) + `PreparedOp`
+(`prepared_operator.cc:161`): an op is a pure jnp function; `call_op` unwraps
+Tensor arguments, runs the function (through `jax.vjp` when any input needs
+grad, recording a TapeNode), and wraps outputs. There is no kernel registry —
+XLA is the kernel library; the same dispatch path works eagerly on device
+arrays and under `to_static` tracing on tracers.
+"""
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import is_floating
+
+__all__ = ["call_op", "call_op_nograd", "wrap", "unwrap", "_STATIC_HOOK"]
+
+# When paddle.static program_guard is active, this holds Program.record and
+# every op call is captured into the program instead of the autograd tape.
+_STATIC_HOOK = [None]
+
+
+def _is_tensor(x):
+    from .tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def unwrap(x):
+    return x._value if _is_tensor(x) else x
+
+
+def wrap(value, stop_gradient=True):
+    from .tensor import Tensor
+
+    return Tensor(value, stop_gradient=stop_gradient)
+
+
+def _amp_cast(op_name, values):
+    """AMP hook: bf16-cast inputs of allow-listed ops (see amp/auto_cast.py)."""
+    from ..amp.auto_cast import _state, amp_cast_inputs
+    if not _state.enabled:
+        return values
+    return amp_cast_inputs(op_name, values)
+
+
+def _substitute(args, kwargs, positions, values, op_name=None):
+    """Rebuild (args, kwargs) with Tensors replaced by raw values; the tensors
+    at `positions` (path keys) get `values`, the rest are closed-over consts."""
+    flat_args = list(args)
+    new_kwargs = dict(kwargs)
+    for (where, key), val in zip(positions, values):
+        if where == "a":
+            flat_args[key] = val
+        else:
+            new_kwargs[key] = val
+    flat_args = _amp_cast(op_name, [unwrap(a) for a in flat_args])
+    new_kwargs = {k: unwrap(v) for k, v in new_kwargs.items()}
+    return flat_args, new_kwargs
+
+
+def call_op(fn, *args, op_name=None, **kwargs):
+    """Run `fn(*arrays, **kwargs)` with autograd recording.
+
+    Tensor args participate in differentiation when grad is enabled, they are
+    floating point, and `stop_gradient` is False. Everything else is closed
+    over as a constant. Multi-output fns must return only floating-point
+    outputs (mixed-dtype ops are built as composites in the ops library).
+    """
+    if _STATIC_HOOK[0] is not None:
+        return _STATIC_HOOK[0](fn, args, kwargs, op_name)
+
+    diff_positions, diff_tensors = [], []
+    if autograd.grad_enabled():
+        for i, a in enumerate(args):
+            if _is_tensor(a) and not a.stop_gradient and is_floating(a.dtype):
+                diff_positions.append(("a", i))
+                diff_tensors.append(a)
+        for k, v in kwargs.items():
+            if _is_tensor(v) and not v.stop_gradient and is_floating(v.dtype):
+                diff_positions.append(("k", k))
+                diff_tensors.append(v)
+
+    if not diff_tensors:
+        return call_op_nograd(fn, *args, op_name=op_name, **kwargs)
+
+    name = op_name or getattr(fn, "__name__", "op")
+
+    def g(*diff_vals):
+        a, k = _substitute(args, kwargs, diff_positions, diff_vals, op_name=name)
+        out = fn(*a, **k)
+        return out if isinstance(out, tuple) else (out,)
+
+    diff_vals = _amp_cast(name, [t._value for t in diff_tensors])
+    outs, vjp_fn = jax.vjp(g, *diff_vals)
+    out_meta = [(jnp.shape(o), o.dtype) for o in outs]
+    node = autograd.TapeNode(vjp_fn, list(diff_tensors), out_meta,
+                             name=op_name or getattr(fn, "__name__", "op"))
+
+    tensors = []
+    for i, o in enumerate(outs):
+        t = wrap(o, stop_gradient=False)
+        t._tape_node = node
+        t._tape_index = i
+        tensors.append(t)
+    if len(tensors) == 1:
+        return tensors[0]
+    return tuple(tensors)
+
+
+def call_op_nograd(fn, *args, op_name=None, **kwargs):
+    """Run without recording (non-diff inputs, no_grad scope, or int ops)."""
+    if _STATIC_HOOK[0] is not None:
+        return _STATIC_HOOK[0](fn, args, kwargs, op_name)
+    a = _amp_cast(op_name or getattr(fn, "__name__", "op"),
+                  [unwrap(x) for x in args])
+    k = {key: unwrap(v) for key, v in kwargs.items()}
+    out = fn(*a, **k)
+    if isinstance(out, tuple):
+        return tuple(wrap(o) for o in out)
+    return wrap(out)

@@ -1,0 +1,66 @@
+"""Dtype registry.
+
+Mirrors the reference's VarType dtype surface
+(`/root/reference/paddle/fluid/framework/framework.proto:106`) with jax/numpy
+dtypes as the single source of truth — no custom enum, TPU-native bf16 first.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical names exposed as paddle_tpu.float32 etc.
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "fp16": jnp.float16,
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "float64": jnp.float64,
+    "fp64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a user dtype (str / numpy / jnp) to a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+        return np.dtype(_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    return np.dtype(dtype).name
+
+
+def is_floating(dtype):
+    d = np.dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype):
+    d = np.dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer) or d == np.bool_

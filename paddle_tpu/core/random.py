@@ -1,0 +1,67 @@
+"""RNG: stateful seed API over stateless threefry keys.
+
+The reference keeps per-device mutable generators
+(`paddle/fluid/framework/generator.h:93`); on TPU we keep the same user API
+(`paddle.seed`, deterministic dropout) but back it with a jax PRNG key held in
+a stateful Tensor, so a traced training step advances the key functionally —
+the counter becomes one more donated state input/output of the compiled step.
+The TP RNG-state tracker (`fleet/meta_parallel/parallel_layers/random.py`)
+builds on this in paddle_tpu.distributed.
+"""
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+_DEFAULT_SEED = 0
+
+
+class Generator:
+    def __init__(self, seed=_DEFAULT_SEED):
+        self._key_t = Tensor(jax.random.key_data(jax.random.PRNGKey(seed)))
+        self._key_t.persistable = True
+        self._key_t._mark_stateful()
+        self._seed = seed
+
+    def manual_seed(self, seed):
+        self._seed = seed
+        self._key_t.set_value(jax.random.key_data(jax.random.PRNGKey(seed)))
+        return self
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        """Split the stored key; works eagerly and under tracing."""
+        key = jax.random.wrap_key_data(self._key_t._value)
+        key, sub = jax.random.split(key)
+        self._key_t._value = jax.random.key_data(key)
+        return sub
+
+    def get_state(self):
+        return Tensor(self._key_t._value)
+
+    def set_state(self, state):
+        self._key_t.set_value(state)
+
+
+default_generator = Generator()
+
+
+def seed(s):
+    """`paddle.seed` analog."""
+    default_generator.manual_seed(int(s))
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+def next_key():
+    return default_generator.next_key()

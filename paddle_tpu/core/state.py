@@ -1,0 +1,52 @@
+"""Stateful-tensor registry.
+
+The TPU-native replacement for the reference's Scope/Variable persistable state
+(`paddle/fluid/framework/scope.h:52`): every mutable framework tensor —
+Parameter, Layer buffer (BN running stats), optimizer accumulator, the global
+RNG counter — registers here. `paddle_tpu.jit.to_static` snapshots the
+registry, threads every entry through the compiled function as a donated
+input/output pair (PJRT input-output aliasing — the XLA answer to the
+reference's in-place Variable mutation), and writes results back after each
+call.
+"""
+import weakref
+
+_registry = {}  # uid -> weakref to Tensor
+_next_uid = 0
+_version = 0  # bumped on registration/removal; part of the jit cache key
+
+
+def register(tensor):
+    global _next_uid, _version
+    uid = _next_uid
+    _next_uid += 1
+    _version += 1
+
+    def _cleanup(_ref, _uid=uid):
+        global _version
+        _registry.pop(_uid, None)
+        _version += 1
+
+    _registry[uid] = weakref.ref(tensor, _cleanup)
+    return uid
+
+
+def unregister(uid):
+    global _version
+    if uid in _registry:
+        del _registry[uid]
+        _version += 1
+
+
+def version():
+    return _version
+
+
+def snapshot():
+    """Sorted list of (uid, Tensor) for all live stateful tensors."""
+    out = []
+    for uid in sorted(_registry):
+        t = _registry[uid]()
+        if t is not None:
+            out.append((uid, t))
+    return out

@@ -1,0 +1,207 @@
+"""Tensor: the user-facing array type.
+
+TPU-native analog of the reference's `imperative::VarBase` + `framework::Tensor`
+(`paddle/fluid/framework/tensor.h:89`, `python/paddle/fluid/framework.py:805`):
+a thin mutable wrapper over an immutable jax.Array (PJRT buffer). Mutation
+(`set_value`, optimizer updates) rebinds `_value`; under `to_static` tracing
+`_value` holds a tracer, which is how the imperative API compiles to one XLA
+computation. Most math methods are monkey-patched from the ops library at
+package import (mirroring the reference's varbase_patch_methods.py).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd, state
+from .device import _current_place
+from .dtype import convert_dtype
+
+_tensor_count = 0
+
+
+def _auto_name(prefix):
+    global _tensor_count
+    _tensor_count += 1
+    return f"{prefix}_{_tensor_count}"
+
+
+class Tensor:
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        dtype = convert_dtype(dtype)
+        if isinstance(data, Tensor):
+            data = data._value
+        if isinstance(data, (np.ndarray, np.generic, int, float, bool, list, tuple)):
+            data = jnp.asarray(data, dtype=dtype)
+        elif dtype is not None and data.dtype != dtype:
+            data = data.astype(dtype)
+        self._value = data
+        self.stop_gradient = stop_gradient
+        self.name = name or _auto_name("tensor")
+        self.persistable = False
+        self.pspec = None  # jax PartitionSpec for distributed state
+        self._grad = None
+        self._tape_node = None
+        self._tape_index = 0
+        self._retain_grads = False
+        self._state_uid = None
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def shape(self):
+        return list(jnp.shape(self._value))
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(jnp.shape(self._value), dtype=np.int64))
+
+    @property
+    def place(self):
+        return _current_place()
+
+    def numel(self):
+        return self.size
+
+    @property
+    def is_leaf(self):
+        return self._tape_node is None
+
+    # -- host interop -----------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # -- autograd ---------------------------------------------------------
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        return Tensor(self._grad, stop_gradient=True, name=self.name + "@GRAD")
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else (
+            value._value if isinstance(value, Tensor) else jnp.asarray(value))
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def _accumulate_grad(self, cot):
+        if cot.dtype != self.dtype:
+            cot = cot.astype(self.dtype)
+        self._grad = cot if self._grad is None else self._grad + cot
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def clone(self):
+        from .dispatch import call_op
+        return call_op(lambda x: x + 0, self, op_name="clone")
+
+    # -- mutation ---------------------------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self.dtype)
+        if jnp.shape(value) != tuple(jnp.shape(self._value)):
+            raise ValueError(
+                f"set_value shape mismatch: {jnp.shape(value)} vs {self.shape}")
+        self._value = value
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    # -- framework state --------------------------------------------------
+    def _mark_stateful(self):
+        """Register in the to_static state registry (Scope-variable analog)."""
+        if self._state_uid is None:
+            self._state_uid = state.register(self)
+        return self
+
+    def block_until_ready(self):
+        if isinstance(self._value, jax.Array):
+            self._value.block_until_ready()
+        return self
+
+    # -- misc -------------------------------------------------------------
+    def __len__(self):
+        s = jnp.shape(self._value)
+        if not s:
+            raise TypeError("len() of a 0-d tensor")
+        return s[0]
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_s},\n"
+                f"       {np.asarray(self._value)!r})")
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # Math dunders / methods are attached by paddle_tpu.ops._patch_tensor().
+
+
+class Parameter(Tensor):
+    """Trainable parameter (reference: framework.py:5443 ParamBase)."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name or _auto_name("param"))
+        self.persistable = True
+        self._mark_stateful()
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """`paddle.to_tensor` analog."""
+    del place  # single logical device per process; jax owns placement
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

@@ -1,0 +1,18 @@
+"""paddle_tpu.distributed — mirrors `python/paddle/distributed/`.
+
+XLA collectives over the device mesh replace NCCL rings; see
+parallel_env.py / collective.py / fleet/ for the mapping table
+(SURVEY.md §2.3).
+"""
+from . import parallel_env  # noqa: F401
+from .parallel_env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv,
+    set_mesh, current_mesh, make_mesh,
+)
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, reduce, broadcast, scatter, alltoall, send, recv,
+    barrier, new_group, wait, split, ReduceOp,
+)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from .spawn import spawn  # noqa: F401

@@ -1,0 +1,198 @@
+"""Functional collective API.
+
+Reference: `python/paddle/distributed/collective.py` (all_reduce:415,
+all_gather:589, split:1283 …) backed by `operators/collective/c_*` NCCL
+kernels. TPU mapping: a Group is a named mesh axis; inside shard_map/pjit
+regions the ops lower to lax.psum/all_gather/ppermute/all_to_all over ICI
+(compiler-scheduled — the c_sync_*/wait ops have no analog because data-flow
+order replaces stream order). Called eagerly on replicated single-process
+state the ops degenerate to their mathematical identities.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import call_op, unwrap
+from ..core.tensor import Tensor
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator: a mesh axis name (+ rank list for bookkeeping)."""
+
+    def __init__(self, ranks=None, axis_name=None, gid=0):
+        self.ranks = ranks
+        self.axis_name = axis_name
+        self.id = gid
+
+    @property
+    def nranks(self):
+        if self.ranks is not None:
+            return len(self.ranks)
+        return jax.device_count()
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, ranks={self.ranks})"
+
+
+_GLOBAL_GROUP = Group(axis_name=None, gid=0)
+_group_count = 0
+
+
+def _in_named_trace(axis_name):
+    """True when called under shard_map with this axis bound."""
+    if axis_name is None:
+        return False
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    global _group_count
+    _group_count += 1
+    return Group(ranks=ranks, axis_name=axis_name, gid=_group_count)
+
+
+def get_group(gid=0):
+    return _GLOBAL_GROUP
+
+
+def _axis(group):
+    return group.axis_name if group is not None else None
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+    if _in_named_trace(ax):
+        fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin,
+               ReduceOp.AVG: jax.lax.pmean}
+        out = call_op(lambda v: fns[op](v, ax), tensor, op_name="c_allreduce")
+        tensor._value = out._value
+        tensor._tape_node = out._tape_node
+        tensor._tape_index = out._tape_index
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    return tensor  # replicated: allreduce(sum over 1 copy) == identity
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    ax = _axis(group)
+    if _in_named_trace(ax):
+        out = call_op(
+            lambda v: jax.lax.all_gather(v, ax), tensor, op_name="c_allgather")
+        n = out.shape[0]
+        for i in range(n):
+            tensor_list.append(out[i])
+        return tensor_list
+    tensor_list.append(tensor)
+    return tensor_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if _in_named_trace(ax):
+        def _bcast(v):
+            # take src's value on every rank: gather then index
+            return jax.lax.all_gather(v, ax)[src]
+        out = call_op(_bcast, tensor, op_name="c_broadcast")
+        tensor._value = out._value
+        tensor._tape_node = out._tape_node
+        tensor._tape_index = out._tape_index
+        return tensor
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if _in_named_trace(ax):
+        def _scatter(v):
+            idx = jax.lax.axis_index(ax)
+            stacked = jnp.stack([unwrap(t) for t in tensor_list])
+            return stacked[idx]
+        out = call_op(_scatter, tensor, op_name="c_scatter")
+        tensor._value = out._value
+        return tensor
+    if tensor_list:
+        tensor.set_value(unwrap(tensor_list[src]))
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    ax = _axis(group)
+    if _in_named_trace(ax):
+        stacked = jnp.stack([unwrap(t) for t in in_tensor_list])
+        out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i]))
+        return out_tensor_list
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """p2p send (reference send_v2): inside shard_map this is a ppermute
+    handled by the pipeline helpers; eager single-process is a no-op."""
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def barrier(group=None):
+    for d in jax.devices():
+        pass  # single-controller: dispatch order is the barrier
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor.block_until_ready()
+    return tensor
+
+
+def get_rank():
+    return jax.process_index()
+
+
+def get_world_size():
+    return jax.process_count()
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style sharded layer builder (reference: collective.py:1283).
+    Delegates to the meta_parallel sharded layers over the 'mp' mesh axis."""
+    from .fleet import meta_parallel as mp
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            return mp.ColumnParallelLinear(in_f, out_f,
+                                           weight_attr=weight_attr,
+                                           has_bias=bias_attr is not False,
+                                           gather_output=gather_out)
+        return mp.RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                    has_bias=bias_attr is not False,
+                                    input_is_parallel=not gather_out)
+    if operation == "embedding":
+        vocab, hidden = size
+        return mp.VocabParallelEmbedding(vocab, hidden,
+                                         weight_attr=weight_attr)
+    raise ValueError(f"unsupported split operation: {operation}")

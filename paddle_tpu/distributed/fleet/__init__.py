@@ -1,0 +1,26 @@
+"""Fleet — the distributed facade.
+
+Reference: `python/paddle/distributed/fleet/base/fleet_base.py:72` (Fleet),
+`distributed_strategy.py:105`, `topology.py:117` (HybridCommunicateGroup).
+TPU mapping: fleet.init builds the 4-D device mesh data×pipe×sharding×model
+(same axis order as the reference topology) and installs it globally;
+distributed_model/distributed_optimizer attach sharding specs that GSPMD
+turns into ICI collectives.
+"""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import HybridCommunicateGroup, CommunicateTopology  # noqa: F401
+from .base.role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .utils import recompute  # noqa: F401
+
+from .base import fleet_base as _fb
+
+init = _fb.init
+distributed_model = _fb.distributed_model
+distributed_optimizer = _fb.distributed_optimizer
+get_hybrid_communicate_group = _fb.get_hybrid_communicate_group
+worker_index = _fb.worker_index
+worker_num = _fb.worker_num
+is_first_worker = _fb.is_first_worker
+barrier_worker = _fb.barrier_worker
+stop_worker = _fb.stop_worker

@@ -1,0 +1,115 @@
+"""Fleet facade (reference: `fleet/base/fleet_base.py:72`).
+
+fleet.init builds the hybrid mesh; distributed_model wraps per the active
+degrees (DataParallel / TensorParallel / PipelineParallel); and
+distributed_optimizer returns a HybridParallelOptimizer that attaches ZeRO
+sharding specs to optimizer state (the sharding_optimizer analog — GSPMD
+emits the reduce-scatter/all-gather the reference inserts by program rewrite).
+"""
+import jax
+from jax.sharding import PartitionSpec
+
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker
+from .topology import (
+    AXIS_DATA, AXIS_SHARD, HybridCommunicateGroup,
+    set_hybrid_communicate_group, get_hybrid_communicate_group,
+)
+
+_role_maker = None
+_strategy = None
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    global _role_maker, _strategy
+    _role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
+    _strategy = strategy or DistributedStrategy()
+    hcg = HybridCommunicateGroup(strategy=_strategy)
+    set_hybrid_communicate_group(hcg)
+    return hcg
+
+
+def worker_index():
+    return _role_maker.worker_index() if _role_maker else jax.process_index()
+
+
+def worker_num():
+    return _role_maker.worker_num() if _role_maker else jax.process_count()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    pass  # single-controller: no-op
+
+
+def stop_worker():
+    pass
+
+
+def distributed_model(model):
+    """reference: fleet_base.py:836 — wrap per active parallelism."""
+    from ...parallel import DataParallel
+    from ..meta_parallel import PipelineLayer, PipelineParallel, TensorParallel
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        init()
+        hcg = get_hybrid_communicate_group()
+
+    if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, _strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, _strategy)
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference: fleet_base.py:783 → meta-optimizer stack. TPU: attach
+    sharding specs to optimizer state (ZeRO) and keep the same object API."""
+    global _strategy
+    strategy = strategy or _strategy or DistributedStrategy()
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and (strategy.sharding
+                            or hcg.get_sharding_parallel_world_size() > 1):
+        axis = (AXIS_SHARD if hcg.get_sharding_parallel_world_size() > 1
+                else AXIS_DATA)
+        _shard_optimizer_state(optimizer, hcg, axis)
+    return HybridParallelOptimizer(optimizer, hcg, strategy)
+
+
+def _shard_optimizer_state(optimizer, hcg, axis):
+    """ZeRO-1: shard each accumulator's first divisible dim over `axis`
+    (reference: sharding_optimizer.py:43 shards opt state across the ring)."""
+    mesh = hcg.mesh
+    if mesh is None:
+        return
+    degree = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    for (slot, _), acc in optimizer._accumulators.items():
+        shape = acc.shape
+        if shape and shape[0] % degree == 0 and shape[0] >= degree:
+            acc.pspec = PartitionSpec(axis)
+
+
+class HybridParallelOptimizer:
+    """Pass-through optimizer wrapper (reference:
+    fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py)."""
+
+    def __init__(self, optimizer, hcg, strategy):
+        self._inner = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def minimize(self, loss, *args, **kwargs):
+        return self._inner.minimize(loss, *args, **kwargs)

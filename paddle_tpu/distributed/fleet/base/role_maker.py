@@ -1,0 +1,71 @@
+"""RoleMakers (reference: `fleet/base/role_maker.py:359/530/903`).
+
+Rank/endpoint resolution from env (the PADDLE_TRAINER_* contract) — on TPU
+the jax coordination service supplies process identity, env vars remain
+supported for launcher compatibility.
+"""
+import os
+
+import jax
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._is_collective = True
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def get_trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:0"]
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        if "PADDLE_TRAINER_ID" in os.environ:
+            return int(os.environ["PADDLE_TRAINER_ID"])
+        return jax.process_index()
+
+    def worker_num(self):
+        if "PADDLE_TRAINERS_NUM" in os.environ:
+            return int(os.environ["PADDLE_TRAINERS_NUM"])
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+        if eps:
+            return len(eps.split(","))
+        return jax.process_count()
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=True, current_id=0, role=Role.WORKER,
+                 worker_num=1, server_endpoints=None, **kwargs):
+        super().__init__()
+        self._current_id = current_id
+        self._worker_num = worker_num
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num

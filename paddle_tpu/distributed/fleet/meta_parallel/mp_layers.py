@@ -1,0 +1,119 @@
+"""Tensor-parallel layers.
+
+Reference: `fleet/meta_parallel/parallel_layers/mp_layers.py`
+(VocabParallelEmbedding:30, ColumnParallelLinear:97, RowParallelLinear:170,
+ParallelCrossEntropy:249) — explicit weight-slice layers calling c_* NCCL ops.
+
+TPU re-design (GSPMD): each layer owns the FULL logical weight annotated with
+a PartitionSpec over the 'mp' mesh axis; under @to_static/pjit XLA partitions
+the matmul and inserts the all-reduce/all-gather the reference codes by hand
+(identity/allreduce pairs around column/row splits). Sharding constraints at
+layer boundaries pin the activation layouts so the partitioner keeps the
+Megatron pattern (column out-sharded → row in-sharded → psum).
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.dispatch import call_op
+from .... import ops
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer.layers import Layer
+from ... import parallel_env
+from ..base.topology import AXIS_MODEL
+
+
+def sharding_constraint(x, *spec):
+    """with_sharding_constraint as a differentiable framework op."""
+    mesh = parallel_env.current_mesh()
+    if mesh is None:
+        return x
+    sh = NamedSharding(mesh, P(*spec))
+
+    def _constrain(v):
+        return jax.lax.with_sharding_constraint(v, sh)
+
+    return call_op(_constrain, x, op_name="sharding_constraint")
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 name=None, mp_group=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.pspec = P(AXIS_MODEL, None)  # vocab-dim sharded
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, name=None, mp_group=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.pspec = P(None, AXIS_MODEL)  # out-dim sharded
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.pspec = P(AXIS_MODEL)
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return sharding_constraint(out, *( [None] * (len(out.shape) - 1) + [None] ))
+        # keep the hidden dim sharded (megatron column output)
+        spec = [None] * (len(out.shape) - 1) + [AXIS_MODEL]
+        return sharding_constraint(out, *spec)
+
+
+class RowParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, name=None,
+                 mp_group=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.pspec = P(AXIS_MODEL, None)  # in-dim sharded
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.pspec = P()  # replicated (added after the implicit psum)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = [None] * (len(x.shape) - 1) + [AXIS_MODEL]
+            x = sharding_constraint(x, *spec)
+        out = F.linear(x, self.weight, self.bias)
+        # replicated output: GSPMD emits the mp all-reduce here
+        return sharding_constraint(out, *([None] * len(out.shape)))
+
+
+class ParallelCrossEntropy(Layer):
+    """TP-sharded softmax-xent (reference mp_layers.py:249 →
+    `operators/collective/c_softmax_with_cross_entropy_op.cu`). The logits'
+    class dim may be mp-sharded; XLA partitions the log-softmax reduction
+    and inserts the two mp all-reduces (max and sum) the CUDA kernel does
+    manually."""
+
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, input, label):  # noqa: A002
+        loss = F.cross_entropy(input, label, reduction="none",
+                               soft_label=False)
+        return ops.unsqueeze(loss, -1)

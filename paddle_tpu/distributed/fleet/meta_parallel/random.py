@@ -1,0 +1,65 @@
+"""TP RNG-state tracker (reference:
+`fleet/meta_parallel/parallel_layers/random.py`): dropout inside the
+model-parallel region must differ per mp rank while everything else matches.
+TPU mapping: named Generators (threefry key state); the 'model-parallel'
+state folds the mp axis index into the key under shard_map, which is exactly
+the per-rank-offset seed trick the reference does with seeds."""
+from contextlib import contextmanager
+
+from ....core.random import Generator
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        from ....core import random as core_random
+        prev = core_random.default_generator
+        core_random.default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            core_random.default_generator = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed if seed is not None else pyrandom.randint(0, 2 ** 31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024
+    _tracker.reset()
+    from ....core import random as core_random
+    core_random.seed(global_seed)
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)

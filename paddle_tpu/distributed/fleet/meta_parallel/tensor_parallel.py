@@ -1,0 +1,20 @@
+"""TensorParallel wrapper (reference: `fleet/meta_parallel/tensor_parallel.py:25`
+— broadcasts non-distributed params across the mp group at wrap time; on a
+single controller every rank shares one copy, so only the API remains)."""
+from ....nn.layer.layers import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

@@ -1,0 +1,2 @@
+"""Fleet utils (reference: `fleet/utils/`)."""
+from .recompute import recompute  # noqa: F401

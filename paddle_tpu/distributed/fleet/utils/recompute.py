@@ -1,0 +1,74 @@
+"""Activation recompute (reference: `fleet/utils/recompute.py:63`
+RecomputeFunction — PyLayer that drops intermediate activations and replays
+the forward in backward, restoring RNG state for dropout determinism).
+
+Eager mode: true memory saving (no tape inside the segment). Under
+@to_static the replay traces the segment twice, giving XLA a rematerialization
+region (jax.checkpoint-equivalent structure).
+"""
+from ....autograd.py_layer import PyLayer
+from ....core import random as core_random
+from ....core.autograd import enable_grad, grad as autograd_grad, no_grad
+from ....core.tensor import Tensor
+
+
+class RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.preserve_rng_state = preserve_rng_state
+        ctx.inputs = args
+        if preserve_rng_state:
+            ctx.rng_state = core_random.default_generator._key_t._value
+        with no_grad():
+            outputs = run_function(*args)
+        return outputs
+
+    @staticmethod
+    def backward(ctx, *grads):
+        detached = []
+        for a in ctx.inputs:
+            if isinstance(a, Tensor):
+                t = Tensor(a._value, stop_gradient=a.stop_gradient)
+                detached.append(t)
+            else:
+                detached.append(a)
+        if ctx.preserve_rng_state:
+            saved_key = core_random.default_generator._key_t._value
+            core_random.default_generator._key_t._value = ctx.rng_state
+        try:
+            with enable_grad():
+                outputs = ctx.run_function(*detached)
+        finally:
+            if ctx.preserve_rng_state:
+                core_random.default_generator._key_t._value = saved_key
+
+        outs = outputs if isinstance(outputs, (tuple, list)) else [outputs]
+        out_tensors = [o for o in outs if isinstance(o, Tensor)
+                       and not o.stop_gradient]
+        # Seed every output with its cotangent via sum(out*cot) and run a full
+        # backward: input grads land on the detached leaves below, parameter
+        # grads accumulate directly on the Parameters touched inside the
+        # segment (reference semantics — grads of a recompute segment merge
+        # into the params' accumulated gradients).
+        from .... import ops as _ops
+        combined = None
+        for o, g in zip(out_tensors, grads):
+            term = _ops.sum(_ops.multiply(o, g))
+            combined = term if combined is None else combined + term
+        if combined is not None:
+            combined.backward()
+        result = []
+        for t in detached:
+            if isinstance(t, Tensor):
+                result.append(t.grad)
+        return tuple(result)
+
+
+def recompute(function, *args, preserve_rng_state=True, **kwargs):
+    """reference API: paddle.distributed.fleet.utils.recompute"""
+    if kwargs:
+        function_ = lambda *a: function(*a, **kwargs)  # noqa: E731
+    else:
+        function_ = function
+    return RecomputeFunction.apply(function_, preserve_rng_state, *args)

@@ -1,0 +1,48 @@
+"""DataParallel (reference: `python/paddle/fluid/dygraph/parallel.py:382` +
+C++ `imperative/reducer.cc` bucketed allreduce).
+
+TPU re-design: no gradient reducer exists — the wrapped model's training step,
+compiled with @to_static over the active mesh, shards the batch on the 'dp'
+axis and XLA emits the gradient all-reduce (fused, overlapped with backward
+by the compiler — the analog of reducer.cc's bucketing/overlap). The wrapper
+keeps the reference API surface: it marks batch inputs with a dp sharding
+spec and replicates parameters.
+"""
+from jax.sharding import PartitionSpec
+
+from ..nn.layer.layers import Layer
+from . import parallel_env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._dp_axis = "dp"
+        mesh = parallel_env.current_mesh()
+        if mesh is not None and self._dp_axis in mesh.axis_names:
+            for p in layers.parameters():
+                if p.pspec is None:
+                    p.pspec = PartitionSpec()  # replicated over dp
+
+    @property
+    def batch_pspec(self):
+        return PartitionSpec(self._dp_axis)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # reference API compat: no-op on TPU (XLA fuses the grad allreduce)
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

@@ -1,0 +1,82 @@
+"""Process/mesh environment.
+
+Replaces the reference's env-contract bootstrap (`python/paddle/distributed/
+parallel.py:58 init_parallel_env`, PADDLE_TRAINER_* vars, NCCL comm-id TCP
+exchange `platform/gen_comm_id_helper.cc`) with the jax picture: one python
+process drives all local chips; multi-host uses jax.distributed.initialize
+(the coordination service is the comm-id rendezvous analog). The device mesh
+(`jax.sharding.Mesh`) is the TPU-native HybridCommunicateGroup substrate.
+"""
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_mesh = None
+
+
+def current_mesh():
+    return _mesh
+
+
+def set_mesh(mesh):
+    global _mesh
+    _mesh = mesh
+    return mesh
+
+
+def make_mesh(axes, devices=None):
+    """axes: dict name->size, e.g. {'dp':2,'mp':2,'pp':2}. -1 infers one axis."""
+    devices = devices if devices is not None else jax.devices()
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    assert total <= n, f"mesh {dict(zip(names, sizes))} needs {total} devices, have {n}"
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, axis_names=names)
+
+
+def init_parallel_env():
+    """Single-host: nothing to bootstrap (XLA owns the collectives). Multi-host
+    under a launcher: initialize the jax coordination service from env."""
+    if "PADDLE_TRAINER_ENDPOINTS" in os.environ and jax.process_count() == 1:
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        if len(eps) > 1:
+            jax.distributed.initialize(
+                coordinator_address=eps[0],
+                num_processes=len(eps),
+                process_id=rank)
+    return ParallelEnv()
+
+
+class ParallelEnv:
+    """reference: python/paddle/fluid/dygraph/parallel.py:71"""
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def world_size(self):
+        return jax.process_count()
+
+    @property
+    def device_id(self):
+        return 0
+
+    local_rank = rank
+    nranks = world_size
+
+
+def get_rank():
+    return jax.process_index()
+
+
+def get_world_size():
+    return jax.process_count()

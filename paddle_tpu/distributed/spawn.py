@@ -1,0 +1,24 @@
+"""spawn (reference: `python/paddle/distributed/spawn.py:333`).
+
+One JAX process drives all local TPU chips, so single-host spawn runs the
+target in-process (nprocs>1 only makes sense multi-host, where the launcher
+sets the coordination env and each host runs one process).
+"""
+import os
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    if nprocs in (-1, 1) or "PADDLE_TRAINER_ENDPOINTS" not in os.environ:
+        result = func(*args)
+        return _Context([result])
+    raise NotImplementedError(
+        "multi-host spawn: use paddle_tpu.distributed.launch with one process "
+        "per host; in-host parallelism is the device mesh")
+
+
+class _Context:
+    def __init__(self, results):
+        self.results = results
+
+    def join(self):
+        return True

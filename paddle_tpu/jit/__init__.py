@@ -1,0 +1,3 @@
+"""paddle_tpu.jit — to_static + save/load (reference: `python/paddle/jit/`)."""
+from .to_static import StaticFunction, InputSpec, to_static, not_to_static, in_tracing  # noqa: F401
+from .io import save, load, TranslatedLayer  # noqa: F401

@@ -1,0 +1,82 @@
+"""jit.save / jit.load — inference model export.
+
+Reference: `python/paddle/fluid/dygraph/jit.py:515/876` (save/load →
+TranslatedLayer) and `fluid/io.py:1246 save_inference_model`. The serialized
+artifact here is a state_dict archive + a pickled layer constructor spec; the
+serving runner (paddle_tpu.inference.Predictor) loads it and compiles the
+forward once. A StableHLO export path is planned for cross-process serving.
+"""
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_SUFFIX_PARAMS = ".pdiparams"
+_SUFFIX_MODEL = ".pdmodel"
+
+
+def _save_state_dict_np(state_dict, path):
+    arrays = {k: np.asarray(v._value if isinstance(v, Tensor) else v)
+              for k, v in state_dict.items()}
+    # np.savez needs str keys without '/': keep a name map
+    np.savez(path, **{f"t{i}": a for i, a in enumerate(arrays.values())})
+    return list(arrays.keys())
+
+
+def save(layer, path, input_spec=None, **config):
+    """Save layer params + spec for later `jit.load` / Predictor serving."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    sd = layer.state_dict()
+    names = _save_state_dict_np(sd, path + _SUFFIX_PARAMS + ".npz")
+    meta = {
+        "names": names,
+        "class_module": type(layer).__module__,
+        "class_name": type(layer).__qualname__,
+        "input_spec": input_spec,
+    }
+    # Best effort: pickle the layer object itself for exact reload.
+    try:
+        with open(path + _SUFFIX_MODEL, "wb") as f:
+            pickle.dump({"meta": meta, "layer": layer}, f)
+    except Exception:
+        with open(path + _SUFFIX_MODEL, "wb") as f:
+            pickle.dump({"meta": meta, "layer": None}, f)
+
+
+class TranslatedLayer:
+    """Loaded inference layer (reference: TranslatedLayer jit.py)."""
+
+    def __init__(self, layer):
+        self._layer = layer
+        self._layer.eval()
+        from .to_static import StaticFunction
+        self._forward = StaticFunction(layer.forward, donate_state=False)
+
+    def __call__(self, *args, **kwargs):
+        from ..core.autograd import no_grad
+        with no_grad():
+            return self._forward(*args, **kwargs)
+
+    def eval(self):
+        self._layer.eval()
+        return self
+
+    def state_dict(self):
+        return self._layer.state_dict()
+
+
+def load(path, **config):
+    with open(path + _SUFFIX_MODEL, "rb") as f:
+        blob = pickle.load(f)
+    layer = blob["layer"]
+    if layer is None:
+        raise RuntimeError(
+            f"{path}: layer class could not be pickled at save time; "
+            "reconstruct the layer and use set_state_dict + load_params")
+    data = np.load(path + _SUFFIX_PARAMS + ".npz")
+    names = blob["meta"]["names"]
+    sd = {name: data[f"t{i}"] for i, name in enumerate(names)}
+    layer.set_state_dict(sd)
+    return TranslatedLayer(layer)

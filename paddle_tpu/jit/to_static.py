@@ -1,0 +1,224 @@
+"""@to_static: compile the imperative training step into one XLA computation.
+
+The reference reaches whole-program execution via AST transformation →
+ProgramDesc → run_program op (`python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:759`, `partial_program.py:111`,
+`operators/run_program_op.cc:176`). On TPU we get the same result by *tracing*:
+the eager Tensor wraps whatever jax hands it, so running the user's python
+step function under `jax.jit` with all framework state (parameters, buffers,
+optimizer accumulators, RNG key, lr) threaded through as donated inputs turns
+`forward(); loss.backward(); opt.step()` into a single compiled, fused,
+buffer-aliased XLA program — the "north star" fast path.
+
+Sharding: state tensors carry an optional PartitionSpec (`Tensor.pspec`);
+when a mesh is active (fleet.init / paddle_tpu.distributed.set_mesh) state and
+inputs are device_put onto NamedShardings before compilation, and GSPMD
+inserts the collectives (the analog of the reference's c_allreduce insertion
+by fleet meta-optimizers).
+"""
+import functools
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core import state as state_mod
+from ..core.tensor import Tensor
+
+_is_tracing = False
+
+
+def in_tracing():
+    return _is_tracing
+
+
+def _is_dynamic(x):
+    return isinstance(x, (Tensor, jax.Array, np.ndarray, np.generic))
+
+
+class _StateSwap:
+    """Swap registered state values with tracers for the trace duration."""
+
+    def __init__(self, items, values):
+        self.items = items
+        self.values = values
+        self.saved = None
+
+    def __enter__(self):
+        global _is_tracing
+        self.saved = [(t._value, t._tape_node, t._grad) for _, t in self.items]
+        for (_, t), v in zip(self.items, self.values):
+            t._value = v
+            t._tape_node = None
+            t._grad = None
+        self._was_tracing = _is_tracing
+        _is_tracing = True
+        return self
+
+    def capture(self):
+        return [t._value for _, t in self.items]
+
+    def __exit__(self, *exc):
+        global _is_tracing
+        _is_tracing = self._was_tracing
+        for (_, t), (v, node, g) in zip(self.items, self.saved):
+            t._value = v
+            t._tape_node = node
+            t._grad = g
+        return False
+
+
+def _leaf_key(x):
+    if _is_dynamic(x):
+        return ("dyn", tuple(np.shape(x)), np.dtype(
+            x.dtype if hasattr(x, "dtype") else type(x)).str)
+    try:
+        hash(x)
+        return ("static", x)
+    except TypeError:
+        return ("static", repr(x))
+
+
+class StaticFunction:
+    """Callable wrapper with a compile cache keyed on arg shapes/dtypes and
+    the framework-state registry version (reference: StaticFunction
+    program_translator.py:232 + its program cache)."""
+
+    def __init__(self, fn, input_spec=None, donate_state=True):
+        self._fn = fn
+        self._cache = {}
+        self._donate = donate_state
+        self._input_spec = input_spec
+        functools.update_wrapper(self, fn)
+
+    # -- sharding helpers -------------------------------------------------
+    @staticmethod
+    def _mesh():
+        from ..distributed import parallel_env
+        return parallel_env.current_mesh()
+
+    @staticmethod
+    def _place_state(items, mesh):
+        """device_put state onto NamedShardings per tensor pspec (committed
+        arrays steer GSPMD; donation keeps them in place thereafter)."""
+        for _, t in items:
+            if isinstance(t._value, jax.Array) and getattr(t._value, "committed", False):
+                continue
+            spec = t.pspec if t.pspec is not None else PartitionSpec()
+            t._value = jax.device_put(t._value, NamedSharding(mesh, spec))
+
+    def __call__(self, *args, **kwargs):
+        if _is_tracing:  # nested to_static: inline
+            return self._fn(*args, **kwargs)
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        dyn_idx = [i for i, l in enumerate(leaves) if _is_dynamic(l)]
+        dyn_vals = [leaves[i]._value if isinstance(leaves[i], Tensor)
+                    else leaves[i] for i in dyn_idx]
+
+        state_items = state_mod.snapshot()
+        mesh = self._mesh()
+        if mesh is not None:
+            self._place_state(state_items, mesh)
+            dyn_vals = self._place_args(dyn_vals, mesh)
+
+        key = (treedef, tuple(_leaf_key(l) for l in leaves),
+               tuple(uid for uid, _ in state_items), state_mod.version(),
+               mesh is not None)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(treedef, leaves, dyn_idx, state_items)
+            self._cache[key] = entry
+        compiled, out_wrap = entry
+
+        state_vals = [t._value for _, t in state_items]
+        out_flat, new_state = compiled(state_vals, dyn_vals)
+        for (_, t), v in zip(state_items, new_state):
+            t._value = v
+        return out_wrap(out_flat)
+
+    def _place_args(self, dyn_vals, mesh):
+        """Respect explicit input shardings; default: leave placement to jax
+        (replicated). DataParallel layers set `_arg_pspec` on the wrapper."""
+        specs = getattr(self, "_arg_pspecs", None)
+        if specs is None:
+            return dyn_vals
+        out = []
+        for v, spec in zip(dyn_vals, specs):
+            if spec is None:
+                out.append(v)
+            else:
+                out.append(jax.device_put(v, NamedSharding(mesh, spec)))
+        return out
+
+    def _build(self, treedef, template_leaves, dyn_idx, state_items):
+        fn = self._fn
+        out_template = {}
+
+        def pure_fn(state_vals, dyn_vals):
+            leaves = list(template_leaves)
+            for i, v in zip(dyn_idx, dyn_vals):
+                leaves[i] = Tensor(v)
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+            with _StateSwap(state_items, state_vals) as swap:
+                out = fn(*args, **kwargs)
+                out_leaves, out_treedef = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                out_vals = [l._value if isinstance(l, Tensor) else l
+                            for l in out_leaves]
+                out_template["treedef"] = out_treedef
+                new_state = swap.capture()
+            return out_vals, new_state
+
+        donate = (0,) if self._donate else ()
+        compiled = jax.jit(pure_fn, donate_argnums=donate)
+
+        def out_wrap(out_flat):
+            wrapped = [Tensor(v) if isinstance(v, jax.Array) else v
+                       for v in out_flat]
+            return jax.tree_util.tree_unflatten(out_template["treedef"], wrapped)
+
+        return compiled, out_wrap
+
+    # paddle API compat
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._fn)
+
+    def concrete_program(self):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    """Decorator / wrapper, usable as @to_static or to_static(fn)."""
+    if function is None:
+        return lambda fn: to_static(fn, input_spec=input_spec)
+    if isinstance(function, StaticFunction):
+        return function
+    # Layers: wrap forward, keep the layer object semantics
+    from ..nn.layer.layers import Layer
+    if isinstance(function, Layer):
+        layer = function
+        static_forward = StaticFunction(layer.forward, input_spec)
+        layer.forward = static_forward
+        return layer
+    return StaticFunction(function, input_spec)
+
+
+class InputSpec:
+    """Shape/dtype declaration (reference: paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

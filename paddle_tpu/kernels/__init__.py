@@ -1,0 +1,2 @@
+"""Pallas TPU kernels — the analog of the reference's hand-written CUDA
+`operators/fused/` + `operators/math/` for cases XLA fusion can't reach."""

@@ -1,0 +1,11 @@
+"""Flash attention pallas kernel (placeholder wiring; kernel lands with the
+kernels milestone — until then is_available() gates callers to the fused-XLA
+path)."""
+
+
+def is_available():
+    return False
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None):
+    raise NotImplementedError

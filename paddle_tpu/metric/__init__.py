@@ -1,0 +1,173 @@
+"""Metrics (reference: `python/paddle/metric/metrics.py`)."""
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import ops
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label):
+        pred_np = np.asarray(pred.numpy() if isinstance(pred, Tensor) else pred)
+        label_np = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+        idx = np.argsort(-pred_np, axis=-1)[..., :self.maxk]
+        if label_np.ndim == idx.ndim:
+            label_np = label_np.squeeze(-1)
+        correct = idx == label_np[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct):
+        c = np.asarray(correct.numpy() if isinstance(correct, Tensor) else correct)
+        accs = []
+        num = int(np.prod(c.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            n_correct = float(c[..., :k].sum())
+            self.total[i] += n_correct
+            self.count[i] += num
+            accs.append(n_correct / max(num, 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels)
+        p = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.astype(np.int32).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return [self._name]
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels)
+        p = (p > 0.5).astype(np.int32).reshape(-1)
+        l = l.astype(np.int32).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return [self._name]
+
+
+class Auc(Metric):
+    """Streaming AUC via thresholded confusion bins (reference:
+    `operators/metrics/auc_op.cc`)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels)
+        if p.ndim == 2 and p.shape[1] == 2:
+            p = p[:, 1]
+        p = p.reshape(-1)
+        l = l.reshape(-1)
+        bins = np.minimum((p * self.num_thresholds).astype(np.int64),
+                          self.num_thresholds)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # integrate TPR over FPR from the highest threshold down
+        pos = self._stat_pos[::-1].cumsum()
+        neg = self._stat_neg[::-1].cumsum()
+        tpr = pos / tot_pos
+        fpr = neg / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+    def name(self):
+        return [self._name]
+
+
+def accuracy(input, label, k=1):  # noqa: A002
+    """Functional accuracy (reference: `operators/metrics/accuracy_op.cc`)."""
+    values, indices = ops.topk(input, k)
+    label_np = label
+    correct = ops.equal(indices.astype("int64"),
+                        ops.reshape(label_np, [-1, 1]).astype("int64"))
+    return ops.mean(ops.cast(ops.any(correct, axis=-1), "float32"))

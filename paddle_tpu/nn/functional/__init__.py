@@ -1,0 +1,26 @@
+"""paddle_tpu.nn.functional — mirrors `python/paddle/nn/functional/`."""
+from .activation import *  # noqa: F401,F403
+from .common import (  # noqa: F401
+    linear, embedding, dropout, dropout2d, dropout3d, alpha_dropout, one_hot,
+    label_smooth, interpolate, upsample, unfold, cosine_similarity, bilinear,
+    normalize, pixel_shuffle, pad,
+)
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose,
+)
+from .pooling import (  # noqa: F401
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_max_pool2d,
+)
+from .norm import (  # noqa: F401
+    batch_norm, layer_norm, rms_norm, instance_norm, group_norm,
+    local_response_norm,
+)
+from .loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
+    kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
+    triplet_margin_loss, square_error_cost, sigmoid_focal_loss,
+)
+from .attention import scaled_dot_product_attention  # noqa: F401

@@ -1,0 +1,70 @@
+"""Attention functional.
+
+Not a single op in the reference (composed from matmul+softmax there; the
+fused path is `operators/fused/fused_attention_op.cu` in later snapshots).
+Here: one fused XLA computation by default, and the pallas flash-attention
+kernel (paddle_tpu.kernels.flash_attention) on TPU for long sequences.
+"""
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op
+
+_FLASH_MIN_SEQ = 512  # below this XLA's fused softmax-matmul is already fine
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 scale=None):
+    """q/k/v: [batch, seq, heads, head_dim] (paddle layout)."""
+    from ...core import random as core_random
+
+    q_shape = query.shape
+    seq_len = q_shape[1]
+    use_flash = False
+    if dropout_p == 0.0 and attn_mask is None and seq_len >= _FLASH_MIN_SEQ:
+        try:
+            from ...kernels import flash_attention as _fa
+            use_flash = _fa.is_available()
+        except Exception:
+            use_flash = False
+
+    if use_flash:
+        from ...kernels import flash_attention as _fa
+
+        def _flash(q, k, v):
+            return _fa.flash_attention_bshd(q, k, v, causal=is_causal,
+                                            scale=scale)
+
+        return call_op(_flash, query, key, value, op_name="flash_attention")
+
+    drop_key = core_random.next_key() if (dropout_p > 0.0 and training) else None
+
+    def _sdpa(q, k, v, *rest):
+        mask = rest[0] if attn_mask is not None else None
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+        # [B, S, H, D] -> [B, H, S, D]
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+        if is_causal:
+            causal = jnp.tril(jnp.ones((logits.shape[-2], logits.shape[-1]),
+                                       dtype=bool))
+            logits = jnp.where(causal, logits, jnp.asarray(-1e9, logits.dtype))
+        if mask is not None:
+            if mask.dtype == jnp.bool_:
+                logits = jnp.where(mask, logits, jnp.asarray(-1e9, logits.dtype))
+            else:
+                logits = logits + mask
+        probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        if drop_key is not None:
+            import jax
+            keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vt.dtype), vt)
+        return jnp.swapaxes(out, 1, 2)  # back to [B, S, H, D]
+
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
+    return call_op(_sdpa, *args, op_name="scaled_dot_product_attention")

@@ -1,0 +1,186 @@
+"""Common functionals: linear, embedding, dropout, interpolate, pad, one_hot.
+
+References: `paddle/fluid/operators/matmul_v2_op.cc` (+ fc fusion pass —
+linear is a single dot_general here, XLA fuses the bias add),
+`lookup_table_v2_op.cc` (embedding), `dropout_op.cu` (dropout — threefry
+masks instead of curand).
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core import random as core_random
+from ...core.dispatch import call_op, unwrap
+from ...ops.manipulation import pad as _pad_op  # re-export
+from ...ops.math import _norm_axis
+
+pad = _pad_op
+
+
+def linear(x, weight, bias=None):
+    """y = x @ W + b. W layout [in, out] as in the reference (matmul_v2 +
+    elementwise_add; `python/paddle/nn/functional/common.py` linear)."""
+    if bias is None:
+        return call_op(lambda v, w: jnp.matmul(v, w), x, weight, op_name="linear")
+    return call_op(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias,
+                   op_name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    del sparse  # dense gather on TPU; SelectedRows path is CPU/PS-specific
+
+    def _embed(w, idx):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return call_op(_embed, weight, unwrap(x), op_name="embedding")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
+    if not training or p == 0.0:
+        return x
+    key = core_random.next_key()
+
+    def _dropout(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+
+    return call_op(_dropout, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x
+    key = core_random.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def _ad(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, alpha_p) + b
+
+    return call_op(_ad, x, op_name="alpha_dropout")
+
+
+def one_hot(x, num_classes):
+    from ...ops.manipulation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    def _ls(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            return (1 - epsilon) * l + epsilon * unwrap(prior_dist)
+        return (1 - epsilon) * l + epsilon / k
+    return call_op(_ls, label, op_name="label_smooth")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    """Image resize (reference: `operators/interpolate_v2_op.*`)."""
+    v = unwrap(x)
+    if data_format == "NCHW":
+        spatial = v.shape[2:]
+    else:
+        spatial = v.shape[1:-1]
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    size = [int(s) for s in (size.numpy() if hasattr(size, "numpy") else size)]
+
+    jax_method = {"nearest": "nearest", "bilinear": "linear",
+                  "bicubic": "cubic", "trilinear": "linear",
+                  "linear": "linear", "area": "linear"}[mode]
+
+    def _interp(val):
+        if data_format == "NCHW":
+            out_shape = val.shape[:2] + tuple(size)
+        else:
+            out_shape = (val.shape[0],) + tuple(size) + (val.shape[-1],)
+        return jax.image.resize(val, out_shape, method=jax_method)
+
+    return call_op(_interp, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference: `operators/math/im2col.cc`)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def _unfold(v):
+        n, c = v.shape[:2]
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=tuple(ks), window_strides=tuple(st),
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])],
+            rhs_dilation=tuple(dl),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+
+    return call_op(_unfold, x, op_name="unfold")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def _cos(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return call_op(_cos, x1, x2, op_name="cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None):
+    def _bilinear(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return call_op(_bilinear, *args, op_name="bilinear")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    def _normalize(v):
+        nrm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+    return call_op(_normalize, x, op_name="normalize")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+
+    def _ps(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c // (r * r), r, r, h, w)
+        v = v.transpose(0, 1, 4, 2, 5, 3)
+        return v.reshape(n, c // (r * r), h * r, w * r)
+
+    return call_op(_ps, x, op_name="pixel_shuffle")

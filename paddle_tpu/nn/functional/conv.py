@@ -1,0 +1,148 @@
+"""Convolutions via lax.conv_general_dilated.
+
+Reference: `paddle/fluid/operators/conv_op.cc` / `conv_cudnn_op.cu` /
+`conv_transpose_op.cc`. One XLA convolution covers what the reference splits
+across im2col+gemm, cuDNN algo search, and depthwise special cases — the MXU
+tiling is the compiler's job.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dim_numbers(nd, data_format):
+    if nd == 1:
+        return ("NCL", "OIL", "NCL") if data_format in ("NCL", "NCHW") else ("NLC", "OIL", "NLC")
+    if nd == 2:
+        return ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC")
+    return ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else ("NDHWC", "OIDHW", "NDHWC")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    strides = _pair(stride, nd)
+    dil = _pair(dilation, nd)
+    pad = _conv_padding(padding, nd)
+    dn = _dim_numbers(nd, data_format)
+
+    def _conv(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, feature_group_count=groups,
+            dimension_numbers=dn,
+            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None)
+        if out.dtype != v.dtype:
+            out = out.astype(v.dtype)
+        if rest:
+            b = rest[0]
+            if dn[2].endswith("C"):
+                out = out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * nd)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return call_op(_conv, *args, op_name=f"conv{nd}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, data_format):
+    strides = _pair(stride, nd)
+    dil = _pair(dilation, nd)
+    opad = _pair(output_padding, nd)
+    dn = _dim_numbers(nd, data_format)
+
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        pad_cfg = _conv_padding(padding, nd)
+
+    def _convt(v, w, *rest):
+        # Transposed conv as fractionally-strided conv: lhs_dilation=stride,
+        # spatially-flipped kernel with in/out swapped. Weight layout
+        # [in, out/groups, *k] (paddle conv_transpose layout).
+        k = w.shape[2:]
+        # [in, out/g, *k] -> [g, in/g, out/g, *k] -> [g*out/g, in/g, *k]
+        in_ch = w.shape[0]
+        w_g = w.reshape((groups, in_ch // groups, w.shape[1]) + k)
+        w_g = jnp.swapaxes(w_g, 1, 2)
+        w_oihw = w_g.reshape((groups * w.shape[1], in_ch // groups) + k)
+        spatial_axes = tuple(range(2, 2 + nd))
+        w_oihw = jnp.flip(w_oihw, axis=spatial_axes)
+
+        if isinstance(pad_cfg, str):
+            raise NotImplementedError(
+                "string padding for conv_transpose not supported")
+        pad = []
+        for kk, dd, (p0, p1), op in zip(k, dil, pad_cfg, opad):
+            k_eff = (kk - 1) * dd + 1
+            pad.append((k_eff - 1 - p0, k_eff - 1 - p1 + op))
+        out = jax.lax.conv_general_dilated(
+            v, w_oihw, window_strides=(1,) * nd, padding=pad,
+            lhs_dilation=strides, rhs_dilation=dil,
+            feature_group_count=groups, dimension_numbers=dn)
+        if rest:
+            b = rest[0]
+            if dn[2].endswith("C"):
+                out = out + b.reshape((1,) * (out.ndim - 1) + (-1,))
+            else:
+                out = out + b.reshape((1, -1) + (1,) * nd)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return call_op(_convt, *args, op_name=f"conv{nd}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCHW"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCDHW"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format)

@@ -1,0 +1,192 @@
+"""Loss functionals.
+
+Reference: `operators/softmax_with_cross_entropy_op.*`,
+`cross_entropy_op.cc`, `bce_loss_op.cc`, `smooth_l1_loss_op.cc`, etc.
+cross_entropy is the fused logits path by default (`use_softmax=True`),
+matching the reference's softmax_with_cross_entropy in one XLA computation.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op, unwrap
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    lbl = unwrap(label)
+
+    def _ce(logits, *rest):
+        w = rest[0] if weight is not None else None
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            tgt = lbl.astype(logp.dtype)
+            if label_smoothing > 0.0:
+                k = logp.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            idx = lbl
+            if idx.ndim == logp.ndim:
+                idx = jnp.squeeze(idx, axis=axis)
+            idx = idx.astype(jnp.int32)
+            valid = idx != ignore_index
+            safe_idx = jnp.where(valid, idx, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_idx, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0.0:
+                k = logp.shape[axis]
+                mean_logp = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * mean_logp
+            loss = -jnp.where(valid, picked, 0.0)
+            if w is not None:
+                loss = loss * jnp.take(w, safe_idx) * valid
+            if reduction == "mean":
+                denom = (jnp.sum(jnp.take(w, safe_idx) * valid)
+                         if w is not None else jnp.sum(valid))
+                return jnp.sum(loss) / jnp.maximum(denom, 1)
+        return _reduce(loss, reduction)
+
+    args = (input,) + ((weight,) if weight is not None else ())
+    return call_op(_ce, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100, return_softmax=False):
+    loss = cross_entropy(logits, label, reduction="none",
+                         soft_label=soft_label, axis=axis,
+                         ignore_index=ignore_index)
+    from .activation import softmax as _softmax
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):  # noqa: A002
+    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index,
+                         reduction=reduction, use_softmax=False,
+                         soft_label=False)
+
+
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    return call_op(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                   input, label, op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    return call_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                   input, label, op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    def _sl1(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return call_op(_sl1, input, label, op_name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    def _bce(p, t, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return call_op(_bce, *args, op_name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    def _bcewl(z, t, *rest):
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        pw = next(it) if pos_weight is not None else None
+        log_sig = jax.nn.log_sigmoid(z)
+        log_one_minus = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * t * log_sig + (1 - t) * log_one_minus)
+        else:
+            loss = -(t * log_sig + (1 - t) * log_one_minus)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = ((logit, label) + ((weight,) if weight is not None else ())
+            + ((pos_weight,) if pos_weight is not None else ()))
+    return call_op(_bcewl, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean"):  # noqa: A002
+    def _kl(logp, t):
+        loss = t * (jnp.log(jnp.maximum(t, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return call_op(_kl, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    def _mr(a, b, t):
+        return _reduce(jnp.maximum(0.0, -t * (a - b) + margin), reduction)
+    return call_op(_mr, input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):  # noqa: A002
+    def _hinge(a, t):
+        loss = jnp.where(t == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return call_op(_hinge, input, label, op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    def _cel(a, b, t):
+        cos = (jnp.sum(a * b, axis=-1)
+               / jnp.maximum(jnp.linalg.norm(a, axis=-1)
+                             * jnp.linalg.norm(b, axis=-1), 1e-12))
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return call_op(_cel, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, reduction="mean"):
+    def _tm(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.abs(a - pos) ** p, axis=-1) + epsilon, 1 / p)
+        dn = jnp.power(jnp.sum(jnp.abs(a - neg) ** p, axis=-1) + epsilon, 1 / p)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return call_op(_tm, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return call_op(lambda a, b: jnp.square(a - b), input, label,
+                   op_name="square_error_cost")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    def _focal(z, t, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = -(t * jax.nn.log_sigmoid(z) + (1 - t) * jax.nn.log_sigmoid(-z))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return call_op(_focal, *args, op_name="sigmoid_focal_loss")

@@ -1,0 +1,156 @@
+"""Normalization functionals.
+
+Reference: `operators/batch_norm_op.cc` / `layer_norm_op.cc` /
+`group_norm_op.cc` / `instance_norm_op.cc`. Running-stat buffers are mutated
+eagerly (or as traced state under to_static) — the analog of the reference's
+in-place MeanOut/VarianceOut outputs.
+"""
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op, unwrap
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None):
+    if use_global_stats is None:
+        use_global_stats = not training
+    channel_axis = 1 if data_format.startswith("NC") else -1
+
+    v = unwrap(x)
+    reduce_axes = tuple(i for i in range(v.ndim) if i != (channel_axis % v.ndim))
+
+    if not use_global_stats:
+        # batch statistics; update running buffers in-place (traced state)
+        batch_mean = jnp.mean(v, axis=reduce_axes)
+        batch_var = jnp.var(v, axis=reduce_axes)
+        if running_mean is not None:
+            running_mean._value = (momentum * unwrap(running_mean)
+                                   + (1.0 - momentum) * batch_mean)
+            running_var._value = (momentum * unwrap(running_var)
+                                  + (1.0 - momentum) * batch_var)
+        mean_c, var_c = None, None  # recomputed differentiably below
+    else:
+        mean_c, var_c = unwrap(running_mean), unwrap(running_var)
+
+    bshape = [1] * v.ndim
+    bshape[channel_axis % v.ndim] = v.shape[channel_axis % v.ndim]
+
+    def _bn(val, *params):
+        it = iter(params)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        if use_global_stats:
+            m, var = mean_c, var_c
+        else:
+            m = jnp.mean(val, axis=reduce_axes)
+            var = jnp.var(val, axis=reduce_axes)
+        inv = jnp.asarray(1.0, val.dtype) / jnp.sqrt(var + epsilon)
+        out = (val - m.reshape(bshape)) * inv.reshape(bshape)
+        if w is not None:
+            out = out * w.reshape(bshape)
+        if b is not None:
+            out = out + b.reshape(bshape)
+        return out
+
+    params = tuple(p for p in (weight, bias) if p is not None)
+    return call_op(_bn, x, *params, op_name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(normalized_shape)
+
+    def _ln(val, *params):
+        it = iter(params)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        axes = tuple(range(val.ndim - nd, val.ndim))
+        m = jnp.mean(val, axis=axes, keepdims=True)
+        var = jnp.var(val, axis=axes, keepdims=True)
+        out = (val - m) / jnp.sqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    params = tuple(p for p in (weight, bias) if p is not None)
+    return call_op(_ln, x, *params, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    """RMSNorm — not in the reference snapshot; standard for modern LLM blocks."""
+    def _rms(val, *params):
+        var = jnp.mean(jnp.square(val), axis=-1, keepdims=True)
+        out = val / jnp.sqrt(var + epsilon)
+        if params:
+            out = out * params[0]
+        return out
+
+    params = (weight,) if weight is not None else ()
+    return call_op(_rms, x, *params, op_name="rms_norm")
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    def _in(val, *params):
+        it = iter(params)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        axes = tuple(range(2, val.ndim))  # per-sample, per-channel
+        m = jnp.mean(val, axis=axes, keepdims=True)
+        var = jnp.var(val, axis=axes, keepdims=True)
+        out = (val - m) / jnp.sqrt(var + epsilon)
+        shape = (1, -1) + (1,) * (val.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+
+    params = tuple(p for p in (weight, bias) if p is not None)
+    return call_op(_in, x, *params, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    def _gn(val, *params):
+        it = iter(params)
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        n, c = val.shape[0], val.shape[1]
+        spatial = val.shape[2:]
+        g = val.reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) / jnp.sqrt(var + epsilon)).reshape(val.shape)
+        shape = (1, -1) + (1,) * (val.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+
+    params = tuple(p for p in (weight, bias) if p is not None)
+    return call_op(_gn, x, *params, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    def _lrn(val):
+        c = val.shape[1]
+        sq = jnp.square(val)
+        acc = jnp.zeros_like(val)
+        half = size // 2
+        for off in range(-half, half + 1):
+            shifted = jnp.roll(sq, off, axis=1)
+            # zero out wrapped channels
+            idx = jnp.arange(c)
+            valid = (idx - off >= 0) & (idx - off < c)
+            acc = acc + jnp.where(valid.reshape(1, -1, *([1] * (val.ndim - 2))),
+                                  shifted, 0.0)
+        return val / jnp.power(k + alpha * acc, beta)
+
+    return call_op(_lrn, x, op_name="local_response_norm")

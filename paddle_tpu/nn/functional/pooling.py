@@ -1,0 +1,152 @@
+"""Pooling via lax.reduce_window (reference: `operators/pool_op.cc`)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import call_op
+from .conv import _pair, _conv_padding
+
+
+def _pool_nd(x, kernel_size, stride, padding, nd, reducer, init, data_format,
+             ceil_mode=False, exclusive=True, count_include_pad=False, name="pool"):
+    ks = _pair(kernel_size, nd)
+    st = _pair(stride if stride is not None else kernel_size, nd)
+    pad = _conv_padding(padding, nd)
+    channel_last = data_format.endswith("C") and data_format[1] != "C"
+
+    def _window(v):
+        if channel_last:
+            dims = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = [(0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * nd) + [(0, 0)]
+        else:
+            dims = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * nd)
+        if isinstance(pad, str):
+            pads = pad
+        return dims, strides, pads
+
+    def _pool(v):
+        dims, strides, pads = _window(v)
+        out = jax.lax.reduce_window(v, init, reducer, dims, strides, pads)
+        return out
+
+    def _avg_pool(v):
+        dims, strides, pads = _window(v)
+        summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides, pads)
+        if exclusive and not count_include_pad:
+            ones = jnp.ones_like(v)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                           strides, pads)
+            return summed / counts
+        return summed / float(np.prod(ks))
+
+    fn = _avg_pool if reducer is None else _pool
+    return call_op(fn, x, op_name=name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCL"):
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
+                    -jnp.inf, data_format, ceil_mode, name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW", return_mask=False):
+    out = _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
+                   -jnp.inf, data_format, ceil_mode, name="max_pool2d")
+    if return_mask:
+        raise NotImplementedError("return_mask not supported yet")
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
+                    -jnp.inf, data_format, ceil_mode, name="max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    return _pool_nd(x, kernel_size, stride, padding, 1, None, 0.0, data_format,
+                    ceil_mode, exclusive, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 2, None, 0.0, data_format,
+                    ceil_mode, exclusive, name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCDHW"):
+    return _pool_nd(x, kernel_size, stride, padding, 3, None, 0.0, data_format,
+                    ceil_mode, exclusive, name="avg_pool3d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    os = _pair(output_size, 2)
+
+    def _aap(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v4 = v.reshape(n, c, os[0], h // os[0], os[1], w // os[1])
+            return v4.mean(axis=(3, 5))
+        n, h, w, c = v.shape
+        v4 = v.reshape(n, os[0], h // os[0], os[1], w // os[1], c)
+        return v4.mean(axis=(2, 4))
+
+    # exact fast path when divisible; general path via resize-style mean
+    import jax.numpy as _jnp
+
+    def _general(v):
+        if data_format == "NCHW":
+            h, w = v.shape[2], v.shape[3]
+        else:
+            h, w = v.shape[1], v.shape[2]
+        if h % os[0] == 0 and w % os[1] == 0:
+            return _aap(v)
+        # fallback: interpolate-style adaptive pooling via cumulative windows
+        hs = np.linspace(0, h, os[0] + 1).astype(int)
+        ws = np.linspace(0, w, os[1] + 1).astype(int)
+        rows = []
+        for i in range(os[0]):
+            cols = []
+            for j in range(os[1]):
+                if data_format == "NCHW":
+                    cols.append(v[:, :, hs[i]:hs[i + 1], ws[j]:ws[j + 1]].mean(axis=(2, 3)))
+                else:
+                    cols.append(v[:, hs[i]:hs[i + 1], ws[j]:ws[j + 1], :].mean(axis=(1, 2)))
+            rows.append(_jnp.stack(cols, axis=-1))
+        out = _jnp.stack(rows, axis=-2)
+        if data_format == "NCHW":
+            return out
+        return _jnp.moveaxis(out, 1, -1)
+
+    return call_op(_general, x, op_name="adaptive_avg_pool2d")
+
+
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    os = _pair(output_size, 2)
+
+    def _amp(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v4 = v.reshape(n, c, os[0], h // os[0], os[1], w // os[1])
+            return v4.max(axis=(3, 5))
+        n, h, w, c = v.shape
+        v4 = v.reshape(n, os[0], h // os[0], os[1], w // os[1], c)
+        return v4.max(axis=(2, 4))
+
+    return call_op(_amp, x, op_name="adaptive_max_pool2d")
+
+
+def adaptive_avg_pool1d(x, output_size):
+    os = int(output_size)
+
+    def _aap(v):
+        n, c, l = v.shape
+        return v.reshape(n, c, os, l // os).mean(axis=3)
+
+    return call_op(_aap, x, op_name="adaptive_avg_pool1d")

@@ -1,0 +1,148 @@
+"""Weight initializers (reference: `python/paddle/fluid/initializer.py`,
+`python/paddle/nn/initializer/`). Draw through the functional RNG so model
+init is reproducible under `paddle_tpu.seed`.
+"""
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as core_random
+from ...core.dtype import convert_dtype
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(tuple(shape), self.value, dtype=convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        key = core_random.next_key()
+        return (jax.random.normal(key, tuple(shape), dtype=convert_dtype(dtype))
+                * self.std + self.mean)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        key = core_random.next_key()
+        return (jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape),
+                                            dtype=convert_dtype(dtype))
+                * self.std + self.mean)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        key = core_random.next_key()
+        return jax.random.uniform(key, tuple(shape), dtype=convert_dtype(dtype),
+                                  minval=self.low, maxval=self.high)
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out, in, *k] (paddle conv weight layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * _math.sqrt(2.0 / (fi + fo))
+        key = core_random.next_key()
+        return jax.random.normal(key, tuple(shape),
+                                 dtype=convert_dtype(dtype)) * std
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * _math.sqrt(6.0 / (fi + fo))
+        key = core_random.next_key()
+        return jax.random.uniform(key, tuple(shape), dtype=convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = _math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / _math.sqrt(fi)
+        key = core_random.next_key()
+        return jax.random.normal(key, tuple(shape),
+                                 dtype=convert_dtype(dtype)) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = _math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * _math.sqrt(3.0 / fi)
+        key = core_random.next_key()
+        return jax.random.uniform(key, tuple(shape), dtype=convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        arr = jnp.asarray(np.asarray(self.value), dtype=convert_dtype(dtype))
+        assert tuple(arr.shape) == tuple(shape), \
+            f"Assign initializer shape {arr.shape} != param shape {tuple(shape)}"
+        return arr
+
+
+# paddle-style default: fluid's default is Xavier for weights, Constant(0) bias
+def _default_weight_init():
+    return XavierNormal()
+
+
+def _default_bias_init():
+    return Constant(0.0)

@@ -1,0 +1,292 @@
+"""RNN layers (reference: `python/paddle/nn/layer/rnn.py`, `operators/rnn_op.*`).
+
+The recurrence is a `lax.scan` — compiler-friendly control flow instead of the
+reference's per-step op loop / cuDNN RNN descriptor. Weight layout matches the
+reference: weight_ih [gates*hidden, input], weight_hh [gates*hidden, hidden].
+Gate order: LSTM i,f,c,o ; GRU r,z,c (update/reset as in paddle).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op
+from ... import ops
+from .. import initializer as I
+from .layers import Layer
+
+
+def _lstm_step(carry, x_t, wi, wh, bi, bh, hidden):
+    h, c = carry
+    gates = x_t @ wi.T + h @ wh.T + bi + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+def _gru_step(carry, x_t, wi, wh, bi, bh, hidden):
+    h = carry
+    xg = x_t @ wi.T + bi
+    hg = h @ wh.T + bh
+    xr, xz, xc = jnp.split(xg, 3, axis=-1)
+    hr, hz, hc = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    h = (1.0 - z) * c + z * h
+    return h, h
+
+
+def _rnn_step_tanh(carry, x_t, wi, wh, bi, bh, hidden):
+    h = carry
+    h = jnp.tanh(x_t @ wi.T + h @ wh.T + bi + bh)
+    return h, h
+
+
+def _rnn_step_relu(carry, x_t, wi, wh, bi, bh, hidden):
+    h = carry
+    h = jax.nn.relu(x_t @ wi.T + h @ wh.T + bi + bh)
+    return h, h
+
+
+_STEPS = {"LSTM": (_lstm_step, 4, True), "GRU": (_gru_step, 3, False),
+          "RNN_TANH": (_rnn_step_tanh, 1, False),
+          "RNN_RELU": (_rnn_step_relu, 1, False)}
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirect else 1
+        _, gates, self.has_cell = _STEPS[mode]
+
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for direction in range(self.num_directions):
+                in_size = (input_size if layer == 0
+                           else hidden_size * self.num_directions)
+                suffix = "_reverse" if direction == 1 else ""
+                wi = self.create_parameter([gates * hidden_size, in_size],
+                                           attr=weight_ih_attr,
+                                           default_initializer=init)
+                wh = self.create_parameter([gates * hidden_size, hidden_size],
+                                           attr=weight_hh_attr,
+                                           default_initializer=init)
+                bi = self.create_parameter([gates * hidden_size],
+                                           attr=bias_ih_attr, is_bias=True,
+                                           default_initializer=init)
+                bh = self.create_parameter([gates * hidden_size],
+                                           attr=bias_hh_attr, is_bias=True,
+                                           default_initializer=init)
+                names = [f"weight_ih_l{layer}{suffix}",
+                         f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}",
+                         f"bias_hh_l{layer}{suffix}"]
+                for name, p in zip(names, (wi, wh, bi, bh)):
+                    self.add_parameter(name, p)
+                self._all_weights.append(names)
+
+    def _run_direction(self, x, wi, wh, bi, bh, h0, c0, reverse):
+        """x: [T, B, I] (time-major inside). Returns (out [T,B,H], h, c)."""
+        step_fn, _, has_cell = _STEPS[self.mode]
+        hidden = self.hidden_size
+
+        def _scan(xv, wiv, whv, biv, bhv, h0v, *rest):
+            if reverse:
+                xv = jnp.flip(xv, axis=0)
+            carry = (h0v, rest[0]) if has_cell else h0v
+
+            def body(carry, x_t):
+                return step_fn(carry, x_t, wiv, whv, biv, bhv, hidden)
+
+            carry, ys = jax.lax.scan(body, carry, xv)
+            if reverse:
+                ys = jnp.flip(ys, axis=0)
+            if has_cell:
+                return ys, carry[0], carry[1]
+            return ys, carry, carry
+
+        if has_cell:
+            return call_op(_scan, x, wi, wh, bi, bh, h0, c0, op_name=self.mode)
+        return call_op(_scan, x, wi, wh, bi, bh, h0, op_name=self.mode)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if not self.time_major:
+            x = ops.transpose(x, [1, 0, 2])
+        t, b = x.shape[0], x.shape[1]
+        d = self.num_directions
+
+        if initial_states is None:
+            h0 = ops.zeros([self.num_layers * d, b, self.hidden_size],
+                           dtype="float32")
+            c0 = ops.zeros([self.num_layers * d, b, self.hidden_size],
+                           dtype="float32")
+        elif self.has_cell:
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, None
+
+        h_finals, c_finals = [], []
+        out = x
+        from .. import functional as F
+        for layer in range(self.num_layers):
+            outs_dir = []
+            for direction in range(d):
+                idx = layer * d + direction
+                names = self._all_weights[idx]
+                wi, wh, bi, bh = (getattr(self, n) for n in names)
+                h_init = h0[idx]
+                c_init = c0[idx] if self.has_cell else None
+                res = self._run_direction(out, wi, wh, bi, bh, h_init, c_init,
+                                          reverse=(direction == 1))
+                ys, h_f, c_f = res
+                outs_dir.append(ys)
+                h_finals.append(h_f)
+                if self.has_cell:
+                    c_finals.append(c_f)
+            out = outs_dir[0] if d == 1 else ops.concat(outs_dir, axis=-1)
+            if self.dropout > 0.0 and layer < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+
+        h_n = ops.stack(h_finals, axis=0)
+        if not self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        if self.has_cell:
+            c_n = ops.stack(c_finals, axis=0)
+            return out, (h_n, c_n)
+        return out, h_n
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0):
+        b = batch_ref.shape[0]
+        return ops.full([b, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], is_bias=True,
+                                             default_initializer=init)
+        self._act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = self._act
+
+        def _cell(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + h @ wh.T + bi + bh)
+
+        h = call_op(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh, op_name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def _cell(x, hv, cv, wi, wh, bi, bh):
+            (hn, cn), _ = _lstm_step((hv, cv), x, wi, wh, bi, bh,
+                                     self.hidden_size)
+            return hn, cn
+
+        h, c = call_op(_cell, inputs, h, c, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, op_name="lstm_cell")
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, hv, wi, wh, bi, bh):
+            hn, _ = _gru_step(hv, x, wi, wh, bi, bh, self.hidden_size)
+            return hn
+
+        h = call_op(_cell, inputs, states, self.weight_ih, self.weight_hh,
+                    self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h

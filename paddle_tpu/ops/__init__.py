@@ -1,0 +1,106 @@
+"""Op library + Tensor method patching.
+
+The analog of the reference's `python/paddle/tensor/` method library plus
+`varbase_patch_methods`: math/manipulation/random ops are defined as module
+functions and attached to Tensor here, so `x.sum()`, `x + y`, `x[idx]` all
+route through the same autograd dispatch.
+"""
+from . import manipulation, math, random  # noqa: F401
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .random import rand, randn, randint, randperm, normal, uniform, bernoulli, multinomial  # noqa: F401
+
+from ..core.tensor import Tensor
+
+
+def _patch_tensor():
+    T = Tensor
+
+    # arithmetic dunders
+    T.__add__ = lambda self, o: math.add(self, o)
+    T.__radd__ = lambda self, o: math.add(o, self)
+    T.__sub__ = lambda self, o: math.subtract(self, o)
+    T.__rsub__ = lambda self, o: math.subtract(o, self)
+    T.__mul__ = lambda self, o: math.multiply(self, o)
+    T.__rmul__ = lambda self, o: math.multiply(o, self)
+    T.__truediv__ = lambda self, o: math.divide(self, o)
+    T.__rtruediv__ = lambda self, o: math.divide(o, self)
+    T.__floordiv__ = lambda self, o: math.floor_divide(self, o)
+    T.__mod__ = lambda self, o: math.mod(self, o)
+    T.__pow__ = lambda self, o: math.pow(self, o)
+    T.__rpow__ = lambda self, o: math.pow(o, self)
+    T.__neg__ = lambda self: math.neg(self)
+    T.__abs__ = lambda self: math.abs(self)
+    T.__matmul__ = lambda self, o: math.matmul(self, o)
+
+    # comparisons
+    T.__eq__ = lambda self, o: math.equal(self, o)
+    T.__ne__ = lambda self, o: math.not_equal(self, o)
+    T.__lt__ = lambda self, o: math.less_than(self, o)
+    T.__le__ = lambda self, o: math.less_equal(self, o)
+    T.__gt__ = lambda self, o: math.greater_than(self, o)
+    T.__ge__ = lambda self, o: math.greater_equal(self, o)
+    T.__invert__ = lambda self: math.logical_not(self)
+
+    # indexing
+    T.__getitem__ = lambda self, idx: manipulation.getitem(self, idx)
+    T.__setitem__ = lambda self, idx, v: manipulation.setitem(self, idx, v)
+
+    # methods (paddle Tensor API)
+    for name in [
+        "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square",
+        "abs", "sign", "reciprocal", "floor", "ceil", "round", "sin", "cos",
+        "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "erf", "clip",
+        "add", "subtract", "multiply", "divide", "mod", "pow", "maximum",
+        "minimum", "sum", "mean", "max", "min", "prod", "std", "var",
+        "logsumexp", "all", "any", "argmax", "argmin", "argsort", "sort",
+        "topk", "cumsum", "cumprod", "matmul", "dot", "bmm", "mm", "norm",
+        "cast", "isnan", "isinf", "isfinite", "allclose", "equal_all",
+    ]:
+        setattr(T, name, _make_method(getattr(math, name)))
+
+    for name in [
+        "reshape", "flatten", "transpose", "squeeze", "unsqueeze", "tile",
+        "expand", "expand_as", "broadcast_to", "flip", "roll", "gather",
+        "gather_nd", "split", "chunk", "unstack", "slice", "strided_slice",
+        "index_select", "masked_select", "masked_fill", "unique", "numel",
+        "take_along_axis", "put_along_axis", "repeat_interleave", "moveaxis",
+    ]:
+        setattr(T, name, _make_method(getattr(manipulation, name)))
+
+    T.astype = lambda self, dtype: math.cast(self, dtype)
+    T.t = lambda self: math.t(self)
+    T.T = property(lambda self: math.t(self))
+    T.item = Tensor.item  # keep original
+    T.scale = lambda self, scale=1.0, bias=0.0: math.scale(self, scale, bias)
+    T.add_ = _make_inplace(math.add)
+    T.subtract_ = _make_inplace(math.subtract)
+    T.multiply_ = _make_inplace(math.multiply)
+    T.scale_ = _make_inplace(math.scale)
+    T.clip_ = _make_inplace(math.clip)
+    T.zero_ = lambda self: (self.set_value(
+        __import__("jax.numpy", fromlist=["zeros_like"]).zeros_like(self._value)), self)[1]
+    T.fill_ = lambda self, v: (self.set_value(
+        __import__("jax.numpy", fromlist=["full_like"]).full_like(self._value, v)), self)[1]
+
+
+def _make_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    method.__name__ = fn.__name__
+    return method
+
+
+def _make_inplace(fn):
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._value = out._value
+        self._tape_node = out._tape_node
+        self._tape_index = out._tape_index
+        self.stop_gradient = out.stop_gradient
+        return self
+    method.__name__ = fn.__name__ + "_"
+    return method
+
+
+_patch_tensor()

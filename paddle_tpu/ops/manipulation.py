@@ -1,0 +1,319 @@
+"""Shape / indexing / combination ops.
+
+Replaces the reference's reshape/transpose/concat/split/gather/scatter op files
+under `paddle/fluid/operators/` with jnp lowerings. All shapes are static under
+jit (XLA requirement); dynamic-shape reference ops (LoD) are handled by
+padding/bucketing at the io layer instead.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op, call_op_nograd, unwrap
+from ..core.tensor import Tensor
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s) for s in shape]
+
+
+def reshape(x, shape):
+    return call_op(jnp.reshape, x, tuple(_shape_list(shape)), op_name="reshape")
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    def _flatten(v):
+        nd = v.ndim
+        s = start_axis if start_axis >= 0 else nd + start_axis
+        e = stop_axis if stop_axis >= 0 else nd + stop_axis
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new_shape)
+    return call_op(_flatten, x, op_name="flatten")
+
+
+def transpose(x, perm=None):
+    return call_op(jnp.transpose, x, axes=None if perm is None else tuple(perm),
+                   op_name="transpose")
+
+
+def moveaxis(x, source, destination):
+    return call_op(jnp.moveaxis, x, source, destination, op_name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1):
+    return call_op(jnp.swapaxes, x, axis0, axis1, op_name="swapaxes")
+
+
+def squeeze(x, axis=None):
+    def _squeeze(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return call_op(_squeeze, x, op_name="squeeze")
+
+
+def unsqueeze(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return call_op(jnp.expand_dims, x, axis=tuple(axes), op_name="unsqueeze")
+
+
+def concat(xs, axis=0):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return call_op(lambda *vs: jnp.concatenate(vs, axis=axis), *xs,
+                   op_name="concat")
+
+
+def stack(xs, axis=0):
+    return call_op(lambda *vs: jnp.stack(vs, axis=axis), *xs, op_name="stack")
+
+
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else jnp.shape(unwrap(x))[axis]
+    def _unstack(v):
+        return tuple(jnp.squeeze(p, axis=axis)
+                     for p in jnp.split(v, n, axis=axis))
+    out = call_op(_unstack, x, op_name="unstack")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+
+    def _split(v):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=axis))
+        sections = list(num_or_sections)
+        total = v.shape[axis]
+        if any(s == -1 for s in sections):
+            known = sum(s for s in sections if s != -1)
+            sections = [total - known if s == -1 else s for s in sections]
+        offsets = np.cumsum(sections)[:-1].tolist()
+        return tuple(jnp.split(v, offsets, axis=axis))
+
+    out = call_op(_split, x, op_name="split")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times):
+    return call_op(jnp.tile, x, tuple(_shape_list(repeat_times)), op_name="tile")
+
+
+def expand(x, shape):
+    target = _shape_list(shape)
+
+    def _expand(v):
+        tgt = list(target)
+        # paddle allows -1 meaning "keep this dim"
+        off = len(tgt) - v.ndim
+        for i, s in enumerate(tgt):
+            if s == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tuple(tgt))
+    return call_op(_expand, x, op_name="expand")
+
+
+def expand_as(x, y):
+    return call_op(lambda v, w: jnp.broadcast_to(v, w.shape), x, unwrap(y),
+                   op_name="expand_as")
+
+
+def broadcast_to(x, shape):
+    return call_op(jnp.broadcast_to, x, tuple(_shape_list(shape)),
+                   op_name="broadcast_to")
+
+
+def flip(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return call_op(jnp.flip, x, axis=tuple(axes), op_name="flip")
+
+
+def roll(x, shifts, axis=None):
+    return call_op(jnp.roll, x, shifts, axis=axis, op_name="roll")
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    def _slice(v):
+        slicer = [jnp.s_[:]] * v.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            slicer[ax] = jnp.s_[st:en]
+        return v[tuple(slicer)]
+    return call_op(_slice, x, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    def _ss(v):
+        slicer = [jnp.s_[:]] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            slicer[ax] = jnp.s_[st:en:sd]
+        return v[tuple(slicer)]
+    return call_op(_ss, x, op_name="strided_slice")
+
+
+def gather(x, index, axis=0):
+    return call_op(lambda v, i: jnp.take(v, i, axis=axis), x, unwrap(index),
+                   op_name="gather")
+
+
+def gather_nd(x, index):
+    def _gather_nd(v, idx):
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+    return call_op(_gather_nd, x, unwrap(index), op_name="gather_nd")
+
+
+def take_along_axis(x, indices, axis):
+    return call_op(lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                   x, unwrap(indices), op_name="take_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True):
+    def _scatter(v, u, i):
+        if overwrite:
+            return v.at[i].set(u)
+        return v.at[i].add(u)
+    return call_op(_scatter, x, updates, unwrap(index), op_name="scatter")
+
+
+def scatter_nd_add(x, index, updates):
+    def _snd(v, u, i):
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return call_op(_snd, x, updates, unwrap(index), op_name="scatter_nd_add")
+
+
+def put_along_axis(x, indices, values, axis):
+    def _put(v, u, i):
+        return jnp.put_along_axis(v, i, u, axis=axis, inplace=False)
+    return call_op(_put, x, values, unwrap(indices), op_name="put_along_axis")
+
+
+def index_select(x, index, axis=0):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index):
+    def _is(v, i):
+        return jnp.take_along_axis(v, i, axis=1)
+    return call_op(_is, x, unwrap(index), op_name="index_sample")
+
+
+def masked_select(x, mask):
+    arr = np.asarray(unwrap(x))
+    m = np.asarray(unwrap(mask))
+    return Tensor(arr[m])
+
+
+def masked_fill(x, mask, value):
+    return call_op(lambda v, m: jnp.where(m, jnp.asarray(value, v.dtype), v),
+                   x, unwrap(mask), op_name="masked_fill")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
+    def _pad(v):
+        p = list(pad)
+        if len(p) == v.ndim * 2:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(v.ndim)]
+        else:
+            # paddle semantics: pad applies to the last len(pad)//2 dims,
+            # given innermost-last ordering (like torch.nn.functional.pad)
+            n = len(p) // 2
+            width = [(0, 0)] * (v.ndim - n)
+            trailing = [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+            if data_format in ("NCHW", "NCL", "NCDHW") and len(p) in (2, 4, 6):
+                width = [(0, 0)] * (v.ndim - n) + trailing
+            else:
+                width = [(0, 0)] * (v.ndim - n) + trailing
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, width, mode=jmode, constant_values=value)
+        return jnp.pad(v, width, mode=jmode)
+    return call_op(_pad, x, op_name="pad")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(unwrap(x))
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if isinstance(res, tuple):
+        return tuple(Tensor(r) for r in res)
+    return Tensor(res)
+
+
+def assign(x, output=None):
+    val = unwrap(x)
+    if output is None:
+        return call_op(lambda v: v + 0 if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) else jnp.asarray(v), x, op_name="assign")
+    output.set_value(val)
+    return output
+
+
+def numel(x):
+    return Tensor(np.asarray(int(np.prod(jnp.shape(unwrap(x)), dtype=np.int64))))
+
+
+def shape(x):
+    return Tensor(np.asarray(jnp.shape(unwrap(x)), dtype=np.int64))
+
+
+def meshgrid(*xs):
+    out = call_op(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *xs,
+                  op_name="meshgrid")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return call_op(lambda v: jnp.repeat(v, repeats, axis=axis), x,
+                   op_name="repeat_interleave")
+
+
+def one_hot(x, num_classes):
+    return call_op_nograd(
+        lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32), x)
+
+
+def getitem(x, idx):
+    """Tensor.__getitem__ with differentiable basic+advanced indexing."""
+    def _conv(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+
+    if isinstance(idx, tuple):
+        jidx = tuple(_conv(i) for i in idx)
+    else:
+        jidx = _conv(idx)
+    return call_op(lambda v: v[jidx], x, op_name="getitem")
+
+
+def setitem(x, idx, value):
+    """Functional __setitem__: rebind x's value to the updated array."""
+    def _conv(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+
+    if isinstance(idx, tuple):
+        jidx = tuple(_conv(i) for i in idx)
+    else:
+        jidx = _conv(idx)
+    out = call_op(lambda v, u: v.at[jidx].set(u.astype(v.dtype) if hasattr(u, "astype") else u),
+                  x, value, op_name="setitem")
+    x._value = out._value
+    x._tape_node = out._tape_node
+    x._tape_index = out._tape_index
+    x.stop_gradient = out.stop_gradient
+    return x

@@ -1,0 +1,516 @@
+"""Math / elementwise / reduction / linalg ops.
+
+TPU-native replacement for the reference's dense op library
+(`paddle/fluid/operators/*_op.cc`, `elementwise/`, `reduce_ops/`,
+`operators/math/blas.h`): every op is a pure jnp lowering — XLA is the kernel
+library, fusion comes from the compiler rather than hand-written CUDA.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import call_op, call_op_nograd, unwrap
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype
+
+# ---------------------------------------------------------------- creation
+
+def to_value(x):
+    return unwrap(x)
+
+
+def full(shape, fill_value, dtype="float32"):
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in shape.numpy()]
+    if isinstance(shape, int):
+        shape = [shape]
+    return Tensor(jnp.full(tuple(shape), unwrap(fill_value), dtype=convert_dtype(dtype)))
+
+
+def zeros(shape, dtype="float32"):
+    return full(shape, 0, dtype)
+
+
+def ones(shape, dtype="float32"):
+    return full(shape, 1, dtype)
+
+
+def zeros_like(x, dtype=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    return Tensor(jnp.full_like(unwrap(x), fill_value, dtype=convert_dtype(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype="float32"):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(num),
+                               dtype=convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype)))
+
+
+def tril(x, diagonal=0):
+    return call_op(jnp.tril, x, k=diagonal, op_name="tril")
+
+
+def triu(x, diagonal=0):
+    return call_op(jnp.triu, x, k=diagonal, op_name="triu")
+
+
+def diag(x, offset=0):
+    return call_op(jnp.diag, x, k=offset, op_name="diag")
+
+
+# ------------------------------------------------------------- elementwise
+
+def _unary(fn, x, name):
+    return call_op(fn, x, op_name=name)
+
+
+def exp(x):
+    return _unary(jnp.exp, x, "exp")
+
+
+def log(x):
+    return _unary(jnp.log, x, "log")
+
+
+def log2(x):
+    return _unary(jnp.log2, x, "log2")
+
+
+def log10(x):
+    return _unary(jnp.log10, x, "log10")
+
+
+def log1p(x):
+    return _unary(jnp.log1p, x, "log1p")
+
+
+def sqrt(x):
+    return _unary(jnp.sqrt, x, "sqrt")
+
+
+def rsqrt(x):
+    return _unary(jax.lax.rsqrt, x, "rsqrt")
+
+
+def square(x):
+    return _unary(jnp.square, x, "square")
+
+
+def abs(x):  # noqa: A001 - paddle API name
+    return _unary(jnp.abs, x, "abs")
+
+
+def sign(x):
+    return _unary(jnp.sign, x, "sign")
+
+
+def neg(x):
+    return _unary(jnp.negative, x, "neg")
+
+
+def reciprocal(x):
+    return _unary(jnp.reciprocal, x, "reciprocal")
+
+
+def floor(x):
+    return _unary(jnp.floor, x, "floor")
+
+
+def ceil(x):
+    return _unary(jnp.ceil, x, "ceil")
+
+
+def round(x):  # noqa: A001
+    return _unary(jnp.round, x, "round")
+
+
+def sin(x):
+    return _unary(jnp.sin, x, "sin")
+
+
+def cos(x):
+    return _unary(jnp.cos, x, "cos")
+
+
+def tan(x):
+    return _unary(jnp.tan, x, "tan")
+
+
+def asin(x):
+    return _unary(jnp.arcsin, x, "asin")
+
+
+def acos(x):
+    return _unary(jnp.arccos, x, "acos")
+
+
+def atan(x):
+    return _unary(jnp.arctan, x, "atan")
+
+
+def sinh(x):
+    return _unary(jnp.sinh, x, "sinh")
+
+
+def cosh(x):
+    return _unary(jnp.cosh, x, "cosh")
+
+
+def tanh(x):
+    return _unary(jnp.tanh, x, "tanh")
+
+
+def erf(x):
+    return _unary(jax.scipy.special.erf, x, "erf")
+
+
+def expm1(x):
+    return _unary(jnp.expm1, x, "expm1")
+
+
+def logit(x, eps=None):
+    def _logit(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+    return call_op(_logit, x, op_name="logit")
+
+
+def isnan(x):
+    return call_op_nograd(jnp.isnan, x)
+
+
+def isinf(x):
+    return call_op_nograd(jnp.isinf, x)
+
+
+def isfinite(x):
+    return call_op_nograd(jnp.isfinite, x)
+
+
+def clip(x, min=None, max=None):  # noqa: A002
+    return call_op(jnp.clip, x, min=unwrap(min), max=unwrap(max), op_name="clip")
+
+
+# ------------------------------------------------------------------ binary
+
+def add(x, y):
+    return call_op(jnp.add, x, y, op_name="add")
+
+
+def subtract(x, y):
+    return call_op(jnp.subtract, x, y, op_name="subtract")
+
+
+def multiply(x, y):
+    return call_op(jnp.multiply, x, y, op_name="multiply")
+
+
+def divide(x, y):
+    return call_op(jnp.divide, x, y, op_name="divide")
+
+
+def floor_divide(x, y):
+    return call_op_nograd(jnp.floor_divide, x, y)
+
+
+def mod(x, y):
+    return call_op(jnp.mod, x, y, op_name="mod")
+
+
+def pow(x, y):  # noqa: A001
+    return call_op(jnp.power, x, y, op_name="pow")
+
+
+def maximum(x, y):
+    return call_op(jnp.maximum, x, y, op_name="maximum")
+
+
+def minimum(x, y):
+    return call_op(jnp.minimum, x, y, op_name="minimum")
+
+
+def atan2(x, y):
+    return call_op(jnp.arctan2, x, y, op_name="atan2")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    def _scale(v, s, b):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+    out = call_op(_scale, x, unwrap(scale), unwrap(bias), op_name="scale")
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+# -------------------------------------------------------------- comparison
+
+def equal(x, y):
+    return call_op_nograd(jnp.equal, x, y)
+
+
+def not_equal(x, y):
+    return call_op_nograd(jnp.not_equal, x, y)
+
+
+def greater_than(x, y):
+    return call_op_nograd(jnp.greater, x, y)
+
+
+def greater_equal(x, y):
+    return call_op_nograd(jnp.greater_equal, x, y)
+
+
+def less_than(x, y):
+    return call_op_nograd(jnp.less, x, y)
+
+
+def less_equal(x, y):
+    return call_op_nograd(jnp.less_equal, x, y)
+
+
+def logical_and(x, y):
+    return call_op_nograd(jnp.logical_and, x, y)
+
+
+def logical_or(x, y):
+    return call_op_nograd(jnp.logical_or, x, y)
+
+
+def logical_not(x):
+    return call_op_nograd(jnp.logical_not, x)
+
+
+def logical_xor(x, y):
+    return call_op_nograd(jnp.logical_xor, x, y)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return call_op_nograd(jnp.allclose, x, y, rtol=rtol, atol=atol,
+                          equal_nan=equal_nan)
+
+
+def equal_all(x, y):
+    return call_op_nograd(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return call_op(lambda c, a, b: jnp.where(c, a, b), unwrap(condition), x, y,
+                   op_name="where")
+
+
+def nonzero(x, as_tuple=False):
+    import numpy as np
+    arr = np.asarray(unwrap(x))
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(i) for i in idx)
+    return Tensor(np.stack(idx, axis=-1))
+
+
+# -------------------------------------------------------------- reductions
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    return call_op(jnp.sum, x, axis=_norm_axis(axis),
+                   dtype=convert_dtype(dtype), keepdims=keepdim, op_name="sum")
+
+
+def mean(x, axis=None, keepdim=False):
+    return call_op(jnp.mean, x, axis=_norm_axis(axis), keepdims=keepdim,
+                   op_name="mean")
+
+
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return call_op(jnp.max, x, axis=_norm_axis(axis), keepdims=keepdim,
+                   op_name="max")
+
+
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return call_op(jnp.min, x, axis=_norm_axis(axis), keepdims=keepdim,
+                   op_name="min")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return call_op(jnp.prod, x, axis=_norm_axis(axis), keepdims=keepdim,
+                   dtype=convert_dtype(dtype), op_name="prod")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return call_op(jnp.std, x, axis=_norm_axis(axis),
+                   ddof=1 if unbiased else 0, keepdims=keepdim, op_name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return call_op(jnp.var, x, axis=_norm_axis(axis),
+                   ddof=1 if unbiased else 0, keepdims=keepdim, op_name="var")
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return call_op(jax.scipy.special.logsumexp, x, axis=_norm_axis(axis),
+                   keepdims=keepdim, op_name="logsumexp")
+
+
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return call_op_nograd(jnp.all, x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return call_op_nograd(jnp.any, x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return call_op_nograd(jnp.argmax, x, axis=axis, keepdims=keepdim).astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return call_op_nograd(jnp.argmin, x, axis=axis, keepdims=keepdim).astype(dtype)
+
+
+def argsort(x, axis=-1, descending=False):
+    def _argsort(v):
+        idx = jnp.argsort(v, axis=axis)
+        return jnp.flip(idx, axis=axis) if descending else idx
+    return call_op_nograd(_argsort, x)
+
+
+def sort(x, axis=-1, descending=False):
+    def _sort(v):
+        out = jnp.sort(v, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+    return call_op(_sort, x, op_name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    """Composite: indices in a non-diff pass, values gathered differentiably."""
+    v = unwrap(x)
+    ax = axis if axis >= 0 else v.ndim + axis
+
+    def _indices(val):
+        if not largest:
+            val = -val
+        moved = jnp.moveaxis(val, ax, -1)
+        _, idx = jax.lax.top_k(moved, k)
+        return jnp.moveaxis(idx, -1, ax)
+
+    idx = call_op_nograd(_indices, x)
+
+    def _gather(val, i):
+        return jnp.take_along_axis(val, i, axis=ax)
+
+    values = call_op(_gather, x, unwrap(idx), op_name="topk_gather")
+    return values, idx.astype("int64")
+
+
+def cumsum(x, axis=None, dtype=None):
+    def _cs(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=convert_dtype(dtype))
+        return jnp.cumsum(v, axis=axis, dtype=convert_dtype(dtype))
+    return call_op(_cs, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None):
+    def _cp(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=convert_dtype(dtype))
+        return jnp.cumprod(v, axis=dim, dtype=convert_dtype(dtype))
+    return call_op(_cp, x, op_name="cumprod")
+
+
+# ------------------------------------------------------------------ linalg
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    def _mm(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return call_op(_mm, x, y, op_name="matmul")
+
+
+def dot(x, y):
+    def _dot(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return call_op(_dot, x, y, op_name="dot")
+
+
+def bmm(x, y):
+    return call_op(jnp.matmul, x, y, op_name="bmm")
+
+
+def mm(x, y):
+    return call_op(jnp.matmul, x, y, op_name="mm")
+
+
+def t(x):
+    return call_op(lambda v: v.T, x, op_name="t")
+
+
+def norm(x, p=2, axis=None, keepdim=False):
+    def _norm(v):
+        if p == "fro" or p == 2:
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=_norm_axis(axis),
+                                    keepdims=keepdim))
+        if p == 1:
+            return jnp.sum(jnp.abs(v), axis=_norm_axis(axis), keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=_norm_axis(axis), keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(v), p), axis=_norm_axis(axis),
+                    keepdims=keepdim), 1.0 / p)
+    return call_op(_norm, x, op_name="norm")
+
+
+def einsum(equation, *operands):
+    return call_op(lambda *ops: jnp.einsum(equation, *ops), *operands,
+                   op_name="einsum")
+
+
+def multiply_sum(x, y):  # helper used by some losses
+    return sum(multiply(x, y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return call_op(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                   input, x, y, op_name="addmm")
+
+
+def cast(x, dtype):
+    dt = convert_dtype(dtype)
+
+    def _cast(v):
+        return v.astype(dt)
+
+    from ..core.dtype import is_floating
+    if is_floating(dt) and isinstance(x, Tensor) and is_floating(x.dtype):
+        return call_op(_cast, x, op_name="cast")
+    return call_op_nograd(_cast, x)

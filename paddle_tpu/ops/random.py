@@ -1,0 +1,82 @@
+"""Random ops over the functional key state (see core/random.py).
+
+Replaces the reference's curand-backed samplers (`operators/uniform_random_op.cu`
+etc.) with threefry; every draw advances the registered key tensor, so traced
+training steps are deterministic and reproducible given `paddle_tpu.seed`.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core import random as core_random
+from ..core.dispatch import unwrap
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def rand(shape, dtype="float32"):
+    key = core_random.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=convert_dtype(dtype)))
+
+
+def randn(shape, dtype="float32"):
+    key = core_random.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), dtype=convert_dtype(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    key = core_random.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape)) * std + mean)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):  # noqa: A002
+    key = core_random.next_key() if seed == 0 else jax.random.PRNGKey(seed)
+    return Tensor(jax.random.uniform(key, _shape(shape),
+                                     dtype=convert_dtype(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    key = core_random.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high,
+                                     dtype=convert_dtype(dtype)))
+
+
+def randperm(n, dtype="int64"):
+    key = core_random.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(convert_dtype(dtype)))
+
+
+def shuffle(x, axis=0):
+    key = core_random.next_key()
+    return Tensor(jax.random.permutation(key, unwrap(x), axis=axis,
+                                         independent=False))
+
+
+def bernoulli(x):
+    key = core_random.next_key()
+    p = unwrap(x)
+    return Tensor(jax.random.bernoulli(key, p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    key = core_random.next_key()
+    p = unwrap(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*p.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, p.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))

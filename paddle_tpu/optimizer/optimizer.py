@@ -1,0 +1,443 @@
+"""Optimizers.
+
+Reference: `python/paddle/optimizer/` (2.x rewrite of fluid/optimizer.py:59
+family) and the device kernels in `operators/optimizers/` (sgd_op, momentum_op,
+adam_op, lamb_op...). Here each optimizer's update is pure jnp on the raw
+param/accumulator values: eager mode applies it per step; under `to_static`
+the whole update fuses into the compiled training step with donated buffers
+(the XLA answer to the reference's in-place param updates).
+
+Accumulators are created eagerly at construction so they are registered
+framework state before any tracing happens.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradBase
+
+
+class _LRValue:
+    """Learning rate as a stateful scalar tensor: scheduler updates don't
+    retrace the compiled step (the analog of the reference's lr var in scope)."""
+
+    def __init__(self, lr):
+        from .lr import LRScheduler
+        self.scheduler = None
+        if isinstance(lr, LRScheduler):
+            self.scheduler = lr
+            lr_value = lr.get_lr()
+        else:
+            lr_value = float(lr)
+        self.tensor = Tensor(jnp.asarray(lr_value, jnp.float32))
+        self.tensor.persistable = True
+        self.tensor._mark_stateful()
+        if self.scheduler is not None:
+            self.scheduler._bind(self)
+
+    def value(self):
+        return self.tensor._value
+
+    def set(self, v):
+        self.tensor.set_value(jnp.asarray(v, jnp.float32))
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            # static-graph style: parameters resolved at minimize() time from
+            # the current Program (reference: fluid Optimizer.minimize)
+            parameters = []
+        parameters = list(parameters)
+        if parameters and isinstance(parameters[0], dict):
+            self._param_groups = []
+            for g in parameters:
+                group = dict(g)
+                group["params"] = list(g["params"])
+                self._param_groups.append(group)
+        else:
+            self._param_groups = [{"params": parameters}]
+        self._lr = _LRValue(learning_rate)
+        self._weight_decay = self._wd_value(weight_decay)
+        self._grad_clip = grad_clip
+        assert grad_clip is None or isinstance(grad_clip, ClipGradBase)
+        self._accumulators = {}  # (slot, param_id) -> Tensor
+        self._step_count = Tensor(jnp.zeros((), jnp.int32))
+        self._step_count._mark_stateful()
+        for group in self._param_groups:
+            for p in group["params"]:
+                self._create_accumulators(p)
+
+    @staticmethod
+    def _wd_value(weight_decay):
+        from ..regularizer import L2Decay, L1Decay
+        if weight_decay is None:
+            return 0.0
+        if isinstance(weight_decay, (L2Decay, L1Decay)):
+            return weight_decay
+        return float(weight_decay)
+
+    # -- accumulator management ------------------------------------------
+    def _add_accumulator(self, slot, param, fill=0.0, dtype=None):
+        key = (slot, id(param))
+        if key not in self._accumulators:
+            t = Tensor(jnp.full(param._value.shape, fill,
+                                dtype or jnp.float32))
+            t.persistable = True
+            t._mark_stateful()
+            self._accumulators[key] = t
+        return self._accumulators[key]
+
+    def _get_accumulator(self, slot, param):
+        return self._accumulators[(slot, id(param))]
+
+    def _create_accumulators(self, param):
+        pass  # subclasses pre-create slots here
+
+    # -- API --------------------------------------------------------------
+    def get_lr(self):
+        return float(self._lr.value())
+
+    def set_lr(self, value):
+        self._lr.set(value)
+
+    def _parameters(self):
+        for group in self._param_groups:
+            yield from group["params"]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameters():
+            p._grad = None
+
+    clear_gradients = clear_grad
+
+    def _decayed_grad(self, p, g):
+        """Apply L2/L1 'regularizer-style' decay into the gradient (the
+        reference's regularizer path; AdamW-style decoupled decay overrides)."""
+        from ..regularizer import L1Decay, L2Decay
+        wd = self._weight_decay
+        reg = getattr(p, "regularizer", None) or wd
+        if isinstance(reg, L2Decay):
+            return g + reg.coeff * p._value
+        if isinstance(reg, L1Decay):
+            return g + reg.coeff * jnp.sign(p._value)
+        if isinstance(reg, float) and reg != 0.0:
+            return g + reg * p._value
+        return g
+
+    def step(self):
+        params_grads = [(p, p._grad) for p in self._parameters()
+                        if not p.stop_gradient and p._grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(
+                [(p, g) for p, g in params_grads])
+        self._step_count._value = self._step_count._value + 1
+        lr = self._lr.value()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            g = g.astype(jnp.float32) if g.dtype == jnp.bfloat16 else g
+            plr = lr * p.__dict__.get("optimize_attr", {}).get("learning_rate", 1.0)
+            new_val = self._apply_one(p, g, plr)
+            p._value = new_val.astype(p._value.dtype)
+
+    minimize_step = step
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..core.dispatch import _STATIC_HOOK
+        if _STATIC_HOOK[0] is not None:
+            from ..static import program as prog_mod
+            prog = prog_mod.default_main_program()
+            # adopt the program's trainable parameters
+            from ..core.tensor import Parameter as _Param
+            train_params = [p for p in prog.params.values()
+                            if isinstance(p, _Param) and not p.stop_gradient]
+            known = {id(p) for p in self._parameters()}
+            fresh = [p for p in train_params if id(p) not in known]
+            if fresh:
+                self._param_groups.append({"params": fresh})
+                for p in fresh:
+                    self._create_accumulators(p)
+            prog._optimizer = self
+            prog._loss_slot = prog._slot_of(loss, create=False)
+            return None, None
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def _apply_one(self, p, g, lr):
+        raise NotImplementedError
+
+    def state_dict(self):
+        out = {}
+        for (slot, pid), t in self._accumulators.items():
+            # keyed by param name for portability
+            for p in self._parameters():
+                if id(p) == pid:
+                    out[f"{p.name}.{slot}"] = t
+                    break
+        out["@step"] = self._step_count
+        out["@lr"] = self._lr.tensor
+        if self._lr.scheduler is not None:
+            out["LR_Scheduler"] = self._lr.scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        name_to_key = {}
+        for (slot, pid), t in self._accumulators.items():
+            for p in self._parameters():
+                if id(p) == pid:
+                    name_to_key[f"{p.name}.{slot}"] = (slot, pid)
+        for k, v in state.items():
+            if k == "@step":
+                self._step_count.set_value(v.numpy() if hasattr(v, "numpy") else v)
+            elif k == "@lr":
+                self._lr.set(v.numpy() if hasattr(v, "numpy") else v)
+            elif k == "LR_Scheduler" and self._lr.scheduler is not None:
+                self._lr.scheduler.set_state_dict(v)
+            elif k in name_to_key:
+                t = self._accumulators[name_to_key[k]]
+                t.set_value(v.numpy() if hasattr(v, "numpy") else v)
+
+
+class SGD(Optimizer):
+    """reference: operators/optimizers/sgd_op.cc"""
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        return p._value - lr * g
+
+
+class Momentum(Optimizer):
+    """reference: operators/optimizers/momentum_op.h"""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("velocity", param)
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        v = self._get_accumulator("velocity", p)
+        new_v = self._momentum * v._value + g
+        v._value = new_v
+        if self._nesterov:
+            return p._value - lr * (g + self._momentum * new_v)
+        return p._value - lr * new_v
+
+
+class Adam(Optimizer):
+    """reference: operators/optimizers/adam_op.h (beta-power accumulators and
+    all) — the pow-correction is folded analytically instead of stored."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment1", param)
+        self._add_accumulator("moment2", param)
+
+    def _bias_corrected_lr(self, lr):
+        t = self._step_count._value.astype(jnp.float32)
+        return lr * jnp.sqrt(1.0 - self._beta2 ** t) / (1.0 - self._beta1 ** t)
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g
+        new_v = self._beta2 * v._value + (1 - self._beta2) * jnp.square(g)
+        m._value, v._value = new_m, new_v
+        lr_t = self._bias_corrected_lr(lr)
+        return p._value - lr_t * new_m / (jnp.sqrt(new_v) + self._eps)
+
+
+class AdamW(Adam):
+    """reference: python/paddle/optimizer/adamw.py — decoupled weight decay."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, apply_decay_param_fun=None,
+                 multi_precision=False, lazy_mode=False, name=None):
+        self._coeff = (weight_decay if isinstance(weight_decay, float)
+                       else getattr(weight_decay, "coeff", 0.01))
+        self._decay_fn = apply_decay_param_fun
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+
+    def _apply_one(self, p, g, lr):
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g
+        new_v = self._beta2 * v._value + (1 - self._beta2) * jnp.square(g)
+        m._value, v._value = new_m, new_v
+        lr_t = self._bias_corrected_lr(lr)
+        out = p._value - lr_t * new_m / (jnp.sqrt(new_v) + self._eps)
+        if self._decay_fn is None or self._decay_fn(p.name):
+            out = out - lr * self._coeff * p._value
+        return out
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment", param, fill=self._init_acc)
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        acc = self._get_accumulator("moment", p)
+        new_acc = acc._value + jnp.square(g)
+        acc._value = new_acc
+        return p._value - lr * g / (jnp.sqrt(new_acc) + self._eps)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("mean_square", param)
+        self._add_accumulator("momentum", param)
+        if self._centered:
+            self._add_accumulator("mean_grad", param)
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        ms = self._get_accumulator("mean_square", p)
+        mom = self._get_accumulator("momentum", p)
+        new_ms = self._rho * ms._value + (1 - self._rho) * jnp.square(g)
+        ms._value = new_ms
+        denom = new_ms
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p)
+            new_mg = self._rho * mg._value + (1 - self._rho) * g
+            mg._value = new_mg
+            denom = new_ms - jnp.square(new_mg)
+        new_mom = (self._momentum * mom._value
+                   + lr * g / jnp.sqrt(denom + self._eps))
+        mom._value = new_mom
+        return p._value - new_mom
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        self._rho, self._eps = rho, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("avg_squared_grad", param)
+        self._add_accumulator("avg_squared_update", param)
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        asg = self._get_accumulator("avg_squared_grad", p)
+        asu = self._get_accumulator("avg_squared_update", p)
+        new_asg = self._rho * asg._value + (1 - self._rho) * jnp.square(g)
+        update = (jnp.sqrt(asu._value + self._eps)
+                  / jnp.sqrt(new_asg + self._eps)) * g
+        new_asu = self._rho * asu._value + (1 - self._rho) * jnp.square(update)
+        asg._value, asu._value = new_asg, new_asu
+        return p._value - lr * update
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment", param)
+        self._add_accumulator("inf_norm", param)
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m = self._get_accumulator("moment", p)
+        u = self._get_accumulator("inf_norm", p)
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g
+        new_u = jnp.maximum(self._beta2 * u._value, jnp.abs(g))
+        m._value, u._value = new_m, new_u
+        t = self._step_count._value.astype(jnp.float32)
+        lr_t = lr / (1.0 - self._beta1 ** t)
+        return p._value - lr_t * new_m / (new_u + self._eps)
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op.h + fleet lamb_optimizer.py."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, None, grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment1", param)
+        self._add_accumulator("moment2", param)
+
+    def _apply_one(self, p, g, lr):
+        m = self._get_accumulator("moment1", p)
+        v = self._get_accumulator("moment2", p)
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g
+        new_v = self._beta2 * v._value + (1 - self._beta2) * jnp.square(g)
+        m._value, v._value = new_m, new_v
+        t = self._step_count._value.astype(jnp.float32)
+        m_hat = new_m / (1.0 - self._beta1 ** t)
+        v_hat = new_v / (1.0 - self._beta2 ** t)
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps)
+        if self._exclude_fn is None or not self._exclude_fn(p):
+            r = r + self._lamb_wd * p._value
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p._value)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p._value - lr * ratio * r
+
+
+class Lars(Momentum):
+    """LARS (reference: operators/optimizers/lars_momentum_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, name=None):
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip)
+
+    def _apply_one(self, p, g, lr):
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p._value)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm
+            / (g_norm + self._lars_wd * w_norm + 1e-12), 1.0)
+        v = self._get_accumulator("velocity", p)
+        new_v = self._momentum * v._value + lr * local_lr * (
+            g + self._lars_wd * p._value)
+        v._value = new_v
+        return p._value - new_v

@@ -1,0 +1,49 @@
+"""paddle.save / paddle.load analog (reference: `python/paddle/framework/io.py`
+→ fluid/io.py:1840/1948). Pickle-compatible container with Tensors stored as
+numpy arrays.
+"""
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__paddle_tpu_tensor__": True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tpu_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", t.name)
+            return t
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **config):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy)

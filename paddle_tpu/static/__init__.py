@@ -1,0 +1,40 @@
+"""paddle_tpu.static — the static-graph front-end.
+
+The reference's Program/Executor machine (`python/paddle/fluid/framework.py`,
+`executor.py`) exists to hand a whole graph to a compiler; on TPU the
+whole-graph compiler *is* XLA, so `paddle.static` here is a thin veneer: a
+Program records a python callable built from `paddle.static.data`
+placeholders, and Executor.run jit-compiles it. The imperative+to_static path
+is the blessed one; this module exists for API parity so static-style user
+code ports over. (Full ProgramDesc IR with ops-as-protobuf is deliberately
+NOT rebuilt — see SURVEY.md §7 design stance.)
+"""
+from .program import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    data, Executor, global_scope, name_scope,
+)
+from ..jit.to_static import InputSpec  # noqa: F401
+from .. import nn as _nn  # re-export for paddle.static.nn style usage
+
+_STATIC_MODE = [False]
+
+
+def _enable_static(flag=True):
+    _STATIC_MODE[0] = flag
+
+
+def _static_mode():
+    return _STATIC_MODE[0]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None):
+    from ..jit.io import save as _jit_save
+    prog = program or default_main_program()
+    _jit_save(prog.as_layer(feed_vars, fetch_vars), path_prefix)
+
+
+def load_inference_model(path_prefix, executor):
+    from ..jit.io import load as _jit_load
+    layer = _jit_load(path_prefix)
+    return layer, None, None

@@ -1,0 +1,313 @@
+"""Static-graph Program + Executor.
+
+Reference: `python/paddle/fluid/framework.py` (Program:4017, Block:2522,
+Operator:1921) + `executor.py` (Executor:475) + `backward.py`
+(append_backward:1377). The TPU re-design: a Program is an op-list recorded
+through the same dispatch seam the imperative mode uses (each entry holds the
+pure jnp lowering + variable slots). Executor.run replays the list as a pure
+function of (feed, params) and jit-compiles it — the ProgramDesc→Executor
+pipeline collapses into trace→XLA. append_backward/minimize become
+jax.value_and_grad over the replayed function, matching the reference's
+semantics (grads+update ops live in the same program) without rebuilding a
+protobuf IR.
+"""
+import threading
+from contextlib import contextmanager
+
+import jax
+import numpy as np
+
+from ..core.dispatch import _STATIC_HOOK
+from ..core.tensor import Parameter, Tensor
+
+
+class _OpRecord:
+    __slots__ = ("fn", "arg_slots", "kwarg_slots", "out_slots", "name")
+
+    def __init__(self, fn, arg_slots, kwarg_slots, out_slots, name):
+        self.fn = fn
+        self.arg_slots = arg_slots
+        self.kwarg_slots = kwarg_slots
+        self.out_slots = out_slots
+        self.name = name
+
+
+class _Slot:
+    __slots__ = ("idx",)
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+class Program:
+    def __init__(self):
+        self.ops = []
+        self._tensor_slot = {}  # id(Tensor) -> slot idx
+        self._slot_count = 0
+        self._keepalive = []  # strong refs so id() stays valid
+        self.feed_vars = {}  # name -> (slot, shape, dtype)
+        self.params = {}  # slot -> Parameter
+        self._optimizer = None
+        self._loss_slot = None
+        self._compiled = {}
+        self.random_seed = None
+
+    # -- recording --------------------------------------------------------
+    def _slot_of(self, t, create=True):
+        key = id(t)
+        s = self._tensor_slot.get(key)
+        if s is None and create:
+            s = self._slot_count
+            self._slot_count += 1
+            self._tensor_slot[key] = s
+            self._keepalive.append(t)
+            if isinstance(t, Parameter):
+                self.params[s] = t
+            elif getattr(t, "persistable", False) or t._state_uid is not None:
+                self.params[s] = t  # buffers treated as inputs too
+        return s
+
+    def record(self, fn, args, kwargs, op_name):
+        arg_slots = []
+        in_vals = []
+        for a in args:
+            if isinstance(a, Tensor):
+                arg_slots.append(_Slot(self._slot_of(a)))
+                in_vals.append(a._value)
+            else:
+                arg_slots.append(a)
+                in_vals.append(a)
+        kw_slots = {}
+        kw_vals = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Tensor):
+                kw_slots[k] = _Slot(self._slot_of(v))
+                kw_vals[k] = v._value
+            else:
+                kw_slots[k] = v
+                kw_vals[k] = v
+        # build-time shape propagation: run eagerly on placeholder values
+        out = fn(*in_vals, **kw_vals)
+        outs = out if isinstance(out, tuple) else (out,)
+        out_tensors = []
+        out_slots = []
+        for o in outs:
+            t = Tensor(o)
+            out_slots.append(self._slot_of(t))
+            out_tensors.append(t)
+        self.ops.append(_OpRecord(fn, arg_slots, kw_slots, out_slots, op_name))
+        if len(out_tensors) == 1:
+            return out_tensors[0]
+        return tuple(out_tensors)
+
+    # -- replay -----------------------------------------------------------
+    def _replay(self, env):
+        for op in self.ops:
+            args = [env[a.idx] if isinstance(a, _Slot) else a
+                    for a in op.arg_slots]
+            kwargs = {k: (env[v.idx] if isinstance(v, _Slot) else v)
+                      for k, v in op.kwarg_slots.items()}
+            out = op.fn(*args, **kwargs)
+            outs = out if isinstance(out, tuple) else (out,)
+            for slot, o in zip(op.out_slots, outs):
+                env[slot] = o
+
+    def _pure(self, feed_slots, fetch_slots, param_slots, train=False):
+        """Returns fn(feed_vals, param_vals) -> (fetch_vals, new_param_vals)"""
+        def run(feed_vals, param_vals):
+            env = {}
+            for s, v in zip(feed_slots, feed_vals):
+                env[s] = v
+            for s, v in zip(param_slots, param_vals):
+                env[s] = v
+            self._replay(env)
+            return [env[s] for s in fetch_slots]
+        return run
+
+    def as_layer(self, feed_vars, fetch_vars):
+        """Wrap as a Layer for save_inference_model."""
+        prog = self
+
+        from ..nn.layer.layers import Layer
+
+        class _ProgLayer(Layer):
+            def forward(self, *inputs):
+                feed = {v.name: x for v, x in zip(feed_vars, inputs)}
+                outs = Executor().run(prog, feed=feed, fetch_list=fetch_vars)
+                return outs[0] if len(outs) == 1 else outs
+
+        return _ProgLayer()
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    # vars exposed for program-inspection tests (meta-optimizer test analog)
+    def op_names(self):
+        return [op.name for op in self.ops]
+
+
+_default_main = Program()
+_default_startup = Program()
+_tls = threading.local()
+
+
+def default_main_program():
+    return getattr(_tls, "main", None) or _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextmanager
+def program_guard(main_program, startup_program=None):
+    prev = getattr(_tls, "main", None)
+    _tls.main = main_program
+    _STATIC_HOOK[0] = main_program.record
+    try:
+        yield
+    finally:
+        _tls.main = prev
+        _STATIC_HOOK[0] = prev.record if prev is not None else None
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference: paddle.static.data). Dim None/-1 → 1 at
+    build; the executor re-specializes per concrete feed shape."""
+    from ..core.dtype import convert_dtype
+    build_shape = [1 if (s is None or s == -1) else int(s) for s in shape]
+    t = Tensor(np.zeros(build_shape, dtype=convert_dtype(dtype)))
+    t.name = name
+    prog = default_main_program()
+    slot = prog._slot_of(t)
+    prog.feed_vars[name] = (slot, tuple(s if s not in (None,) else -1 for s in shape), dtype)
+    return t
+
+
+def global_scope():
+    return None
+
+
+@contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+class Executor:
+    """reference: executor.py:475 — run(program, feed, fetch_list)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        prog = program or default_main_program()
+        if not prog.ops:  # startup program: params already initialized eagerly
+            return []
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        feed_names = sorted(feed.keys())
+        feed_slots = [prog.feed_vars[n][0] for n in feed_names]
+        feed_vals = [np.asarray(feed[n]) for n in feed_names]
+        fetch_slots = [prog._slot_of(v, create=False) for v in fetch_list]
+        param_slots = sorted(prog.params.keys())
+        param_vals = [prog.params[s]._value for s in param_slots]
+
+        opt = prog._optimizer
+        key = ("train" if opt else "infer",
+               tuple(feed_names), tuple(v.shape for v in feed_vals),
+               tuple(str(v.dtype) for v in feed_vals), tuple(fetch_slots))
+        compiled = prog._compiled.get(key)
+        if compiled is None:
+            pure = prog._pure(feed_slots, fetch_slots, param_slots)
+            if opt is not None:
+                compiled = self._build_train_step(prog, pure, param_slots,
+                                                  fetch_slots)
+            else:
+                compiled = jax.jit(lambda f, p: pure(f, p))
+            prog._compiled[key] = compiled
+
+        if opt is not None:
+            opt_tensors = self._opt_tensors(opt)
+            opt_vals = [t._value for t in opt_tensors]
+            fetched, new_params, new_opt = compiled(feed_vals, param_vals,
+                                                    opt_vals)
+            for s, v in zip(param_slots, new_params):
+                prog.params[s]._value = v
+            for t, v in zip(opt_tensors, new_opt):
+                t._value = v
+        else:
+            fetched = compiled(feed_vals, param_vals)
+        if return_numpy:
+            return [np.asarray(v) for v in fetched]
+        return [Tensor(v) for v in fetched]
+
+    @staticmethod
+    def _opt_tensors(opt):
+        """Optimizer state in deterministic order (accumulators, step, lr)."""
+        accs = [opt._accumulators[k] for k in sorted(opt._accumulators,
+                                                     key=lambda k: (k[0], k[1]))]
+        return accs + [opt._step_count, opt._lr.tensor]
+
+    def _build_train_step(self, prog, pure, param_slots, fetch_slots):
+        """Fuse forward+backward+update into one jitted step (the analog of
+        append_backward + optimizer ops living in the same ProgramDesc).
+        Optimizer state is swapped to tracers for the trace duration so the
+        eager `_apply_one` update formulas compile unchanged."""
+        opt = prog._optimizer
+        loss_slot = prog._loss_slot
+        train_slots = [s for s in param_slots
+                       if isinstance(prog.params[s], Parameter)
+                       and not prog.params[s].stop_gradient]
+        train_idx = [param_slots.index(s) for s in train_slots]
+        opt_tensors = self._opt_tensors(opt)
+
+        def loss_fn(train_vals, feed_vals, all_param_vals):
+            merged = list(all_param_vals)
+            for i, v in zip(train_idx, train_vals):
+                merged[i] = v
+            env = {}
+            feed_names = sorted(prog.feed_vars.keys())
+            for (name, fv) in zip(feed_names, feed_vals):
+                env[prog.feed_vars[name][0]] = fv
+            for s, v in zip(param_slots, merged):
+                env[s] = v
+            prog._replay(env)
+            loss = env[loss_slot]
+            fetched = [env[s] for s in fetch_slots]
+            return loss.sum(), fetched
+
+        def step(feed_vals, param_vals, opt_vals):
+            train_vals = [param_vals[i] for i in train_idx]
+            (_, fetched), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(train_vals, feed_vals, param_vals)
+            saved = [(t, t._value) for t in opt_tensors]
+            saved += [(prog.params[param_slots[i]],
+                       prog.params[param_slots[i]]._value) for i in train_idx]
+            try:
+                for t, v in zip(opt_tensors, opt_vals):
+                    t._value = v
+                opt._step_count._value = opt._step_count._value + 1
+                lr = opt._lr.value()
+                new_params = list(param_vals)
+                for i, g, v in zip(train_idx, grads, train_vals):
+                    p = prog.params[param_slots[i]]
+                    p._value = v
+                    new_params[i] = opt._apply_one(p, g, lr).astype(v.dtype)
+                new_opt = [t._value for t in opt_tensors]
+            finally:
+                for t, v in saved:
+                    t._value = v
+            return fetched, new_params, new_opt
+
+        return jax.jit(step)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Mark loss for the executor's fused value_and_grad pass."""
+    prog = default_main_program()
+    prog._loss_slot = prog._slot_of(loss, create=False)
+    return []

@@ -1,0 +1,90 @@
+"""Builtin datasets (reference: `python/paddle/vision/datasets/`).
+
+Zero-egress environment: when the real files are absent a deterministic
+synthetic fallback with the same shapes/label space is generated, so training
+pipelines and benchmarks run anywhere (clearly flagged via `.synthetic`).
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class MNIST(Dataset):
+    """reference: python/paddle/vision/datasets/mnist.py"""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        self.synthetic = True
+        n = 60000 if mode == "train" else 10000
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                    num, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), dtype=np.uint8)
+            self.synthetic = False
+        else:
+            rng = np.random.RandomState(42 if mode == "train" else 7)
+            n = min(n, 4096)  # keep the synthetic set small
+            self.labels = rng.randint(0, 10, size=n).astype(np.int64)
+            self.images = np.zeros((n, 28, 28), dtype=np.uint8)
+            # class-dependent pattern so a model can actually learn
+            for i, l in enumerate(self.labels):
+                img = rng.randint(0, 50, size=(28, 28))
+                img[2 + l * 2: 6 + l * 2, 4:24] += 180
+                self.images[i] = np.clip(img, 0, 255)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None, :, :]
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.transform = transform
+        self.synthetic = True
+        n = 1024
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.labels = rng.randint(0, 10, size=n).astype(np.int64)
+        self.images = rng.randint(0, 255, size=(n, 32, 32, 3)).astype(np.uint8)
+        for i, l in enumerate(self.labels):
+            self.images[i, :, :, l % 3] //= 2
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, np.asarray(self.labels[idx], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        rng = np.random.RandomState(2)
+        self.labels = rng.randint(0, 100, size=len(self.labels)).astype(np.int64)
+
+
+class FashionMNIST(MNIST):
+    pass

@@ -1,0 +1,20 @@
+"""Test config: force an 8-device virtual CPU mesh (the reference's
+multi-process-on-localhost simulation strategy, SURVEY.md §4, mapped to
+jax's host-platform device-count flag)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(102)
+    yield

@@ -1,0 +1,69 @@
+"""OpTest harness — the TPU analog of the reference's
+`python/paddle/fluid/tests/unittests/op_test.py` (OpTest:270): declarative
+op checks against a numpy reference, with numeric-vs-analytic gradient checks
+per dtype (bf16 tolerances widened as the reference's white_list does).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+DEFAULT_TOL = {"float32": 1e-5, "bfloat16": 2e-2, "float16": 1e-2}
+
+
+def check_output(op_fn, np_fn, inputs, atol=None, rtol=None, dtype="float32",
+                 kwargs=None):
+    """Run op_fn(Tensors) and np_fn(arrays); compare."""
+    kwargs = kwargs or {}
+    tol = atol if atol is not None else DEFAULT_TOL[dtype]
+    tensors = [Tensor(np.asarray(a, dtype=dtype)) for a in inputs]
+    out = op_fn(*tensors, **kwargs)
+    ref = np_fn(*[np.asarray(a, dtype=dtype) for a in inputs], **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(
+            np.asarray(o.numpy(), np.float64), np.asarray(r, np.float64),
+            atol=tol, rtol=rtol or tol)
+
+
+def check_grad(op_fn, inputs, grad_index=0, eps=1e-3, atol=2e-2,
+               kwargs=None, reduce_to_scalar=True):
+    """Numeric gradient (central differences) vs tape gradient, mirroring
+    OpTest.check_grad_with_place → _get_gradient."""
+    kwargs = kwargs or {}
+    arrays = [np.asarray(a, dtype="float64").astype("float32") for a in inputs]
+
+    def scalar_loss(arrs):
+        tensors = [Tensor(a) for a in arrs]
+        for t in tensors:
+            t.stop_gradient = False
+        out = op_fn(*tensors, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return out.sum() if reduce_to_scalar else out, tensors
+
+    # analytic
+    loss, tensors = scalar_loss(arrays)
+    for t in tensors:
+        t._retain_grads = True
+    loss.backward()
+    analytic = tensors[grad_index].grad.numpy().astype("float64")
+
+    # numeric
+    target = arrays[grad_index]
+    numeric = np.zeros_like(target, dtype="float64")
+    flat = target.reshape(-1)
+    num_flat = numeric.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        lp, _ = scalar_loss(arrays)
+        lp = float(lp.numpy())
+        flat[i] = orig - eps
+        lm, _ = scalar_loss(arrays)
+        lm = float(lm.numpy())
+        flat[i] = orig
+        num_flat[i] = (lp - lm) / (2 * eps)
+
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=atol)

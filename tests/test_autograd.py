@@ -1,0 +1,130 @@
+"""Autograd engine tests (reference model: imperative BasicEngine tests,
+`test_imperative_basic.py`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+rng = np.random.RandomState(3)
+
+
+def test_simple_backward():
+    x = paddle.to_tensor(rng.rand(3, 3).astype("float32"), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-6)
+
+
+def test_chain_and_accumulate():
+    w = paddle.Parameter(np.ones((2, 2), np.float32))
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    for _ in range(2):  # two backward passes accumulate
+        loss = ops.matmul(x, w).sum()
+        loss.backward()
+    np.testing.assert_allclose(w.grad.numpy(), 2 * 2 * np.ones((2, 2)))
+    w.clear_grad()
+    assert w.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(rng.rand(2, 2).astype("float32"), stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    y = (x * 2).sum()
+    y.backward()
+    assert x.grad is not None
+
+
+def test_no_grad_context():
+    w = paddle.Parameter(np.ones((2,), np.float32))
+    with paddle.no_grad():
+        y = (w * 3).sum()
+    assert y._tape_node is None
+    y2 = (w * 3).sum()
+    assert y2._tape_node is not None
+
+
+def test_grad_api():
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    y = (x ** 2).sum()
+    (gx,) = paddle.grad([y], [x])
+    np.testing.assert_allclose(gx.numpy(), 2 * x.numpy())
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_grad_unused():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    z = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = (x * 2).sum()
+    with pytest.raises(RuntimeError):
+        paddle.grad([y], [z])
+    gz = paddle.grad([y], [z], allow_unused=True)
+    assert gz[0] is None
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(rng.rand(4).astype("float32"), stop_gradient=False)
+    parts = ops.split(x, 2)
+    loss = parts[0].sum() * 2 + parts[1].sum() * 3
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 3, 3])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    y = x * 3
+    loss = y.sum()
+    loss.backward(retain_graph=True)
+    loss.backward(retain_graph=False)
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_non_leaf_grad_retention():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    h = x * 2
+    h.retain_grads()
+    (h * 3).sum().backward()
+    np.testing.assert_allclose(h.grad.numpy(), [3, 3])
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_recompute():
+    from paddle_tpu.distributed.fleet.utils import recompute
+
+    w = paddle.Parameter(np.ones((3, 3), np.float32))
+    x = paddle.to_tensor(rng.rand(2, 3).astype("float32"))
+
+    def block(inp):
+        return ops.matmul(inp, w).exp()
+
+    # baseline
+    out_ref = block(x)
+    loss_ref = out_ref.sum()
+    loss_ref.backward()
+    g_ref = w.grad.numpy().copy()
+    w.clear_grad()
+
+    out = recompute(block, x)
+    np.testing.assert_allclose(out.numpy(), out_ref.numpy(), rtol=1e-6)
+    out.sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), g_ref, rtol=1e-5)

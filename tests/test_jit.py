@@ -1,0 +1,156 @@
+"""to_static tests (reference model: dygraph_to_static test suite)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops
+
+rng = np.random.RandomState(5)
+
+
+def test_to_static_forward_equivalence():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m.eval()
+    x = paddle.to_tensor(rng.rand(3, 4).astype("float32"))
+    eager = m(x).numpy()
+    static_fwd = paddle.jit.to_static(lambda t: m(t))
+    np.testing.assert_allclose(static_fwd(x).numpy(), eager, rtol=1e-5)
+
+
+def test_to_static_training_matches_eager():
+    def make():
+        paddle.seed(42)
+        m = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        return m, opt
+
+    x = rng.rand(8, 4).astype("float32")
+    y = rng.rand(8, 2).astype("float32")
+
+    # eager
+    m1, opt1 = make()
+    losses_eager = []
+    for _ in range(5):
+        loss = nn.functional.mse_loss(m1(paddle.to_tensor(x)),
+                                      paddle.to_tensor(y))
+        loss.backward()
+        opt1.step()
+        opt1.clear_grad()
+        losses_eager.append(float(loss.numpy()))
+
+    # jitted
+    m2, opt2 = make()
+
+    @paddle.jit.to_static
+    def step(xb, yb):
+        loss = nn.functional.mse_loss(m2(xb), yb)
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+        return loss
+
+    losses_jit = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+                  for _ in range(5)]
+    np.testing.assert_allclose(losses_eager, losses_jit, rtol=1e-4)
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(), rtol=1e-4)
+
+
+def test_to_static_bn_buffers_update():
+    m = nn.BatchNorm1D(3, data_format="NCL")
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return m(x)
+
+    before = m._mean.numpy().copy()
+    fwd(paddle.to_tensor(rng.rand(4, 3, 5).astype("float32") + 2.0))
+    after = m._mean.numpy()
+    assert not np.allclose(before, after), "running mean must update in jit"
+
+
+def test_to_static_rng_advances():
+    drop = nn.Dropout(0.5)
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return drop(x)
+
+    x = paddle.to_tensor(np.ones((64, 64), np.float32))
+    a = fwd(x).numpy()
+    b = fwd(x).numpy()
+    assert not np.allclose(a, b), "dropout mask must differ across jit calls"
+
+
+def test_to_static_recompiles_on_shape_change():
+    m = nn.Linear(4, 2)
+    fwd = paddle.jit.to_static(lambda t: m(t))
+    out1 = fwd(paddle.to_tensor(rng.rand(2, 4).astype("float32")))
+    out2 = fwd(paddle.to_tensor(rng.rand(6, 4).astype("float32")))
+    assert out1.shape == [2, 2] and out2.shape == [6, 2]
+    assert len(fwd._cache) == 2
+
+
+def test_to_static_adam_scaler_pipeline():
+    m = nn.Linear(8, 8)
+    opt = paddle.optimizer.AdamW(parameters=m.parameters(), learning_rate=1e-2)
+    scaler = paddle.amp.GradScaler(enable=False)
+
+    @paddle.jit.to_static
+    def step(x):
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+            out = m(x)
+            loss = out.square().mean()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        opt.clear_grad()
+        return loss
+
+    x = paddle.to_tensor(rng.rand(4, 8).astype("float32"))
+    l0 = float(step(x).numpy())
+    for _ in range(5):
+        l1 = float(step(x).numpy())
+    assert l1 < l0
+
+
+def test_jit_save_load(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 4), nn.Tanh(), nn.Linear(4, 2))
+    m.eval()
+    x = paddle.to_tensor(rng.rand(2, 4).astype("float32"))
+    ref = m(x).numpy()
+    path = str(tmp_path / "model")
+    paddle.jit.save(m, path)
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-6)
+
+
+def test_paddle_save_load(tmp_path):
+    m = nn.Linear(3, 3)
+    path = str(tmp_path / "m.pdparams")
+    paddle.save(m.state_dict(), path)
+    sd = paddle.load(path)
+    m2 = nn.Linear(3, 3)
+    m2.set_state_dict(sd)
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_lr_scheduler_no_retrace():
+    m = nn.Linear(2, 2)
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=m.parameters())
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = m(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    step(x)
+    w_after_1 = m.weight.numpy().copy()
+    sched.step()  # lr 0.1 -> 0.05
+    assert abs(opt.get_lr() - 0.05) < 1e-7
+    step(x)
+    assert len(step._cache) == 1, "lr change must not retrace"

@@ -1,0 +1,279 @@
+"""Layer tests (reference model: unittests/test_layers.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(11)
+
+
+def test_linear():
+    layer = nn.Linear(4, 3)
+    x = paddle.to_tensor(rng.rand(2, 4).astype("float32"))
+    out = layer(x)
+    ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_shapes():
+    layer = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.to_tensor(rng.rand(2, 3, 16, 16).astype("float32"))
+    assert layer(x).shape == [2, 8, 8, 8]
+    layer = nn.Conv2D(4, 4, 3, groups=4, padding=1)  # depthwise
+    x = paddle.to_tensor(rng.rand(1, 4, 8, 8).astype("float32"))
+    assert layer(x).shape == [1, 4, 8, 8]
+
+
+def test_conv2d_vs_torch_semantics():
+    import torch
+    import torch.nn.functional as tF
+    x = rng.rand(2, 3, 8, 8).astype("float32")
+    w = rng.rand(5, 3, 3, 3).astype("float32")
+    b = rng.rand(5).astype("float32")
+    mine = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                    paddle.to_tensor(b), stride=1, padding=1)
+    ref = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                    stride=1, padding=1).numpy()
+    np.testing.assert_allclose(mine.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_vs_torch():
+    import torch
+    import torch.nn.functional as tF
+    x = rng.rand(2, 4, 8, 8).astype("float32")
+    w = rng.rand(4, 6, 3, 3).astype("float32")  # [in, out, kh, kw]
+    mine = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                              stride=2, padding=1)
+    ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                              padding=1).numpy()
+    np.testing.assert_allclose(mine.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pooling_vs_torch():
+    import torch
+    import torch.nn.functional as tF
+    x = rng.rand(2, 3, 8, 8).astype("float32")
+    mine = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+    ref = tF.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(mine.numpy(), ref)
+    mine = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+    ref = tF.avg_pool2d(torch.tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(mine.numpy(), ref, rtol=1e-6)
+    mine = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+    ref = tF.adaptive_avg_pool2d(torch.tensor(x), 1).numpy()
+    np.testing.assert_allclose(mine.numpy(), ref, rtol=1e-6)
+
+
+def test_batch_norm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.to_tensor(rng.rand(8, 4, 5, 5).astype("float32") * 3 + 1)
+    bn.train()
+    out = bn(x)
+    # normalized output: ~zero mean, unit var per channel
+    o = out.numpy()
+    assert abs(o.mean()) < 1e-4
+    assert abs(o.std() - 1.0) < 1e-2
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    out_eval = bn(x)
+    assert out_eval.shape == out.shape
+
+
+def test_batch_norm_grad_flows():
+    bn = nn.BatchNorm1D(3, data_format="NCL")
+    x = paddle.to_tensor(rng.rand(4, 3, 5).astype("float32"))
+    out = bn(x)
+    out.sum().backward()
+    assert bn.weight.grad is not None
+    assert bn.bias.grad is not None
+
+
+def test_layer_norm_vs_torch():
+    import torch
+    ln = nn.LayerNorm(6)
+    x = rng.rand(4, 6).astype("float32")
+    mine = ln(paddle.to_tensor(x)).numpy()
+    tln = torch.nn.LayerNorm(6)
+    with torch.no_grad():
+        tln.weight.copy_(torch.tensor(ln.weight.numpy()))
+        tln.bias.copy_(torch.tensor(ln.bias.numpy()))
+    ref = tln(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(mine, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([[1, 0, 3]], np.int64))
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_dropout_modes():
+    drop = nn.Dropout(0.5)
+    x = paddle.to_tensor(np.ones((100, 100), np.float32))
+    drop.train()
+    y = drop(x)
+    frac = float((y.numpy() == 0).mean())
+    assert 0.4 < frac < 0.6
+    kept = y.numpy()[y.numpy() != 0]
+    np.testing.assert_allclose(kept, 2.0 * np.ones_like(kept))  # upscale
+    drop.eval()
+    np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+
+
+def test_activations_match_torch():
+    import torch
+    import torch.nn.functional as tF
+    x = rng.randn(3, 4).astype("float32")
+    pairs = [
+        (F.relu, tF.relu), (F.gelu, tF.gelu), (F.silu, tF.silu),
+        (F.sigmoid, torch.sigmoid), (F.softplus, tF.softplus),
+        (F.elu, tF.elu), (F.leaky_relu, tF.leaky_relu),
+        (F.hardswish, tF.hardswish), (F.log_sigmoid, tF.logsigmoid),
+    ]
+    for mine_fn, ref_fn in pairs:
+        mine = mine_fn(paddle.to_tensor(x)).numpy()
+        ref = ref_fn(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(mine, ref, rtol=1e-4, atol=1e-5)
+    mine = F.softmax(paddle.to_tensor(x), axis=-1).numpy()
+    ref = tF.softmax(torch.tensor(x), dim=-1).numpy()
+    np.testing.assert_allclose(mine, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_and_containers():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.to_tensor(rng.rand(3, 4).astype("float32"))
+    assert m(x).shape == [3, 2]
+    assert len(list(m.named_parameters())) == 4
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    pl = nn.ParameterList([paddle.Parameter(np.zeros((2, 2), np.float32))])
+    assert len(pl) == 1
+
+
+def test_state_dict_roundtrip():
+    m = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4, data_format="NCL"))
+    sd = m.state_dict()
+    assert any("weight" in k for k in sd)
+    assert any("_mean" in k for k in sd)
+    m2 = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4, data_format="NCL"))
+    m2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    for (k1, v1), (k2, v2) in zip(sorted(m.state_dict().items()),
+                                  sorted(m2.state_dict().items())):
+        np.testing.assert_allclose(v1.numpy(), v2.numpy())
+
+
+def test_losses_vs_torch():
+    import torch
+    import torch.nn.functional as tF
+    logits = rng.randn(5, 7).astype("float32")
+    labels = rng.randint(0, 7, 5).astype("int64")
+    mine = F.cross_entropy(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels)).numpy()
+    ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels)).numpy()
+    np.testing.assert_allclose(mine, ref, rtol=1e-5)
+
+    pred = rng.rand(4, 3).astype("float32")
+    tgt = rng.rand(4, 3).astype("float32")
+    np.testing.assert_allclose(
+        F.mse_loss(paddle.to_tensor(pred), paddle.to_tensor(tgt)).numpy(),
+        tF.mse_loss(torch.tensor(pred), torch.tensor(tgt)).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(pred), paddle.to_tensor(tgt)).numpy(),
+        tF.binary_cross_entropy_with_logits(
+            torch.tensor(pred), torch.tensor(tgt)).numpy(), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_smoothing():
+    import torch
+    import torch.nn.functional as tF
+    logits = rng.randn(6, 5).astype("float32")
+    labels = np.array([0, 1, -100, 3, -100, 2], np.int64)
+    mine = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                           ignore_index=-100).numpy()
+    ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                           ignore_index=-100).numpy()
+    np.testing.assert_allclose(mine, ref, rtol=1e-5)
+
+
+def test_rnn_lstm_gru():
+    for cls, states in [(nn.SimpleRNN, 1), (nn.LSTM, 2), (nn.GRU, 1)]:
+        rnn = cls(4, 8, num_layers=2)
+        x = paddle.to_tensor(rng.rand(2, 5, 4).astype("float32"))
+        out, h = rnn(x)
+        assert out.shape == [2, 5, 8]
+        if states == 2:
+            assert h[0].shape == [2, 2, 8]
+        out.sum().backward()
+        assert rnn.weight_ih_l0.grad is not None
+
+
+def test_lstm_vs_torch():
+    import torch
+    lstm = nn.LSTM(3, 5)
+    tl = torch.nn.LSTM(3, 5, batch_first=True)
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.tensor(lstm.weight_ih_l0.numpy()))
+        tl.weight_hh_l0.copy_(torch.tensor(lstm.weight_hh_l0.numpy()))
+        tl.bias_ih_l0.copy_(torch.tensor(lstm.bias_ih_l0.numpy()))
+        tl.bias_hh_l0.copy_(torch.tensor(lstm.bias_hh_l0.numpy()))
+    x = rng.rand(2, 7, 3).astype("float32")
+    mine, (h, c) = lstm(paddle.to_tensor(x))
+    ref, (th, tc) = tl(torch.tensor(x))
+    np.testing.assert_allclose(mine.numpy(), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_rnn():
+    rnn = nn.GRU(4, 6, direction="bidirect")
+    x = paddle.to_tensor(rng.rand(2, 5, 4).astype("float32"))
+    out, h = rnn(x)
+    assert out.shape == [2, 5, 12]
+    assert h.shape == [2, 2, 6]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4,
+                                       dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, num_layers=2)
+    x = paddle.to_tensor(rng.rand(2, 6, 16).astype("float32"))
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    grads = [p.grad for p in enc.parameters()]
+    assert all(g is not None for g in grads)
+
+
+def test_mha_cache():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(rng.rand(2, 4, 16).astype("float32"))
+    cache = mha.gen_cache(x)
+    out, new_cache = mha(x, x, x, cache=cache)
+    assert out.shape == [2, 4, 16]
+    assert new_cache.k.shape[1] == 4
+    step = paddle.to_tensor(rng.rand(2, 1, 16).astype("float32"))
+    out2, cache2 = mha(step, step, step, cache=new_cache)
+    assert cache2.k.shape[1] == 5
+
+
+def test_grad_clip():
+    clip = nn.ClipGradByGlobalNorm(0.5)
+    w = paddle.Parameter(np.ones((4,), np.float32))
+    (w * np.float32(100.0)).sum().backward()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w],
+                               grad_clip=clip)
+    before = np.linalg.norm(w.grad.numpy())
+    assert before > 0.5
+    opt.step()
+    # after clipping the applied update is bounded by lr * clip_norm
+    delta = np.linalg.norm(w.numpy() - np.ones(4))
+    assert delta <= 0.1 * 0.5 * 1.01

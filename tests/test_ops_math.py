@@ -1,0 +1,177 @@
+"""Op correctness vs numpy (reference test model: unittests/test_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(7)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("exp", np.exp), ("log", None), ("sqrt", None), ("tanh", np.tanh),
+    ("sin", np.sin), ("cos", np.cos), ("abs", np.abs), ("square", np.square),
+    ("floor", np.floor), ("ceil", np.ceil), ("sign", np.sign),
+])
+def test_unary(name, np_fn):
+    x = rng.rand(3, 4).astype("float32") + 0.5
+    np_fn = np_fn or getattr(np, name)
+    check_output(getattr(ops, name), np_fn, [x])
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+])
+def test_binary(name, np_fn):
+    x = rng.rand(3, 4).astype("float32") + 1.0
+    y = rng.rand(3, 4).astype("float32") + 1.0
+    check_output(getattr(ops, name), np_fn, [x, y])
+
+
+def test_binary_broadcast():
+    x = rng.rand(3, 4).astype("float32")
+    y = rng.rand(4).astype("float32")
+    check_output(ops.add, np.add, [x, y])
+    check_output(ops.multiply, np.multiply, [x, y])
+
+
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False),
+                                          (1, True), ((0, 1), False)])
+def test_reductions(axis, keepdim):
+    x = rng.rand(3, 4, 5).astype("float32")
+    check_output(lambda t: ops.sum(t, axis=axis, keepdim=keepdim),
+                 lambda a: np.sum(a, axis=axis, keepdims=keepdim), [x])
+    check_output(lambda t: ops.mean(t, axis=axis, keepdim=keepdim),
+                 lambda a: np.mean(a, axis=axis, keepdims=keepdim), [x])
+    check_output(lambda t: ops.max(t, axis=axis, keepdim=keepdim),
+                 lambda a: np.max(a, axis=axis, keepdims=keepdim), [x])
+
+
+def test_matmul():
+    x = rng.rand(4, 5).astype("float32")
+    y = rng.rand(5, 3).astype("float32")
+    check_output(ops.matmul, np.matmul, [x, y])
+    # batched
+    xb = rng.rand(2, 4, 5).astype("float32")
+    yb = rng.rand(2, 5, 3).astype("float32")
+    check_output(ops.matmul, np.matmul, [xb, yb])
+    # transpose flags
+    check_output(lambda a, b: ops.matmul(a, b, transpose_y=True),
+                 lambda a, b: a @ b.T, [x, rng.rand(3, 5).astype("float32")])
+
+
+def test_matmul_grad():
+    x = rng.rand(3, 4).astype("float32")
+    y = rng.rand(4, 2).astype("float32")
+    check_grad(ops.matmul, [x, y], grad_index=0)
+    check_grad(ops.matmul, [x, y], grad_index=1)
+
+
+def test_unary_grads():
+    x = rng.rand(3, 3).astype("float32") + 0.5
+    for fn in (ops.exp, ops.log, ops.sqrt, ops.tanh, ops.square):
+        check_grad(fn, [x])
+
+
+def test_manipulation():
+    x = rng.rand(2, 3, 4).astype("float32")
+    check_output(lambda t: ops.reshape(t, [6, 4]),
+                 lambda a: a.reshape(6, 4), [x])
+    check_output(lambda t: ops.transpose(t, [2, 0, 1]),
+                 lambda a: a.transpose(2, 0, 1), [x])
+    check_output(lambda t: ops.squeeze(ops.unsqueeze(t, 0), 0),
+                 lambda a: a, [x])
+    check_output(lambda t: ops.flatten(t, 1),
+                 lambda a: a.reshape(2, 12), [x])
+    check_output(lambda t: ops.flip(t, [1]),
+                 lambda a: a[:, ::-1], [x])
+
+
+def test_concat_split_stack():
+    a = rng.rand(2, 3).astype("float32")
+    b = rng.rand(2, 3).astype("float32")
+    out = ops.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0))
+    parts = ops.split(paddle.to_tensor(a), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1]
+    parts = ops.split(paddle.to_tensor(a), [1, -1], axis=1)
+    assert parts[1].shape == [2, 2]
+    st = ops.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+    assert st.shape == [2, 2, 3]
+
+
+def test_concat_grad():
+    a = rng.rand(2, 2).astype("float32")
+    b = rng.rand(2, 2).astype("float32")
+    check_grad(lambda x, y: ops.concat([x, y], axis=1), [a, b], grad_index=0)
+
+
+def test_gather_indexing():
+    x = rng.rand(5, 4).astype("float32")
+    idx = np.array([0, 2, 4])
+    out = ops.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[idx])
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(t[1:3].numpy(), x[1:3])
+    np.testing.assert_allclose(t[:, 2].numpy(), x[:, 2])
+    np.testing.assert_allclose(t[paddle.to_tensor(idx)].numpy(), x[idx])
+
+
+def test_getitem_grad():
+    x = rng.rand(4, 4).astype("float32")
+    check_grad(lambda t: t[1:3, :2], [x])
+
+
+def test_topk_argmax():
+    x = rng.rand(3, 6).astype("float32")
+    vals, idx = ops.topk(paddle.to_tensor(x), 2)
+    ref = np.sort(x, axis=-1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+    am = ops.argmax(paddle.to_tensor(x), axis=1)
+    np.testing.assert_array_equal(am.numpy(), x.argmax(1))
+
+
+def test_cumsum_sort():
+    x = rng.rand(3, 4).astype("float32")
+    check_output(lambda t: ops.cumsum(t, axis=1),
+                 lambda a: np.cumsum(a, axis=1), [x])
+    check_output(lambda t: ops.sort(t, axis=1),
+                 lambda a: np.sort(a, axis=1), [x])
+
+
+def test_where_clip():
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    cond = x > 0
+    out = ops.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                    paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(cond, x, y))
+    check_output(lambda t: ops.clip(t, -0.5, 0.5),
+                 lambda a: np.clip(a, -0.5, 0.5), [x])
+
+
+def test_scalar_arith_dunders():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose((x + 1).numpy(), [2, 3])
+    np.testing.assert_allclose((2 * x).numpy(), [2, 4])
+    np.testing.assert_allclose((x / 2).numpy(), [0.5, 1])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2])
+    np.testing.assert_allclose((1 - x).numpy(), [0, -1])
+
+
+def test_einsum():
+    a = rng.rand(2, 3).astype("float32")
+    b = rng.rand(3, 4).astype("float32")
+    out = ops.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_cast_dtypes():
+    x = paddle.to_tensor(np.array([1.5, 2.5], np.float32))
+    assert ops.cast(x, "int32").dtype == np.int32
+    assert ops.cast(x, "bfloat16").dtype.name == "bfloat16"
+    assert x.astype("float16").dtype == np.float16

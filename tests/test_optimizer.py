@@ -1,0 +1,153 @@
+"""Optimizer tests vs torch.optim references (reference model:
+unittests/test_adam_op.py etc., but checked against torch semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rng = np.random.RandomState(21)
+
+
+def _run_steps(opt_cls, torch_cls, kwargs_mine, kwargs_torch, steps=5):
+    import torch
+    w0 = rng.rand(4, 3).astype("float32")
+    x = rng.rand(8, 4).astype("float32")
+
+    p = paddle.Parameter(w0.copy())
+    opt = opt_cls(parameters=[p], **kwargs_mine)
+    tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+    topt = torch_cls([tp], **kwargs_torch)
+
+    for _ in range(steps):
+        loss = paddle.matmul(paddle.to_tensor(x), p).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+        tloss = (torch.tensor(x) @ tp).square().mean()
+        topt.zero_grad()
+        tloss.backward()
+        topt.step()
+
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_sgd():
+    import torch
+    _run_steps(paddle.optimizer.SGD, torch.optim.SGD,
+               {"learning_rate": 0.1}, {"lr": 0.1})
+
+
+def test_momentum():
+    import torch
+    _run_steps(paddle.optimizer.Momentum, torch.optim.SGD,
+               {"learning_rate": 0.1, "momentum": 0.9},
+               {"lr": 0.1, "momentum": 0.9})
+
+
+def test_adam():
+    import torch
+    _run_steps(paddle.optimizer.Adam, torch.optim.Adam,
+               {"learning_rate": 0.01}, {"lr": 0.01})
+
+
+def test_adamw():
+    import torch
+    _run_steps(paddle.optimizer.AdamW, torch.optim.AdamW,
+               {"learning_rate": 0.01, "weight_decay": 0.1},
+               {"lr": 0.01, "weight_decay": 0.1})
+
+
+def test_rmsprop():
+    import torch
+    _run_steps(paddle.optimizer.RMSProp, torch.optim.RMSprop,
+               {"learning_rate": 0.01, "rho": 0.9, "epsilon": 1e-8},
+               {"lr": 0.01, "alpha": 0.9, "eps": 1e-8})
+
+
+def test_adagrad():
+    import torch
+    _run_steps(paddle.optimizer.Adagrad, torch.optim.Adagrad,
+               {"learning_rate": 0.05, "epsilon": 1e-10},
+               {"lr": 0.05, "eps": 1e-10})
+
+
+def test_weight_decay_l2():
+    p = paddle.Parameter(np.ones((2,), np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p],
+                               weight_decay=paddle.L2Decay(0.5))
+    (p * np.float32(0.0)).sum().backward()  # zero grad, decay only
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), 1 - 0.1 * 0.5 * np.ones(2),
+                               rtol=1e-6)
+
+
+def test_lamb_runs():
+    p = paddle.Parameter(rng.rand(3, 3).astype("float32"))
+    opt = paddle.optimizer.Lamb(learning_rate=0.01, parameters=[p])
+    before = p.numpy().copy()
+    p.sum().backward()
+    opt.step()
+    assert not np.allclose(p.numpy(), before)
+
+
+def test_optimizer_state_roundtrip():
+    p = paddle.Parameter(rng.rand(2, 2).astype("float32"))
+    opt = paddle.optimizer.Adam(parameters=[p])
+    p.sum().backward()
+    opt.step()
+    opt.clear_grad()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(parameters=[p])
+    opt2.set_state_dict({k: v for k, v in sd.items()})
+    m1 = opt._get_accumulator("moment1", p).numpy()
+    m2 = opt2._get_accumulator("moment1", p).numpy()
+    np.testing.assert_allclose(m1, m2)
+
+
+@pytest.mark.parametrize("sched_fn,expected", [
+    (lambda: paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.1),
+     [0.1, 0.1, 0.01]),
+    (lambda: paddle.optimizer.lr.MultiStepDecay(0.1, milestones=[1, 2]),
+     [0.1, 0.01, 0.001]),
+    (lambda: paddle.optimizer.lr.ExponentialDecay(0.1, gamma=0.5),
+     [0.1, 0.05, 0.025]),
+])
+def test_lr_schedulers(sched_fn, expected):
+    sched = sched_fn()
+    got = [sched.last_lr]
+    for _ in range(len(expected) - 1):
+        sched.step()
+        got.append(sched.last_lr)
+    np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_linear_warmup():
+    sched = paddle.optimizer.lr.LinearWarmup(
+        learning_rate=0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    lrs = [sched.last_lr]
+    for _ in range(5):
+        sched.step()
+        lrs.append(sched.last_lr)
+    np.testing.assert_allclose(lrs[:5], [0.0, 0.025, 0.05, 0.075, 0.1],
+                               rtol=1e-6)
+
+
+def test_cosine_annealing():
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(0.1, T_max=10)
+    assert abs(sched.last_lr - 0.1) < 1e-8
+    for _ in range(10):
+        sched.step()
+    assert sched.last_lr < 1e-8
+
+
+def test_noam():
+    sched = paddle.optimizer.lr.NoamDecay(d_model=64, warmup_steps=10)
+    lrs = []
+    for _ in range(20):
+        sched.step()
+        lrs.append(sched.last_lr)
+    peak = int(np.argmax(lrs))
+    assert 8 <= peak + 1 <= 11  # peaks at warmup boundary
