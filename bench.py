@@ -1,0 +1,103 @@
+"""Flagship benchmark: BERT-base MLM pretraining step, bf16, whole-program XLA.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numbers (BASELINE.md); the north-star target is
+50% MFU for BERT-base pretraining — vs_baseline reports measured_MFU / 0.50.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+PEAK_BF16_FLOPS = {
+    "tpu": 197e12,   # TPU v5e per-chip bf16 peak
+    "cpu": 1e11,     # nominal, for local smoke runs only
+}
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models import BertConfig, BertForPretraining, synthetic_mlm_batch
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = BertConfig(vocab_size=30720, hidden_dropout=0.0,
+                         attention_dropout=0.0)  # base, vocab padded to 128x
+        batch, seq, iters, warmup = 16, 512, 10, 3
+    else:
+        cfg = BertConfig(vocab_size=2048, hidden_size=128, num_layers=2,
+                         num_heads=4, intermediate_size=512,
+                         hidden_dropout=0.0, attention_dropout=0.0)
+        batch, seq, iters, warmup = 4, 128, 3, 1
+
+    model = BertForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-4)
+
+    def train_step(ids, tok, labels, nsp_labels):
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+            logits, nsp = model(ids, tok)
+            loss = model.loss(logits, nsp, labels, nsp_labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step)
+
+    def run(bs):
+        ids, tok, labels, nsp = synthetic_mlm_batch(bs, seq,
+                                                    vocab_size=cfg.vocab_size)
+        t_ids = paddle.to_tensor(ids)
+        t_tok = paddle.to_tensor(tok)
+        t_lab = paddle.to_tensor(labels)
+        t_nsp = paddle.to_tensor(nsp)
+        for _ in range(warmup):
+            loss = step(t_ids, t_tok, t_lab, t_nsp)
+        float(loss.numpy())  # hard sync (device->host) before timing
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(t_ids, t_tok, t_lab, t_nsp)
+        loss_host = float(loss.numpy())  # chain-dependent: waits for all steps
+        dt = time.perf_counter() - t0
+        return bs * seq * iters / dt, loss_host
+
+    tokens_per_s = None
+    for bs in (batch, batch // 2, max(batch // 4, 1)):
+        try:
+            tokens_per_s, loss_val = run(bs)
+            batch = bs
+            break
+        except Exception as e:  # OOM fallback
+            if "RESOURCE_EXHAUSTED" in str(e) or "out of memory" in str(e).lower():
+                continue
+            raise
+    if tokens_per_s is None:
+        print(json.dumps({"metric": "bert_base_pretrain_tokens_per_s_per_chip",
+                          "value": 0.0, "unit": "tokens/s",
+                          "vs_baseline": 0.0}))
+        return
+
+    flops_per_token = model.flops_per_token(seq)
+    peak = PEAK_BF16_FLOPS["tpu" if on_tpu else "cpu"]
+    mfu = tokens_per_s * flops_per_token / peak
+    result = {
+        "metric": "bert_base_pretrain_tokens_per_s_per_chip",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+    }
+    print(json.dumps(result))
+    print(f"# backend={backend} batch={batch} seq={seq} "
+          f"mfu={mfu:.3f} loss={loss_val:.3f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

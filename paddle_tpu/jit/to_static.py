@@ -100,12 +100,20 @@ class StaticFunction:
     @staticmethod
     def _place_state(items, mesh):
         """device_put state onto NamedShardings per tensor pspec (committed
-        arrays steer GSPMD; donation keeps them in place thereafter)."""
+        arrays steer GSPMD; donation keeps them in place thereafter). Arrays
+        committed to a *different* mesh (stale from an earlier fleet.init)
+        are re-placed onto the current one."""
         for _, t in items:
-            if isinstance(t._value, jax.Array) and getattr(t._value, "committed", False):
-                continue
+            v = t._value
             spec = t.pspec if t.pspec is not None else PartitionSpec()
-            t._value = jax.device_put(t._value, NamedSharding(mesh, spec))
+            desired = NamedSharding(mesh, spec)
+            if isinstance(v, jax.Array) and getattr(v, "committed", False):
+                try:
+                    if v.sharding.is_equivalent_to(desired, v.ndim):
+                        continue  # already laid out as requested
+                except Exception:
+                    pass  # unknown sharding type: fall through and re-place
+            t._value = jax.device_put(v, desired)
 
     def __call__(self, *args, **kwargs):
         if _is_tracing:  # nested to_static: inline

@@ -1,0 +1,10 @@
+"""Flagship model families (PaddleNLP/PaddleClas-parity models running on the
+TPU-native framework — see BASELINE.md configs)."""
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForPretraining, bert_base, bert_large,
+    synthetic_mlm_batch,
+)
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, gpt_small, gpt3_1p3b,
+    build_pipeline_layer, synthetic_lm_batch,
+)

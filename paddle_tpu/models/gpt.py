@@ -1,0 +1,154 @@
+"""GPT-style decoder (BASELINE.md config 4: GPT-3 1.3B, Fleet sharding + PP).
+
+TPU-first: causal flash attention, GSPMD mp sharding on qkv/ffn, ZeRO via
+optimizer-state specs, and a PipelineLayer description for pp segmentation.
+"""
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import nn, ops
+from ..nn import functional as F
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None, max_seq_len=1024,
+                 hidden_dropout=0.1, attention_dropout=0.1, use_mp=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.hidden_dropout = hidden_dropout
+        self.attention_dropout = attention_dropout
+        self.use_mp = use_mp
+
+
+def gpt3_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16, **kw)
+
+
+def gpt_small(**kw):
+    return GPTConfig(**kw)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        h = cfg.hidden_size
+        self.ln1 = nn.LayerNorm(h)
+        self.qkv = nn.Linear(h, 3 * h)
+        self.proj = nn.Linear(h, h)
+        self.ln2 = nn.LayerNorm(h)
+        self.fc1 = nn.Linear(h, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, h)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        self.attn_dropout_p = cfg.attention_dropout
+        if cfg.use_mp:
+            self.qkv.weight.pspec = P(None, "mp")
+            self.qkv.bias.pspec = P("mp")
+            self.proj.weight.pspec = P("mp", None)
+            self.fc1.weight.pspec = P(None, "mp")
+            self.fc1.bias.pspec = P("mp")
+            self.fc2.weight.pspec = P("mp", None)
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        h = self.ln1(x)
+        qkv = ops.reshape(self.qkv(h), [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unstack(qkv, axis=2)
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.attn_dropout_p,
+            training=self.training)
+        ctx = ops.reshape(ctx, [b, s, self.num_heads * self.head_dim])
+        x = x + self.dropout(self.proj(ctx))
+        h = self.ln2(x)
+        x = x + self.dropout(self.fc2(F.gelu(self.fc1(h))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg=None, **kwargs):
+        super().__init__()
+        cfg = cfg or GPTConfig(**kwargs)
+        self.config = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.hidden_dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        if cfg.use_mp:
+            self.wte.weight.pspec = P("mp", None)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = ops.arange(s, dtype="int32")
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg=None, **kwargs):
+        super().__init__()
+        cfg = cfg or GPTConfig(**kwargs)
+        self.config = cfg
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids):
+        hidden = self.gpt(input_ids)
+        # weight-tied LM head
+        return ops.matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+
+    def loss(self, logits, labels):
+        b, s, v = logits.shape
+        return F.cross_entropy(ops.reshape(logits[:, :-1], [-1, v]),
+                               ops.reshape(labels[:, 1:], [-1]))
+
+    def flops_per_token(self, seq_len=None):
+        cfg = self.config
+        n = sum(p.size for p in self.parameters())
+        s = seq_len or cfg.max_seq_len
+        return 6 * n + 12 * cfg.num_layers * cfg.hidden_size * s
+
+
+def build_pipeline_layer(cfg, num_stages, loss_fn=None):
+    """GPT as a reference-style PipelineLayer (LayerDesc segmentation)."""
+    from ..distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+    class _EmbedStage(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+            self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+
+        def forward(self, input_ids):
+            s = input_ids.shape[1]
+            pos = ops.arange(s, dtype="int32")
+            return self.wte(input_ids) + self.wpe(pos)
+
+    class _HeadStage(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ln_f = nn.LayerNorm(cfg.hidden_size)
+            self.head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+        def forward(self, x):
+            return self.head(self.ln_f(x))
+
+    descs = ([LayerDesc(_EmbedStage)]
+             + [LayerDesc(GPTBlock, cfg) for _ in range(cfg.num_layers)]
+             + [LayerDesc(_HeadStage)])
+    return PipelineLayer(descs, num_stages=num_stages, loss_fn=loss_fn)
+
+
+def synthetic_lm_batch(batch_size, seq_len, vocab_size=50304, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab_size, (batch_size, seq_len)).astype("int32")
+    return ids
