@@ -37,26 +37,31 @@ def _is_dynamic(x):
 
 
 class _StateSwap:
-    """Swap registered state values with tracers for the trace duration."""
+    """Swap registered state values (and accumulated grads) with tracers for
+    the trace duration. Grads thread through like the reference's persistable
+    @GRAD vars: accumulated-but-unconsumed gradients survive the compiled
+    call (e.g. a step that only runs backward, stepping eagerly later)."""
 
-    def __init__(self, items, values):
+    def __init__(self, items, values, grads):
         self.items = items
         self.values = values
+        self.grads = grads
         self.saved = None
 
     def __enter__(self):
         global _is_tracing
         self.saved = [(t._value, t._tape_node, t._grad) for _, t in self.items]
-        for (_, t), v in zip(self.items, self.values):
+        for (_, t), v, g in zip(self.items, self.values, self.grads):
             t._value = v
             t._tape_node = None
-            t._grad = None
+            t._grad = g
         self._was_tracing = _is_tracing
         _is_tracing = True
         return self
 
     def capture(self):
-        return [t._value for _, t in self.items]
+        return ([t._value for _, t in self.items],
+                [t._grad for _, t in self.items])
 
     def __exit__(self, *exc):
         global _is_tracing
@@ -107,13 +112,19 @@ class StaticFunction:
             v = t._value
             spec = t.pspec if t.pspec is not None else PartitionSpec()
             desired = NamedSharding(mesh, spec)
-            if isinstance(v, jax.Array) and getattr(v, "committed", False):
-                try:
-                    if v.sharding.is_equivalent_to(desired, v.ndim):
-                        continue  # already laid out as requested
-                except Exception:
-                    pass  # unknown sharding type: fall through and re-place
-            t._value = jax.device_put(v, desired)
+
+            def _placed(arr):
+                if isinstance(arr, jax.Array) and getattr(arr, "committed", False):
+                    try:
+                        if arr.sharding.is_equivalent_to(desired, arr.ndim):
+                            return arr  # already laid out as requested
+                    except Exception:
+                        pass  # unknown sharding type: re-place
+                return jax.device_put(arr, desired)
+
+            t._value = _placed(v)
+            if t._grad is not None:  # accumulated grads follow the same layout
+                t._grad = _placed(t._grad)
 
     def __call__(self, *args, **kwargs):
         if _is_tracing:  # nested to_static: inline
@@ -131,9 +142,10 @@ class StaticFunction:
             self._place_state(state_items, mesh)
             dyn_vals = self._place_args(dyn_vals, mesh)
 
+        grad_vals = [t._grad for _, t in state_items]
         key = (treedef, tuple(_leaf_key(l) for l in leaves),
                tuple(uid for uid, _ in state_items), state_mod.version(),
-               mesh is not None)
+               tuple(g is not None for g in grad_vals), mesh is not None)
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(treedef, leaves, dyn_idx, state_items)
@@ -141,9 +153,11 @@ class StaticFunction:
         compiled, out_wrap = entry
 
         state_vals = [t._value for _, t in state_items]
-        out_flat, new_state = compiled(state_vals, dyn_vals)
-        for (_, t), v in zip(state_items, new_state):
+        out_flat, new_state, new_grads = compiled(state_vals, dyn_vals,
+                                                  grad_vals)
+        for (_, t), v, g in zip(state_items, new_state, new_grads):
             t._value = v
+            t._grad = g
         return out_wrap(out_flat)
 
     def _place_args(self, dyn_vals, mesh):
@@ -164,22 +178,24 @@ class StaticFunction:
         fn = self._fn
         out_template = {}
 
-        def pure_fn(state_vals, dyn_vals):
+        def pure_fn(state_vals, dyn_vals, grad_vals):
             leaves = list(template_leaves)
             for i, v in zip(dyn_idx, dyn_vals):
                 leaves[i] = Tensor(v)
             args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
-            with _StateSwap(state_items, state_vals) as swap:
+            with _StateSwap(state_items, state_vals, grad_vals) as swap:
                 out = fn(*args, **kwargs)
                 out_leaves, out_treedef = jax.tree_util.tree_flatten(
                     out, is_leaf=lambda x: isinstance(x, Tensor))
                 out_vals = [l._value if isinstance(l, Tensor) else l
                             for l in out_leaves]
                 out_template["treedef"] = out_treedef
-                new_state = swap.capture()
-            return out_vals, new_state
+                new_state, new_grads = swap.capture()
+            return out_vals, new_state, new_grads
 
-        donate = (0,) if self._donate else ()
+        # grads are dead after the call (overwritten from new_grads), so
+        # donate them alongside state to avoid doubling gradient HBM
+        donate = (0, 2) if self._donate else ()
         compiled = jax.jit(pure_fn, donate_argnums=donate)
 
         def out_wrap(out_flat):

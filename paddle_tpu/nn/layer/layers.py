@@ -193,6 +193,10 @@ class Layer:
             fn(l)
         return self
 
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
     # ----------------------------------------------------------- state i/o
     def state_dict(self, include_sublayers=True, structured_name_prefix=""):
         out = OrderedDict()

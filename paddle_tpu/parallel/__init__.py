@@ -1,0 +1,7 @@
+"""paddle_tpu.parallel — TPU-native parallelism primitives.
+
+Long-context (ring/Ulysses attention) and in-XLA pipelining; the
+building blocks under paddle_tpu.distributed's reference-shaped API.
+"""
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .pipeline import spmd_pipeline, pipelined_transformer_step  # noqa: F401

@@ -1,0 +1,83 @@
+"""In-XLA SPMD pipeline parallelism over the 'pp' mesh axis.
+
+The performance path for pipeline parallelism (the host-loop
+PipelineParallel.train_batch is the semantic-parity path, matching
+`framework/section_worker.cc`'s schedules). Here the whole GPipe schedule —
+microbatch loop, stage compute, inter-stage sends — compiles into ONE XLA
+program: stage parameters are stacked on a leading axis sharded over 'pp',
+shard_map gives each device its stage's slice, and activations move between
+stages with collective-permute over ICI. Backward differentiates through the
+scan/ppermute (XLA transposes the permutes), so fwd+bwd+update is still a
+single computation — no per-microbatch host round-trips, no p2p protocol.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def spmd_pipeline(stage_fn, stacked_params, microbatches, axis_name="pp"):
+    """Run inside shard_map with `axis_name` bound.
+
+    stage_fn(params_slice, x) -> y : one pipeline stage (uniform across
+        stages; params_slice is one element of the stacked leading axis).
+    stacked_params: pytree with leading axis == n_stages, sharded over
+        axis_name OUTSIDE (shard_map in_specs P(axis_name, ...)); inside,
+        leaves arrive with leading axis 1 — squeezed here.
+    microbatches: [n_micro, micro_batch, ...] activations, replicated.
+
+    Returns [n_micro, micro_batch, ...] outputs of the LAST stage,
+    replicated across the axis (psum-masked broadcast).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0),
+                                    stacked_params)
+    n_micro = microbatches.shape[0]
+    total_steps = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    y0 = stage_fn(params, microbatches[0])
+    assert y0.shape == microbatches[0].shape, (
+        "spmd_pipeline requires shape-preserving stages")
+
+    def step_fn(carry, t):
+        recv, outputs = carry
+        inject = microbatches[jnp.clip(t, 0, n_micro - 1)]
+        x = jnp.where(stage == 0, inject, recv)
+        y = stage_fn(params, x)
+        out_t = t - (n_stages - 1)
+        is_out = (stage == n_stages - 1) & (out_t >= 0)
+        outputs = outputs.at[jnp.clip(out_t, 0, n_micro - 1)].set(
+            jnp.where(is_out, y, outputs[jnp.clip(out_t, 0, n_micro - 1)]))
+        recv = jax.lax.ppermute(y, axis_name, perm)
+        return (recv, outputs), None
+
+    _vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    outputs0 = _vary(jnp.zeros((n_micro,) + tuple(y0.shape), y0.dtype))
+    recv0 = _vary(jnp.zeros(tuple(y0.shape), y0.dtype))
+    (_, outputs), _ = jax.lax.scan(step_fn, (recv0, outputs0),
+                                   jnp.arange(total_steps))
+    # broadcast last stage's outputs to every stage (replicated result)
+    mask = (stage == n_stages - 1).astype(outputs.dtype)
+    return jax.lax.psum(outputs * mask, axis_name)
+
+
+def pipelined_transformer_step(block_fn, embed_fn, head_loss_fn):
+    """Build a full pipelined training-step function for a uniform
+    transformer: embed (replicated) → stacked blocks over 'pp' via
+    spmd_pipeline → head+loss (replicated). Returns
+    step(stacked_block_params, other_params, micro_ids, micro_labels)->loss
+    suitable for jax.value_and_grad + jit over a mesh with a 'pp' axis."""
+
+    def loss_fn(stacked_block_params, other_params, micro_ids, micro_labels,
+                axis_name="pp"):
+        emb = jax.vmap(lambda ids: embed_fn(other_params, ids))(micro_ids)
+        outs = spmd_pipeline(block_fn, stacked_block_params, emb,
+                             axis_name=axis_name)
+        losses = jax.vmap(lambda h, y: head_loss_fn(other_params, h, y))(
+            outs, micro_labels)
+        return jnp.mean(losses)
+
+    return loss_fn

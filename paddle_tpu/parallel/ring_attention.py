@@ -1,0 +1,126 @@
+"""Ring attention — context parallelism over a mesh axis.
+
+Beyond the reference's capability bar (it has no sequence/context parallelism,
+SURVEY.md §5) but first-class here: sequence sharded over the 'sp' axis, K/V
+blocks rotate around the ring via collective-permute over ICI while each
+device accumulates flash-style online softmax for its local Q block. The
+rotation overlaps with compute (XLA schedules ppermute async), so attention
+over sequences far beyond one chip's HBM runs at near-local speed.
+
+Layout: [batch, seq_local, heads, head_dim] (framework attention layout).
+Differentiable (jax transposes the ppermutes); wrap in jax.checkpoint for
+long rings to bound residual memory.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _online_block(q, k, v, m, l, acc, mask=None):
+    """One flash-attention block update. q:[B,H,Sq,D] k/v:[B,H,Sk,D]."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+    m_blk = jnp.max(logits, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows (m_new == -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd",
+                                                 p.astype(v.dtype), v)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Runs INSIDE shard_map with `axis_name` bound; seq dim sharded on it."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale  # [B,H,Sq,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    # mark the zero-initialized carries as device-varying along the ring
+    # axis (shard_map's vma typing requires carry in/out types to match)
+    _vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    m0 = _vary(jnp.full((b, h, s_q), -jnp.inf, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, s_q), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, h, s_q, d), jnp.float32))
+
+    q_pos = my_idx * s_q + jnp.arange(s_q)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def round_fn(carry, r):
+        k_cur, v_cur, m, l, acc = carry
+        # k/v started at this device's block and has rotated r hops forward,
+        # so the block we now hold originated at (my_idx - r) mod n
+        src = (my_idx - r) % n
+        if causal:
+            k_pos = src * s_k + jnp.arange(s_k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = mask[None, None]  # [1,1,Sq,Sk]
+        else:
+            mask = None
+        m, l, acc = _online_block(qt, k_cur.astype(jnp.float32),
+                                  v_cur.astype(jnp.float32), m, l, acc, mask)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        round_fn, (kt, vt, m0, l0, acc0), jnp.arange(n))
+
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B,Sq,H,D]
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
+                      attention_fn=None):
+    """DeepSpeed-Ulysses style sequence parallelism: all-to-all swaps the
+    sharded dim from sequence to heads, attention runs with the FULL sequence
+    locally (heads sharded), then all-to-all swaps back. Needs
+    heads % axis_size == 0. Runs INSIDE shard_map."""
+    n = jax.lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    assert h % n == 0, f"heads {h} not divisible by sp={n}"
+
+    def seq_to_heads(x):
+        # [B, S/n, H, D] -> [B, S, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if attention_fn is None:
+        attention_fn = functools.partial(_full_attention, causal=causal,
+                                         scale=scale)
+    out = attention_fn(qf, kf, vf)
+    return heads_to_seq(out)
+
+
+def _full_attention(q, k, v, causal=False, scale=None):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vt.dtype), vt)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
