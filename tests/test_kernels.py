@@ -1,0 +1,67 @@
+"""Pallas kernel tests (interpret mode on CPU; compiled path covered by the
+on-TPU bench). Reference model: operators/fused/ unit tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.flash_attention import flash_attention_bshd
+from paddle_tpu.parallel.ring_attention import _full_attention
+
+rng = np.random.RandomState(4)
+
+
+def _mk(b, s, h, d):
+    return (jnp.asarray(rng.randn(b, s, h, d).astype("float32")),
+            jnp.asarray(rng.randn(b, s, h, d).astype("float32")),
+            jnp.asarray(rng.randn(b, s, h, d).astype("float32")))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [128, 384, 200])
+def test_flash_forward(causal, s):
+    q, k, v = _mk(2, s, 2, 64)
+    out = flash_attention_bshd(q, k, v, causal=causal, interpret=True)
+    ref = _full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward(causal):
+    q, k, v = _mk(1, 256, 2, 32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention_bshd(q, k, v, causal=causal,
+                                            interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_full_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bf16():
+    q, k, v = _mk(1, 256, 2, 64)
+    out = flash_attention_bshd(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                               v.astype(jnp.bfloat16), causal=True,
+                               interpret=True)
+    ref = _full_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_flash_cross_attention_lengths():
+    q = jnp.asarray(rng.randn(1, 128, 2, 32).astype("float32"))
+    k = jnp.asarray(rng.randn(1, 320, 2, 32).astype("float32"))
+    v = jnp.asarray(rng.randn(1, 320, 2, 32).astype("float32"))
+    out = flash_attention_bshd(q, k, v, interpret=True)
+    ref = _full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
