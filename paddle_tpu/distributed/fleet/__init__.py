@@ -11,6 +11,8 @@ from .base.distributed_strategy import DistributedStrategy  # noqa: F401
 from .base.topology import HybridCommunicateGroup, CommunicateTopology  # noqa: F401
 from .base.role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import meta_optimizers  # noqa: F401
+from . import utils  # noqa: F401
 from .utils import recompute  # noqa: F401
 
 from .base import fleet_base as _fb

@@ -53,7 +53,10 @@ class DistributedStrategy:
         # comm-efficiency knobs (kept for API parity; DGC/localsgd are
         # CUDA-era bandwidth optimizations that ICI does not need)
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.adaptive_localsgd = False
         self.lamb = False
         self.lamb_configs = {}
         self.lars = False

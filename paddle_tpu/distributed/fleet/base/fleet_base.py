@@ -7,12 +7,11 @@ sharding specs to optimizer state (the sharding_optimizer analog — GSPMD
 emits the reduce-scatter/all-gather the reference inserts by program rewrite).
 """
 import jax
-from jax.sharding import PartitionSpec
 
 from .distributed_strategy import DistributedStrategy
 from .role_maker import PaddleCloudRoleMaker
 from .topology import (
-    AXIS_DATA, AXIS_SHARD, HybridCommunicateGroup,
+    HybridCommunicateGroup,
     set_hybrid_communicate_group, get_hybrid_communicate_group,
 )
 
@@ -52,45 +51,57 @@ def stop_worker():
 def distributed_model(model):
     """reference: fleet_base.py:836 — wrap per active parallelism."""
     from ...parallel import DataParallel
-    from ..meta_parallel import PipelineLayer, PipelineParallel, TensorParallel
+    from ..meta_parallel import (
+        PipelineLayer, PipelineParallel, ShardingParallel, TensorParallel,
+    )
 
     hcg = get_hybrid_communicate_group()
     if hcg is None:
         init()
         hcg = get_hybrid_communicate_group()
 
+    # stage-3 parameter sharding is a layout property, orthogonal to which
+    # wrapper is outermost — apply it before picking the wrapper so hybrid
+    # meshes (mp×sharding, pp×sharding) still get ZeRO-3
+    if hcg.get_sharding_parallel_world_size() > 1 and _strategy is not None:
+        stage = int(_strategy.sharding_configs.get("stage", 1))
+        if stage >= 3:
+            from ..meta_parallel.sharding_parallel import shard_parameters
+            shard_parameters(model, mesh=hcg.mesh)
+
     if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
         return PipelineParallel(model, hcg, _strategy)
     if hcg.get_model_parallel_world_size() > 1:
         return TensorParallel(model, hcg, _strategy)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return ShardingParallel(model, hcg, _strategy)
     return DataParallel(model)
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """reference: fleet_base.py:783 → meta-optimizer stack. TPU: attach
-    sharding specs to optimizer state (ZeRO) and keep the same object API."""
+    """reference: fleet_base.py:783 → meta-optimizer stack resolved by
+    strategy_compiler. TPU: plain wrapper nesting — sharding (a state layout)
+    innermost, then gradient-merge, then localsgd — all of which trace into
+    the single compiled train step."""
     global _strategy
     strategy = strategy or _strategy or DistributedStrategy()
     hcg = get_hybrid_communicate_group()
+
+    from ..meta_optimizers import (
+        DygraphShardingOptimizer, GradientMergeOptimizer, LocalSGDOptimizer,
+    )
     if hcg is not None and (strategy.sharding
                             or hcg.get_sharding_parallel_world_size() > 1):
-        axis = (AXIS_SHARD if hcg.get_sharding_parallel_world_size() > 1
-                else AXIS_DATA)
-        _shard_optimizer_state(optimizer, hcg, axis)
+        optimizer = DygraphShardingOptimizer(optimizer, hcg)
+    if strategy.gradient_merge:
+        cfg = strategy.gradient_merge_configs
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1), avg=cfg.get("avg", True))
+    if strategy.localsgd:
+        group = hcg.get_data_parallel_group() if hcg is not None else None
+        k = getattr(strategy, "localsgd_configs", {}).get("k_steps", 1) or 1
+        optimizer = LocalSGDOptimizer(optimizer, k_steps=k, group=group)
     return HybridParallelOptimizer(optimizer, hcg, strategy)
-
-
-def _shard_optimizer_state(optimizer, hcg, axis):
-    """ZeRO-1: shard each accumulator's first divisible dim over `axis`
-    (reference: sharding_optimizer.py:43 shards opt state across the ring)."""
-    mesh = hcg.mesh
-    if mesh is None:
-        return
-    degree = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
-    for (slot, _), acc in optimizer._accumulators.items():
-        shape = acc.shape
-        if shape and shape[0] % degree == 0 and shape[0] >= degree:
-            acc.pspec = PartitionSpec(axis)
 
 
 class HybridParallelOptimizer:

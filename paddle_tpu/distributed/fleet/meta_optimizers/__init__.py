@@ -1,0 +1,12 @@
+"""Meta-optimizers (reference: `fleet/meta_optimizers/` — static-graph program
+rewriters: gradient_merge_optimizer.py:20, localsgd_optimizer.py:26,
+sharding_optimizer.py:43, amp_optimizer.py:20, recompute_optimizer.py:20).
+
+TPU redesign: instead of rewriting a ProgramDesc, each meta-optimizer is a
+composable wrapper over the dygraph optimizer object; under `to_static` the
+wrapped behavior compiles into the one XLA training step. The stack order the
+reference's strategy_compiler enforces falls out of plain wrapper nesting.
+"""
+from .gradient_merge import GradientMergeOptimizer  # noqa: F401
+from .localsgd import LocalSGDOptimizer  # noqa: F401
+from .sharding import DygraphShardingOptimizer, shard_optimizer_state  # noqa: F401

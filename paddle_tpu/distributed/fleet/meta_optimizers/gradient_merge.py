@@ -1,0 +1,74 @@
+"""Gradient merge (reference: `fleet/meta_optimizers/gradient_merge_optimizer.py:20`
+→ fluid GradientMergeOptimizer optimizer.py:6260 — rewrites the program to
+accumulate @GRAD into persistable buffers and gate the optimizer ops on
+`step % k == 0`).
+
+TPU: the accumulation buffer is a stateful framework tensor per param, so the
+whole merge (accumulate, gate, zero) traces into the compiled train step;
+`lax.cond`-free because the gate is expressed with `jnp.where` on the update —
+branchless, which XLA prefers."""
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+
+class GradientMergeOptimizer:
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner = inner_optimizer
+        self._k = int(k_steps)
+        self._avg = avg
+        self._merge_step = Tensor(jnp.zeros((), jnp.int32))
+        self._merge_step._mark_stateful()
+        self._buffers = {}  # id(param) -> Tensor
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _buffer(self, p):
+        key = id(p)
+        if key not in self._buffers:
+            t = Tensor(jnp.zeros(p._value.shape, jnp.float32))
+            t.persistable = True
+            t._mark_stateful()
+            self._buffers[key] = t
+        return self._buffers[key]
+
+    def step(self):
+        self._merge_step._value = self._merge_step._value + 1
+        boundary = (self._merge_step._value % self._k) == 0
+        # include params that saw a grad earlier in this window even if they
+        # have none this micro-step, so their buffer still applies and resets
+        # at the boundary instead of leaking into the next window
+        params = [p for p in self._inner._parameters()
+                  if not p.stop_gradient
+                  and (p._grad is not None or id(p) in self._buffers)]
+        for p in params:
+            buf = self._buffer(p)
+            g = (p._grad.astype(jnp.float32) if p._grad is not None
+                 else jnp.zeros_like(buf._value))
+            acc = buf._value + g
+            merged = acc / self._k if self._avg else acc
+            p._grad = merged.astype(p._value.dtype)
+            buf._value = jnp.where(boundary, jnp.zeros_like(acc), acc)
+        # run the inner update unconditionally, then select old-vs-new on the
+        # boundary flag for every piece of optimizer-visible state (params,
+        # accumulators, step count) — the reference gates the optimizer ops
+        # with a conditional block; jnp.where keeps it branchless for XLA
+        state_tensors = list(params)
+        state_tensors += list(self._inner._accumulators.values())
+        state_tensors.append(self._inner._step_count)
+        old = [t._value for t in state_tensors]
+        self._inner.step()
+        for t, o in zip(state_tensors, old):
+            t._value = jnp.where(boundary, t._value, o)
+        for p in params:
+            p._grad = None  # merged into the buffer / consumed by the update
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None

@@ -1,0 +1,62 @@
+"""LocalSGD (reference: `fleet/meta_optimizers/localsgd_optimizer.py:26,197` —
+each dp rank steps independently for k steps, then parameters are averaged
+across the dp ring; AdaptiveLocalSGD tunes k from loss).
+
+TPU: with one logical replicated parameter array, per-rank divergence only
+exists inside an explicitly shard_map'd region, so the wrapper keeps the
+API (begin/end step bookkeeping + avg trigger) and performs the periodic
+average with a dp-axis pmean when called inside such a region; under plain
+GSPMD data-parallel the gradients are already globally reduced each step and
+LocalSGD degenerates to SGD (documented no-op)."""
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from ... import collective
+
+
+class LocalSGDOptimizer:
+    def __init__(self, inner_optimizer, k_steps=1, group=None,
+                 begin_step=1):
+        self._inner = inner_optimizer
+        self._k = int(k_steps)
+        self._group = group
+        self._begin = begin_step
+        self._local_step = Tensor(jnp.zeros((), jnp.int32))
+        self._local_step._mark_stateful()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        from ....jit import to_static as ts_mod
+        self._inner.step()
+        self._local_step._value = self._local_step._value + 1
+        if ts_mod.in_tracing():
+            # compiled step: branchless select (XLA's select is cheap; the
+            # pmean itself only exists inside explicitly shard_map'd regions)
+            trigger = jnp.logical_and(
+                (self._local_step._value % self._k) == 0,
+                self._local_step._value >= self._begin)
+            self._average_parameters(trigger)
+        else:
+            # eager: the step count is concrete — skip the comm entirely off
+            # the k-boundary (the comm saving LocalSGD exists for)
+            s = int(self._local_step._value)
+            if s >= self._begin and s % self._k == 0:
+                self._average_parameters(True)
+
+    def _average_parameters(self, trigger):
+        for p in self._inner._parameters():
+            t = Tensor(p._value)
+            collective.all_reduce(t, op=collective.ReduceOp.AVG,
+                                  group=self._group)
+            p._value = jnp.where(trigger, t._value, p._value)
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None

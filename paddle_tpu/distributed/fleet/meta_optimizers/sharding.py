@@ -1,0 +1,56 @@
+"""ZeRO optimizer-state sharding (reference:
+`fleet/meta_optimizers/sharding_optimizer.py:43` — segments the program,
+assigns each param's optimizer state to one sharding rank, prunes the rest,
+and inserts broadcasts; helpers `sharding/shard.py`, `sharding/prune.py`).
+
+TPU: assignment/pruning/broadcast are all replaced by a PartitionSpec on the
+accumulator: GSPMD materializes 1/N of each moment per chip and the compiled
+update runs sharded (grads arrive reduce-scattered to match). `stage>=3`
+additionally shards the parameters (see meta_parallel.sharding_parallel)."""
+from ..meta_parallel.sharding_parallel import shard_spec_for, _axis_degree
+from ..base import topology as topo_mod
+
+
+def shard_optimizer_state(optimizer, mesh=None, axis=topo_mod.AXIS_SHARD):
+    """Annotate every optimizer accumulator with a sharding PartitionSpec.
+    Returns number of accumulators sharded."""
+    if mesh is None:
+        hcg = topo_mod.get_hybrid_communicate_group()
+        mesh = hcg.mesh if hcg is not None else None
+    degree = _axis_degree(mesh, axis)
+    count = 0
+    for (_slot, _pid), acc in optimizer._accumulators.items():
+        spec = shard_spec_for(tuple(acc._value.shape), axis, degree)
+        if spec is not None:
+            acc.pspec = spec
+            count += 1
+    return count
+
+
+class DygraphShardingOptimizer:
+    """Reference-shaped wrapper: holds the inner optimizer whose state has
+    been sharded over the sharding axis."""
+
+    def __init__(self, inner_optimizer, hcg=None, axis=None):
+        self._inner = inner_optimizer
+        hcg = hcg or topo_mod.get_hybrid_communicate_group()
+        if axis is None:
+            axis = (topo_mod.AXIS_SHARD
+                    if hcg is not None
+                    and hcg.get_sharding_parallel_world_size() > 1
+                    else topo_mod.AXIS_DATA)
+        self._axis = axis
+        self._n_sharded = shard_optimizer_state(
+            inner_optimizer, mesh=hcg.mesh if hcg else None, axis=axis)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        return self._inner.minimize(loss, *a, **k)

@@ -6,4 +6,5 @@ from .mp_layers import (  # noqa: F401
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .tensor_parallel import TensorParallel  # noqa: F401
+from .sharding_parallel import ShardingParallel, shard_parameters  # noqa: F401
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

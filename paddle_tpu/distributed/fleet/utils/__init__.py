@@ -1,2 +1,3 @@
 """Fleet utils (reference: `fleet/utils/`)."""
 from .recompute import recompute  # noqa: F401
+from . import hybrid_parallel_util  # noqa: F401

@@ -1,0 +1,72 @@
+"""Hybrid-parallel utilities (reference: `fleet/utils/hybrid_parallel_util.py`:
+broadcast_mp_parameters:103, broadcast_dp_parameters:110,
+fused_allreduce_gradients:117, sharding_reduce_gradients:124).
+
+On a single-controller TPU mesh the parameter broadcasts are layout
+operations: replicated state is one logical array (GSPMD keeps the copies
+coherent), so "broadcast" means re-placing the value with a replicated
+sharding. The gradient fusions exist eagerly for API parity; under
+`to_static` XLA fuses/overlaps gradient collectives itself (the analog of
+reducer.cc bucketing).
+"""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ... import collective
+
+
+def _mesh(hcg):
+    return getattr(hcg, "mesh", None)
+
+
+def _replicate(tensor, mesh):
+    if mesh is None:
+        return
+    sharding = NamedSharding(mesh, tensor.pspec or PartitionSpec())
+    tensor._value = jax.device_put(tensor._value, sharding)
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """mp ranks must see identical inputs; one logical copy already does."""
+    return inputs if not kwargs else (inputs, kwargs)
+
+
+def broadcast_mp_parameters(model, hcg):
+    mesh = _mesh(hcg)
+    for p in model.parameters():
+        _replicate(p, mesh)
+
+
+def broadcast_dp_parameters(model, hcg):
+    mesh = _mesh(hcg)
+    for p in model.parameters():
+        _replicate(p, mesh)
+
+
+def broadcast_sharding_parameters(model, hcg):
+    mesh = _mesh(hcg)
+    for p in model.parameters():
+        _replicate(p, mesh)
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Eager dp grad average (reference :117 — _apply_collective_grads scales
+    by 1/nranks then allreduce-sums). Inside a shard_map'd step this lowers
+    to pmean over the dp axis; eagerly on one logical copy it is the
+    identity (mean over a single replica)."""
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    for p in parameter_list:
+        g = getattr(p, "_grad", None)
+        if g is None:
+            continue
+        from ....core.tensor import Tensor
+        gt = Tensor(g)
+        collective.all_reduce(gt, op=collective.ReduceOp.AVG, group=group)
+        p._grad = gt._value
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    """reference :124 — reduce grads into their owning sharding rank; on TPU
+    the reduce-scatter is emitted by GSPMD when grads land on sharded
+    accumulators, so the eager path is the same dp-mean."""
+    fused_allreduce_gradients(parameter_list, hcg)

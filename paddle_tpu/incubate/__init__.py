@@ -4,6 +4,9 @@ Also hosts TPU-first extensions beyond the reference's capability bar:
 ring attention (context parallelism) lives in paddle_tpu.parallel.
 """
 from ..nn.functional.activation import softmax  # noqa: F401
+from ..optimizer.averaging import (  # noqa: F401
+    ModelAverage, LookAhead,
+)
 
 
 def softmax_mask_fuse_upper_triangle(x):

@@ -4,3 +4,6 @@ from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Adadelta,
     Adamax, Lamb, Lars,
 )
+from .averaging import (  # noqa: F401
+    ModelAverage, ExponentialMovingAverage, LookAhead,
+)

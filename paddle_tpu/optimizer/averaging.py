@@ -1,0 +1,211 @@
+"""Parameter-averaging optimizers.
+
+Reference: ModelAverage `fluid/optimizer.py:3574` (+ paddle.incubate
+ModelAverage), EMA `fluid/optimizer.py:3883` (ExponentialMovingAverage),
+Lookahead `fluid/optimizer.py:6088` (+ incubate LookAhead). Each keeps shadow
+state as registered framework tensors so apply/restore trace into compiled
+steps like everything else.
+"""
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+
+def _shadow(param, init=None):
+    t = Tensor(param._value.astype(jnp.float32) if init is None
+               else jnp.asarray(init, jnp.float32))
+    t.persistable = True
+    t._mark_stateful()
+    return t
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters over a bounded window (reference:
+    fluid/optimizer.py:3574 — sum_1/sum_2/sum_3 block accumulators plus
+    num_accumulates/old_num_accumulates; here the same two-block scheme:
+    the current block rolls into `old` when it reaches the window bound
+    max(min_average_window, rate*num_updates) capped at max_average_window,
+    and the applied average spans both blocks)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        zeros = lambda p: _shadow(p, jnp.zeros(p._value.shape))
+        self._sum1 = {id(p): zeros(p) for p in self._parameters()}
+        self._sum2 = {id(p): zeros(p) for p in self._parameters()}
+        self._sum3 = {id(p): zeros(p) for p in self._parameters()}
+        self._num_accum = Tensor(jnp.zeros((), jnp.float32))
+        self._num_accum._mark_stateful()
+        self._old_num_accum = Tensor(jnp.zeros((), jnp.float32))
+        self._old_num_accum._mark_stateful()
+        self._num_updates = Tensor(jnp.zeros((), jnp.float32))
+        self._num_updates._mark_stateful()
+        self._saved = None
+
+    _KMAX_BLOCK = 16384.0  # reference kMaxNumAccumulates sum_1→sum_2 spill
+
+    def step(self):
+        self._num_updates._value = self._num_updates._value + 1.0
+        n = self._num_accum._value + 1.0
+        spill = (self._num_updates._value % self._KMAX_BLOCK) == 0
+        window = jnp.minimum(float(self._max_w),
+                             self._rate * self._num_updates._value)
+        restart = jnp.logical_and(n >= float(self._min_w), n >= window)
+        for p in self._parameters():
+            s1, s2, s3 = (self._sum1[id(p)], self._sum2[id(p)],
+                          self._sum3[id(p)])
+            acc1 = s1._value + p._value.astype(jnp.float32)
+            acc2 = jnp.where(spill, s2._value + acc1, s2._value)
+            acc1 = jnp.where(spill, jnp.zeros_like(acc1), acc1)
+            s3._value = jnp.where(restart, acc1 + acc2, s3._value)
+            s2._value = jnp.where(restart, jnp.zeros_like(acc2), acc2)
+            s1._value = jnp.where(restart, jnp.zeros_like(acc1), acc1)
+        self._old_num_accum._value = jnp.where(
+            restart, n, self._old_num_accum._value)
+        self._num_accum._value = jnp.where(restart, 0.0, n)
+
+    minimize = None  # applied alongside a real optimizer, not instead of it
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap params to their window average (context manager, like the
+        reference's `with model_average.apply(exe):`)."""
+        return self._apply_ctx(need_restore)
+
+    @contextlib.contextmanager
+    def _apply_ctx(self, need_restore):
+        self._saved = {id(p): p._value for p in self._parameters()}
+        total = self._num_accum._value + self._old_num_accum._value
+        for p in self._parameters():
+            acc = (self._sum1[id(p)]._value + self._sum2[id(p)]._value
+                   + self._sum3[id(p)]._value)
+            # no accumulation yet: leave the parameter untouched
+            avg = jnp.where(total > 0, acc / jnp.maximum(total, 1.0),
+                            p._value.astype(jnp.float32))
+            p._value = avg.astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._saved is not None:
+            for p in self._parameters():
+                if id(p) in self._saved:
+                    p._value = self._saved[id(p)]
+            self._saved = None
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference: fluid/optimizer.py:3883 — thirdly the
+    same decay/apply/restore/update surface, with optional Adam-style decay
+    ramp thres_steps)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._thres_steps = thres_steps
+        self._step = Tensor(jnp.zeros((), jnp.float32))
+        self._step._mark_stateful()
+        self._ema = {}
+        self._params = []
+        self._saved = None
+
+    def _track(self, parameters):
+        for p in parameters:
+            if id(p) not in self._ema:
+                self._params.append(p)
+                # zero-initialized shadow: the bias correction in apply()
+                # (1/(1-decay^t), as the reference) assumes it
+                self._ema[id(p)] = _shadow(p, jnp.zeros(p._value.shape))
+
+    def update(self, parameters=None):
+        if parameters is None:
+            from ..core import state as state_mod
+            from ..core.tensor import Parameter
+            parameters = [t for _, t in state_mod.snapshot()
+                          if isinstance(t, Parameter)]
+        self._track(parameters)
+        self._step._value = self._step._value + 1.0
+        decay = self._decay
+        if self._thres_steps is not None:
+            # ramp: min(decay, (1+t)/(10+t)) like the reference's thres path
+            t = self._step._value
+            decay = jnp.minimum(decay, (1.0 + t) / (10.0 + t))
+        for p in self._params:
+            e = self._ema[id(p)]
+            e._value = decay * e._value + (1.0 - decay) * p._value.astype(
+                jnp.float32)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._saved = {id(p): p._value for p in self._params}
+        # bias-corrected shadow (reference applies 1/(1-decay^t) correction)
+        t = self._step._value
+        corr = 1.0 - jnp.power(self._decay, jnp.maximum(t, 1.0))
+        for p in self._params:
+            corrected = self._ema[id(p)]._value / corr
+            # before any update() the shadow is empty: keep live weights
+            corrected = jnp.where(t > 0, corrected,
+                                  p._value.astype(jnp.float32))
+            p._value = corrected.astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._saved is not None:
+            for p in self._params:
+                if id(p) in self._saved:
+                    p._value = self._saved[id(p)]
+            self._saved = None
+
+
+class LookAhead:
+    """Lookahead wrapper (reference: fluid/optimizer.py:6088 / incubate
+    LookAhead): fast optimizer steps k times, then slow weights interpolate
+    slow += alpha*(fast-slow) and fast resets to slow. Branchless k-gate."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self._alpha = alpha
+        self._k = int(k)
+        self._la_step = Tensor(jnp.zeros((), jnp.int32))
+        self._la_step._mark_stateful()
+        self._slow = {id(p): _shadow(p)
+                      for p in inner_optimizer._parameters()}
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def _parameters(self):
+        return self.inner_optimizer._parameters()
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._la_step._value = self._la_step._value + 1
+        sync = (self._la_step._value % self._k) == 0
+        for p in self._parameters():
+            slow = self._slow[id(p)]
+            new_slow = slow._value + self._alpha * (
+                p._value.astype(jnp.float32) - slow._value)
+            slow._value = jnp.where(sync, new_slow, slow._value)
+            p._value = jnp.where(sync, new_slow.astype(p._value.dtype),
+                                 p._value)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
