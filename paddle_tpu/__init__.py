@@ -44,6 +44,10 @@ from . import autograd  # noqa: F401
 from . import inference  # noqa: F401
 from . import incubate  # noqa: F401
 
+from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401
+from .core.flags import set_flags, get_flags  # noqa: F401
+
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .serialization import save, load  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401

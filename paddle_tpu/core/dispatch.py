@@ -14,11 +14,33 @@ import jax.numpy as jnp
 from . import autograd
 from .dtype import is_floating
 
-__all__ = ["call_op", "call_op_nograd", "wrap", "unwrap", "_STATIC_HOOK"]
+__all__ = ["call_op", "call_op_nograd", "wrap", "unwrap", "_STATIC_HOOK",
+           "add_observer", "remove_observer"]
 
 # When paddle.static program_guard is active, this holds Program.record and
 # every op call is captured into the program instead of the autograd tape.
 _STATIC_HOOK = [None]
+
+# Op observers (profiler RecordEvent, FLAGS_check_nan_inf checker): each has
+# begin(name)->token and end(token, name, outputs). Kept in a dict keyed by
+# observer name; _OBSERVER_LIST is the flat fast-path view (None when empty so
+# the hot path is a single truthiness check). Reference analog: every
+# OperatorBase::Run wrapping itself in RecordEvent (platform/profiler.h:127)
+# and the nan_inf_utils post-op hook (framework/details/nan_inf_utils.h:29).
+_OBSERVERS = {}
+_OBSERVER_LIST = None
+
+
+def add_observer(key, obs):
+    global _OBSERVER_LIST
+    _OBSERVERS[key] = obs
+    _OBSERVER_LIST = list(_OBSERVERS.values())
+
+
+def remove_observer(key):
+    global _OBSERVER_LIST
+    _OBSERVERS.pop(key, None)
+    _OBSERVER_LIST = list(_OBSERVERS.values()) or None
 
 
 def _is_tensor(x):
@@ -60,6 +82,19 @@ def _substitute(args, kwargs, positions, values, op_name=None):
     return flat_args, new_kwargs
 
 
+def _observed(name, run):
+    """Run `run()` under the registered op observers."""
+    obs = _OBSERVER_LIST
+    if obs is None:
+        return run()
+    pairs = [(o, o.begin(name)) for o in obs]
+    out = run()
+    flat = out if isinstance(out, tuple) else (out,)
+    for o, tok in pairs:
+        o.end(tok, name, flat)
+    return out
+
+
 def call_op(fn, *args, op_name=None, **kwargs):
     """Run `fn(*arrays, **kwargs)` with autograd recording.
 
@@ -68,6 +103,14 @@ def call_op(fn, *args, op_name=None, **kwargs):
     over as a constant. Multi-output fns must return only floating-point
     outputs (mixed-dtype ops are built as composites in the ops library).
     """
+    if _OBSERVER_LIST is not None and _STATIC_HOOK[0] is None:
+        name = op_name or getattr(fn, "__name__", "op")
+        return _observed(
+            name, lambda: _call_op_impl(fn, *args, op_name=op_name, **kwargs))
+    return _call_op_impl(fn, *args, op_name=op_name, **kwargs)
+
+
+def _call_op_impl(fn, *args, op_name=None, **kwargs):
     if _STATIC_HOOK[0] is not None:
         return _STATIC_HOOK[0](fn, args, kwargs, op_name)
 
@@ -83,7 +126,7 @@ def call_op(fn, *args, op_name=None, **kwargs):
                 diff_tensors.append(v)
 
     if not diff_tensors:
-        return call_op_nograd(fn, *args, op_name=op_name, **kwargs)
+        return _call_op_nograd_impl(fn, *args, op_name=op_name, **kwargs)
 
     name = op_name or getattr(fn, "__name__", "op")
 
@@ -111,6 +154,15 @@ def call_op(fn, *args, op_name=None, **kwargs):
 
 def call_op_nograd(fn, *args, op_name=None, **kwargs):
     """Run without recording (non-diff inputs, no_grad scope, or int ops)."""
+    if _OBSERVER_LIST is not None and _STATIC_HOOK[0] is None:
+        name = op_name or getattr(fn, "__name__", "op")
+        return _observed(
+            name,
+            lambda: _call_op_nograd_impl(fn, *args, op_name=op_name, **kwargs))
+    return _call_op_nograd_impl(fn, *args, op_name=op_name, **kwargs)
+
+
+def _call_op_nograd_impl(fn, *args, op_name=None, **kwargs):
     if _STATIC_HOOK[0] is not None:
         return _STATIC_HOOK[0](fn, args, kwargs, op_name)
     a = _amp_cast(op_name or getattr(fn, "__name__", "op"),

@@ -1,0 +1,145 @@
+"""Global flags (reference: `paddle/fluid/platform/flags.cc` gflags registry +
+`pybind/global_value_getter_setter.cc`, exposed as `paddle.set_flags` /
+`paddle.get_flags`; env override via FLAGS_* like the reference).
+
+The registry itself lives in the native runtime (pt_flag_set/get in
+`_native/src/pt_runtime.cc`) so C++ components and Python see one store; a
+python dict mirrors it for the no-toolchain fallback.
+
+FLAGS_check_nan_inf (reference `platform/flags.cc:44` →
+`framework/details/nan_inf_utils*.cc`) installs a post-op observer that scans
+every eager op output on host — the native scanner handles f32/f64/bf16/f16
+buffers — and raises on the first non-finite value, naming the op.
+"""
+import os
+
+import numpy as np
+
+from .. import _native
+from . import dispatch
+
+_py_flags = {}
+
+_KNOWN_DEFAULTS = {
+    "FLAGS_check_nan_inf": "0",
+    "FLAGS_benchmark": "0",
+    "FLAGS_eager_delete_tensor_gb": "0",
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": "0",
+    "FLAGS_use_system_allocator": "0",
+    "FLAGS_paddle_num_threads": "1",
+}
+
+
+def _store_set(name, value):
+    value = str(value) if not isinstance(value, bool) else ("1" if value else "0")
+    _py_flags[name] = value
+    L = _native.lib()
+    if L is not None:
+        L.pt_flag_set(name.encode(), value.encode())
+
+
+def _store_get(name):
+    import ctypes
+    L = _native.lib()
+    if L is not None:
+        buf = ctypes.create_string_buffer(4096)
+        n = L.pt_flag_get(name.encode(), buf, len(buf))
+        if n >= 0:
+            return buf.raw[: min(n, len(buf) - 1)].decode()
+    if name in _py_flags:
+        return _py_flags[name]
+    if name in os.environ:  # FLAGS_* env override, like gflags env parsing
+        return os.environ[name]
+    return _KNOWN_DEFAULTS.get(name)
+
+
+def set_flags(flags):
+    """paddle.set_flags({'FLAGS_check_nan_inf': 1})."""
+    if not isinstance(flags, dict):
+        raise TypeError("set_flags expects a dict of FLAGS_* -> value")
+    for k, v in flags.items():
+        _store_set(k, v)
+        if k == "FLAGS_check_nan_inf":
+            _sync_nan_check()
+
+
+def get_flags(flags):
+    """paddle.get_flags(['FLAGS_check_nan_inf']) -> dict."""
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _coerce(_store_get(k)) for k in flags}
+
+
+def _coerce(v):
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+def _truthy(v):
+    return str(v).lower() not in ("0", "false", "", "none")
+
+
+class NanInfObserver:
+    """Post-op output scan (reference: CheckVarHasNanOrInf
+    nan_inf_utils.h:29; dygraph hook :44). Forces a host sync per op — debug
+    mode only, exactly like the reference."""
+
+    def begin(self, name):
+        return None
+
+    def end(self, token, name, outputs):
+        for i, o in enumerate(outputs):
+            v = getattr(o, "_value", o)
+            if not hasattr(v, "dtype"):
+                continue
+            kind = str(v.dtype)
+            if kind not in ("float32", "float64", "bfloat16", "float16"):
+                continue
+            bad = _count_nonfinite(v, kind)
+            if bad:
+                raise FloatingPointError(
+                    f"Operator `{name}` output {i} contains {bad} NaN/Inf "
+                    f"value(s) (shape {tuple(v.shape)}, dtype {kind}). "
+                    f"Set FLAGS_check_nan_inf=0 to disable this check.")
+
+
+def _count_nonfinite(v, kind):
+    arr = np.asarray(v)
+    L = _native.lib()
+    if L is not None and arr.flags["C_CONTIGUOUS"]:
+        p, n = arr.ctypes.data, arr.size
+        if kind == "float32":
+            return L.pt_count_nonfinite_f32(p, n)
+        if kind == "float64":
+            return L.pt_count_nonfinite_f64(p, n)
+        if kind == "bfloat16":
+            return L.pt_count_nonfinite_bf16(p, n)
+        if kind == "float16":
+            return L.pt_count_nonfinite_f16(p, n)
+    # bf16/f16 are exactly representable in f32; f32/f64 keep their own dtype
+    # so large finite f64 values are not miscounted as overflow-to-inf.
+    if kind in ("bfloat16", "float16"):
+        arr = arr.astype(np.float32)
+    with np.errstate(all="ignore"):
+        return int((~np.isfinite(arr)).sum())
+
+
+def _sync_nan_check():
+    if _truthy(_store_get("FLAGS_check_nan_inf")):
+        dispatch.add_observer("nan_inf", NanInfObserver())
+    else:
+        dispatch.remove_observer("nan_inf")
+
+
+# honor the env var at import, like gflags env parsing
+if _truthy(os.environ.get("FLAGS_check_nan_inf", "0")):
+    _store_set("FLAGS_check_nan_inf", "1")
+    _sync_nan_check()

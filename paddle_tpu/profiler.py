@@ -1,0 +1,216 @@
+"""Host profiler + op tracing.
+
+TPU-native analog of the reference's profiler stack
+(`paddle/fluid/platform/profiler.{h,cc}` RecordEvent profiler.h:127,
+EnableProfiler :213; Python front `python/paddle/fluid/profiler.py:314`).
+The CUPTI GPU timeline (`platform/device_tracer.cc`) maps to JAX's XPlane
+trace (`jax.profiler.start_trace`) for device-side kernels; host-side op
+dispatch events are recorded by the native C++ runtime
+(`paddle_tpu/_native/src/pt_runtime.cc`) and exported as chrome://tracing
+JSON, the same consumption format as the reference's timeline tool.
+"""
+import contextlib
+import os
+import threading
+
+from . import _native
+from .core import dispatch
+
+__all__ = [
+    "RecordEvent", "profiler", "start_profiler", "stop_profiler",
+    "export_chrome_tracing", "summary", "Profiler",
+]
+
+_fallback_events = []  # [(name, cat, start_ns, end_ns, tid)] when no native lib
+_fallback_enabled = [False]
+
+
+def _now_ns():
+    L = _native.lib()
+    if L is not None:
+        return L.pt_prof_now_ns()
+    import time
+    return time.monotonic_ns()
+
+
+def _record(name, cat, start_ns, end_ns):
+    tid = threading.get_ident() % (1 << 31)
+    L = _native.lib()
+    if L is not None:
+        L.pt_prof_event(name.encode(), cat.encode(), start_ns, end_ns, tid)
+    elif _fallback_enabled[0]:
+        _fallback_events.append((name, cat, start_ns, end_ns, tid))
+
+
+def _enabled():
+    L = _native.lib()
+    if L is not None:
+        return bool(L.pt_prof_enabled())
+    return _fallback_enabled[0]
+
+
+class RecordEvent:
+    """RAII host event (reference: `RecordEvent` profiler.h:127)."""
+
+    def __init__(self, name, cat="user"):
+        self.name = name
+        self.cat = cat
+        self._t0 = None
+
+    def __enter__(self):
+        if _enabled():
+            self._t0 = _now_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            _record(self.name, self.cat, self._t0, _now_ns())
+        return False
+
+    # paddle.profiler.RecordEvent also supports begin()/end()
+    begin = __enter__
+
+    def end(self):
+        self.__exit__()
+
+
+class _OpProfObserver:
+    """Installed into core.dispatch while profiling: one X event per op."""
+
+    def begin(self, name):
+        return _now_ns()
+
+    def end(self, token, name, outputs):
+        _record(name, "op", token, _now_ns())
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    """reference: fluid/profiler.py start_profiler:190."""
+    L = _native.lib()
+    if L is not None:
+        L.pt_prof_enable()
+    else:
+        _fallback_enabled[0] = True
+    dispatch.add_observer("profiler", _OpProfObserver())
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """reference: fluid/profiler.py stop_profiler:257. Prints the aggregated
+    per-op table (the PrintProfiler analog) and keeps events for export."""
+    dispatch.remove_observer("profiler")
+    L = _native.lib()
+    if L is not None:
+        L.pt_prof_disable()
+    else:
+        _fallback_enabled[0] = False
+    if sorted_key:
+        print(summary())
+
+
+def export_chrome_tracing(path):
+    """Write accumulated events as chrome://tracing JSON; returns event count."""
+    L = _native.lib()
+    if L is not None:
+        return int(L.pt_prof_export(path.encode()))
+    import json
+    evs = [{"name": n, "cat": c, "ph": "X", "ts": s / 1e3,
+            "dur": (e - s) / 1e3, "pid": os.getpid(), "tid": t}
+           for (n, c, s, e, t) in _fallback_events]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evs}, f)
+    return len(evs)
+
+
+def reset():
+    L = _native.lib()
+    if L is not None:
+        L.pt_prof_clear()
+    _fallback_events.clear()
+
+
+def summary():
+    """Aggregated per-op table: name, calls, total ms, max ms (sorted by
+    total). reference: profiler.cc PrintProfiler."""
+    import ctypes
+    L = _native.lib()
+    rows = []
+    if L is not None:
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = L.pt_prof_summary(buf, len(buf))
+        text = buf.raw[: min(n, len(buf) - 1)].decode()
+        if not text.endswith("\n"):  # truncated: drop the partial last row
+            text = text[: text.rfind("\n") + 1]
+        for line in text.splitlines():
+            name, calls, total, mx = line.split("\t")
+            rows.append((name, int(calls), int(total), int(mx)))
+    else:
+        agg = {}
+        for (name, _c, s, e, _t) in _fallback_events:
+            a = agg.setdefault(name, [0, 0, 0])
+            a[0] += 1
+            a[1] += e - s
+            a[2] = max(a[2], e - s)
+        rows = sorted(((k, v[0], v[1], v[2]) for k, v in agg.items()),
+                      key=lambda r: -r[2])
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Max(ms)':>12}"]
+    for name, calls, total, mx in rows:
+        lines.append(f"{name:<40}{calls:>8}{total/1e6:>12.3f}{mx/1e6:>12.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path="/tmp/profile"):
+    """reference: fluid/profiler.py profiler:314 context manager."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class Profiler:
+    """paddle.profiler.Profiler-shaped API (2.x). `targets` accepting CPU/TPU;
+    device-side tracing delegates to jax.profiler when a trace dir is given."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 trace_dir=None):
+        self.on_trace_ready = on_trace_ready
+        self.trace_dir = trace_dir
+        self._jax_trace = False
+        self._step = 0
+
+    def start(self):
+        start_profiler()
+        if self.trace_dir:
+            try:
+                import jax
+                jax.profiler.start_trace(self.trace_dir)
+                self._jax_trace = True
+            except Exception:
+                self._jax_trace = False
+
+    def stop(self):
+        if self._jax_trace:
+            import jax
+            jax.profiler.stop_trace()
+            self._jax_trace = False
+        stop_profiler()
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self):
+        self._step += 1
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, **kwargs):
+        return summary()
+
+    def export(self, path, format="json"):
+        return export_chrome_tracing(path)

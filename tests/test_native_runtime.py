@@ -1,0 +1,160 @@
+"""Native runtime (C++ libpaddle_tpu_rt) + profiler/flags/monitor fronts.
+
+Mirrors the reference's platform-layer tests (profiler_test.cc,
+monitor coverage, nan_inf checks via FLAGS_check_nan_inf).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import _native, monitor, profiler
+
+
+def test_native_library_builds():
+    # the toolchain is baked into the image; the native runtime must be real
+    assert _native.AVAILABLE, f"native build failed: {_native._build_err}"
+    assert _native.lib().pt_runtime_version() == 1
+
+
+def test_monitor_counters():
+    monitor.stat_reset("STAT_test_total")
+    monitor.stat_add("STAT_test_total", 5)
+    monitor.stat_add("STAT_test_total", 7)
+    assert monitor.stat_get("STAT_test_total") == 12
+    assert monitor.stats()["STAT_test_total"] == 12
+    monitor.stat_reset("STAT_test_total")
+    assert monitor.stat_get("STAT_test_total") == 0
+
+
+def test_flags_roundtrip():
+    paddle.set_flags({"FLAGS_paddle_num_threads": 4})
+    assert paddle.get_flags(["FLAGS_paddle_num_threads"]) == {
+        "FLAGS_paddle_num_threads": 4}
+    # unknown-but-set flags round-trip as strings
+    paddle.set_flags({"FLAGS_custom_thing": "abc"})
+    assert paddle.get_flags("FLAGS_custom_thing")["FLAGS_custom_thing"] == "abc"
+
+
+def test_profiler_records_ops(tmp_path):
+    profiler.reset()
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    with profiler.profiler():
+        y = paddle.matmul(x, x)
+        z = paddle.add(y, x)
+        _ = z.numpy()
+    path = str(tmp_path / "trace.json")
+    n = profiler.export_chrome_tracing(path)
+    assert n >= 2
+    trace = json.load(open(path))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert any("matmul" in s for s in names)
+    table = profiler.summary()
+    assert "matmul" in table
+    profiler.reset()
+
+
+def test_record_event_user_scope(tmp_path):
+    profiler.reset()
+    profiler.start_profiler()
+    with profiler.RecordEvent("my_scope"):
+        pass
+    profiler.stop_profiler()
+    path = str(tmp_path / "t.json")
+    profiler.export_chrome_tracing(path)
+    names = {e["name"] for e in json.load(open(path))["traceEvents"]}
+    assert "my_scope" in names
+    profiler.reset()
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": 1})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        _ = paddle.add(x, x)  # finite: fine
+        bad = paddle.to_tensor(np.array([1.0, np.inf], np.float32))
+        with pytest.raises(FloatingPointError, match="divide|add|NaN/Inf"):
+            _ = paddle.add(bad, bad)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": 0})
+    # disabled again: no raise
+    bad = paddle.to_tensor(np.array([np.nan], np.float32))
+    _ = paddle.add(bad, bad)
+
+
+def test_nonfinite_scanners_native():
+    if not _native.AVAILABLE:
+        pytest.skip("no native lib")
+    L = _native.lib()
+    a32 = np.array([1, np.nan, np.inf, -np.inf, 0], np.float32)
+    assert L.pt_count_nonfinite_f32(a32.ctypes.data, a32.size) == 3
+    a64 = a32.astype(np.float64)
+    assert L.pt_count_nonfinite_f64(a64.ctypes.data, a64.size) == 3
+    import jax.numpy as jnp
+    b16 = np.asarray(jnp.array(a32, dtype=jnp.bfloat16)).view(np.uint16)
+    b16 = np.ascontiguousarray(b16)
+    assert L.pt_count_nonfinite_bf16(b16.ctypes.data, b16.size) == 3
+    f16 = a32.astype(np.float16).view(np.uint16)
+    assert L.pt_count_nonfinite_f16(np.ascontiguousarray(f16).ctypes.data,
+                                    f16.size) == 3
+
+
+def test_shm_ring_roundtrip():
+    if not _native.AVAILABLE:
+        pytest.skip("no native lib")
+    L = _native.lib()
+    import ctypes
+    name = f"/pt_ring_test_{os.getpid()}".encode()
+    r = L.pt_ring_create(name, 1 << 16)
+    assert r
+    try:
+        payload = np.arange(100, dtype=np.float32).tobytes()
+        assert L.pt_ring_write(r, payload, len(payload), 1000) == 0
+        n = L.pt_ring_next_len(r, 1000)
+        assert n == len(payload)
+        buf = ctypes.create_string_buffer(n)
+        assert L.pt_ring_read(r, buf, n) == n
+        out = np.frombuffer(buf.raw, np.float32)
+        np.testing.assert_array_equal(out, np.arange(100, dtype=np.float32))
+        # close-producer drains to -2
+        L.pt_ring_close_producer(r)
+        assert L.pt_ring_next_len(r, 100) == -2
+    finally:
+        L.pt_ring_free(r, 1)
+
+
+def test_shm_ring_cross_process():
+    if not _native.AVAILABLE:
+        pytest.skip("no native lib")
+    L = _native.lib()
+    import ctypes
+    name = f"/pt_ring_xp_{os.getpid()}".encode()
+    r = L.pt_ring_create(name, 1 << 20)
+    pid = os.fork()
+    if pid == 0:  # child: producer
+        try:
+            Lc = _native.lib()
+            rc = Lc.pt_ring_open(name)
+            for i in range(10):
+                msg = np.full(1000, i, np.int64).tobytes()
+                Lc.pt_ring_write(rc, msg, len(msg), 5000)
+            Lc.pt_ring_close_producer(rc)
+            Lc.pt_ring_free(rc, 0)
+        finally:
+            os._exit(0)
+    try:
+        got = []
+        while True:
+            n = L.pt_ring_next_len(r, 5000)
+            if n == -2:
+                break
+            assert n == 8000
+            buf = ctypes.create_string_buffer(n)
+            L.pt_ring_read(r, buf, n)
+            got.append(int(np.frombuffer(buf.raw, np.int64)[0]))
+        assert got == list(range(10))
+    finally:
+        os.waitpid(pid, 0)
+        L.pt_ring_free(r, 1)
