@@ -46,6 +46,11 @@ from . import incubate  # noqa: F401
 
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401
+from . import distribution  # noqa: F401
+from . import text  # noqa: F401
+from . import dataset  # noqa: F401
+from . import quantization  # noqa: F401
+from . import sparsity  # noqa: F401
 from .core.flags import set_flags, get_flags  # noqa: F401
 
 from .nn.layer.layers import ParamAttr  # noqa: F401

@@ -13,6 +13,8 @@ from .base.role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa:
 from . import meta_parallel  # noqa: F401
 from . import meta_optimizers  # noqa: F401
 from . import utils  # noqa: F401
+from . import elastic  # noqa: F401
+from .elastic import ElasticManager  # noqa: F401
 from .utils import recompute  # noqa: F401
 
 from .base import fleet_base as _fb

@@ -1,0 +1,161 @@
+"""Elastic training manager (reference: `python/paddle/distributed/fleet/
+elastic.py` — ElasticManager:99, watch:316: etcd node registry, fault watch,
+re-rank and relaunch).
+
+TPU re-design: the KV store is pluggable. `FileKVStore` (a shared directory,
+e.g. NFS/GCS-fuse) is the built-in backend — heartbeat files with mtime TTL
+replace etcd leases; an etcd-shaped client can be passed instead. Membership
+changes re-rank hosts deterministically (sorted endpoints) and invoke the
+relaunch callback, matching the reference's scale-in/scale-out semantics.
+"""
+import os
+import threading
+import time
+
+__all__ = ["FileKVStore", "ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class FileKVStore:
+    """etcd-shaped KV on a shared directory (lease = heartbeat mtime)."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        return os.path.join(self.root, key.replace("/", "__"))
+
+    def put(self, key, value):
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(value)
+        os.replace(tmp, self._path(key))
+
+    def refresh(self, key):
+        try:
+            os.utime(self._path(key))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def get(self, key):
+        try:
+            with open(self._path(key)) as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, key):
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix, ttl=None):
+        """Live keys under prefix (mtime within ttl seconds)."""
+        pre = prefix.replace("/", "__")
+        out = {}
+        now = time.time()
+        for name in os.listdir(self.root):
+            if not name.startswith(pre) or name.endswith(".tmp"):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                if ttl is not None and now - os.path.getmtime(path) > ttl:
+                    continue
+                with open(path) as f:
+                    out[name.replace("__", "/")] = f.read()
+            except FileNotFoundError:
+                continue
+        return out
+
+
+class ElasticManager:
+    """Membership + fault watch + re-rank (reference: elastic.py:99).
+
+    env contract (reference :109-136): PADDLE_ELASTIC_NP (target node count),
+    PADDLE_ELASTIC_JOB_ID, heartbeat TTL. The store can be a FileKVStore or
+    any object with put/refresh/list/delete.
+    """
+
+    def __init__(self, endpoint, np=None, job_id=None, store=None,
+                 ttl=10, heartbeat_interval=2):
+        self.endpoint = endpoint
+        self.np = int(np or os.environ.get("PADDLE_ELASTIC_NP", "1"))
+        self.job_id = job_id or os.environ.get("PADDLE_ELASTIC_JOB_ID",
+                                               "default")
+        root = os.environ.get("PADDLE_ELASTIC_STORE_DIR",
+                              "/tmp/paddle_tpu_elastic")
+        self.store = store or FileKVStore(os.path.join(root, self.job_id))
+        self.ttl = ttl
+        self.hb_interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._key = f"nodes/{self.endpoint}"
+
+    # -- membership ---------------------------------------------------------
+    def register(self):
+        self.store.put(self._key, self.endpoint)
+        self._hb_thread = threading.Thread(target=self._heartbeat,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat(self):
+        while not self._stop.wait(self.hb_interval):
+            if not self.store.refresh(self._key):
+                self.store.put(self._key, self.endpoint)
+
+    def live_nodes(self):
+        return sorted(self.store.list("nodes/", ttl=self.ttl).values())
+
+    def rank(self):
+        """Deterministic re-rank: position in the sorted live endpoints."""
+        nodes = self.live_nodes()
+        return nodes.index(self.endpoint) if self.endpoint in nodes else -1
+
+    def ready(self):
+        return len(self.live_nodes()) >= self.np
+
+    def wait_ready(self, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.ready():
+                return True
+            time.sleep(0.2)
+        return False
+
+    # -- fault watch --------------------------------------------------------
+    def watch(self, interval=1.0, on_change=None, max_iter=None,
+              baseline=None):
+        """Block until membership changes vs `baseline` (default: the
+        membership at call time); returns (status, live_nodes).
+        reference: elastic.py watch:316."""
+        if baseline is None:
+            baseline = self.live_nodes()
+        i = 0
+        while True:
+            time.sleep(interval)
+            cur = self.live_nodes()
+            if cur != baseline:
+                status = (ElasticStatus.RESTART if len(cur) >= self.np
+                          else ElasticStatus.HOLD)
+                if on_change:
+                    on_change(status, cur)
+                return status, cur
+            i += 1
+            if max_iter is not None and i >= max_iter:
+                return ElasticStatus.COMPLETED, cur
+
+    def exit(self):
+        self._stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=self.hb_interval + 1)
+        self.store.delete(self._key)

@@ -1,3 +1,5 @@
 """Fleet utils (reference: `fleet/utils/`)."""
 from .recompute import recompute  # noqa: F401
 from . import hybrid_parallel_util  # noqa: F401
+from . import fs  # noqa: F401
+from .fs import LocalFS, HDFSClient  # noqa: F401

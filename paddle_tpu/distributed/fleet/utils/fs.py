@@ -1,0 +1,140 @@
+"""Filesystem abstraction (reference: `python/paddle/distributed/fleet/
+utils/fs.py` — FS base, LocalFS, HDFSClient over `framework/io/fs.cc`).
+
+TPU re-design: LocalFS covers local + fuse-mounted cloud storage (GCS/NFS),
+which is the normal TPU-pod layout; HDFSClient keeps the reference's API
+shape, shelling out to `hadoop fs` when a hadoop env is configured.
+"""
+import os
+import shutil
+import subprocess
+
+__all__ = ["LocalFS", "HDFSClient", "FSFileExistsError", "FSFileNotExistsError"]
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class LocalFS:
+    """reference: fs.py LocalFS."""
+
+    def ls_dir(self, path):
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite=False):
+        if not self.is_exist(src):
+            raise FSFileNotExistsError(src)
+        if self.is_exist(dst):
+            if not overwrite:
+                raise FSFileExistsError(dst)
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path) and not exist_ok:
+            raise FSFileExistsError(path)
+        open(path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+class HDFSClient:
+    """reference: fs.py HDFSClient — shells out to `hadoop fs` (the C++
+    framework/io/fs.cc does the same via popen)."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._configs = configs or {}
+
+    def _run(self, *args):
+        cfg = []
+        for k, v in self._configs.items():
+            cfg += ["-D", f"{k}={v}"]
+        cmd = [self._hadoop, "fs"] + cfg + list(args)
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=300)
+        except FileNotFoundError:
+            raise RuntimeError(
+                "hadoop binary not found; configure hadoop_home or use "
+                "LocalFS (fuse-mounted storage) on TPU hosts")
+        return res.returncode, res.stdout
+
+    def is_exist(self, path):
+        rc, _ = self._run("-test", "-e", path)
+        return rc == 0
+
+    def is_dir(self, path):
+        rc, _ = self._run("-test", "-d", path)
+        return rc == 0
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def ls_dir(self, path):
+        rc, out = self._run("-ls", path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", "-f", path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite:
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)

@@ -1,0 +1,214 @@
+"""Multi-process launcher — `python -m paddle_tpu.distributed.launch`.
+
+Reference: `python/paddle/distributed/launch.py` +
+`fleet/launch_utils.py` (Cluster:59, Pod:173, start_local_trainers:453,
+watch_local_trainers:565) and the env contract `distributed/parallel.py:140`
+(PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT).
+
+TPU re-design: on TPU one process drives all local chips, so `--nproc_per_node`
+defaults to 1 and multi-node runs get JAX coordination-service env
+(JAX_COORDINATOR_ADDRESS / process count / id) derived from the same
+endpoint list — the reference's NCCL-id TCP rendezvous maps to the JAX/PJRT
+coordination service. Multi-process-per-node remains available for
+CPU-simulated mesh testing (each proc gets XLA_FLAGS host-device counts).
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["Cluster", "Pod", "Trainer", "get_cluster",
+           "start_local_trainers", "watch_local_trainers", "main"]
+
+
+class Trainer:
+    def __init__(self, rank, endpoint, gpus=()):
+        self.rank = rank
+        self.endpoint = endpoint
+        self.accelerators = list(gpus)
+
+    def __repr__(self):
+        return f"Trainer(rank={self.rank}, endpoint={self.endpoint})"
+
+
+class Pod:
+    """One node's worth of trainers (reference: launch_utils.py Pod:173)."""
+
+    def __init__(self, addr="127.0.0.1"):
+        self.addr = addr
+        self.trainers = []
+
+    def rank_of(self, trainer):
+        return trainer.rank
+
+
+class Cluster:
+    """All pods (reference: launch_utils.py Cluster:59)."""
+
+    def __init__(self, pods=None):
+        self.pods = pods or []
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def world_device_ids(self):
+        return [t.accelerators for p in self.pods for t in p.trainers]
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, nproc_per_node):
+    cluster = Cluster()
+    rank = 0
+    for ip in node_ips:
+        pod = Pod(ip)
+        for _ in range(nproc_per_node):
+            pod.trainers.append(Trainer(rank, trainer_endpoints[rank]))
+            rank += 1
+        cluster.pods.append(pod)
+    return cluster
+
+
+class TrainerProc:
+    def __init__(self, proc, rank, log_f=None):
+        self.proc = proc
+        self.rank = rank
+        self.log_f = log_f
+
+
+def start_local_trainers(cluster, pod, training_script, training_script_args,
+                         log_dir=None, envs=None):
+    """Spawn one POSIX process per local trainer with the env contract
+    (reference: launch_utils.py start_local_trainers:453)."""
+    procs = []
+    endpoints = cluster.trainers_endpoints()
+    coordinator = endpoints[0].rsplit(":", 1) if endpoints else None
+    for t in pod.trainers:
+        env = dict(os.environ)
+        env.update(envs or {})
+        env.update({
+            "PADDLE_TRAINER_ID": str(t.rank),
+            "PADDLE_CURRENT_ENDPOINT": t.endpoint,
+            "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            # JAX coordination-service mapping (multi-host bring-up)
+            "JAX_COORDINATOR_ADDRESS": endpoints[0],
+            "JAX_NUM_PROCESSES": str(cluster.trainers_nranks()),
+            "JAX_PROCESS_ID": str(t.rank),
+        })
+        cmd = [sys.executable, "-u", training_script] + \
+            list(training_script_args)
+        log_f = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            log_f = open(os.path.join(log_dir, f"workerlog.{t.rank}"), "w")
+        proc = subprocess.Popen(cmd, env=env, stdout=log_f or None,
+                                stderr=subprocess.STDOUT if log_f else None)
+        procs.append(TrainerProc(proc, t.rank, log_f))
+    return procs
+
+
+def watch_local_trainers(procs, nranks=None):
+    """Poll children; on any failure terminate the rest and raise
+    (reference: launch_utils.py watch_local_trainers:565 — abort-all on
+    first failure). Returns the list of still-alive procs; [] when all
+    exited cleanly."""
+    alive = []
+    for tp in procs:
+        ret = tp.proc.poll()
+        if ret is None:
+            alive.append(tp)
+        elif ret != 0:
+            terminate_local_procs(procs)
+            raise RuntimeError(
+                f"trainer rank {tp.rank} failed with exit code {ret}; "
+                f"aborted remaining trainers")
+        else:
+            if tp.log_f:
+                tp.log_f.close()
+    return alive
+
+
+def terminate_local_procs(procs):
+    for tp in procs:
+        if tp.proc.poll() is None:
+            try:
+                tp.proc.terminate()
+            except OSError:
+                pass
+    deadline = time.time() + 5
+    for tp in procs:
+        try:
+            tp.proc.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            tp.proc.kill()
+        if tp.log_f:
+            tp.log_f.close()
+
+
+def _parse_args(argv):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch")
+    p.add_argument("--ips", default="127.0.0.1",
+                   help="comma-separated node ips")
+    p.add_argument("--node_rank", type=int, default=None,
+                   help="this node's index in --ips (default: from "
+                        "PADDLE_NODE_RANK env, else 0)")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--host_devices", type=int, default=0,
+                   help="if >0, set XLA host-platform device count per proc "
+                        "(CPU-simulated mesh testing)")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs="...")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    ips = args.ips.split(",")
+    endpoints = []
+    for ip in ips:
+        for i in range(args.nproc_per_node):
+            endpoints.append(f"{ip}:{args.started_port + i}")
+    node_rank = args.node_rank
+    if node_rank is None:
+        node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
+    if not 0 <= node_rank < len(ips):
+        raise SystemExit(f"--node_rank {node_rank} out of range for "
+                         f"{len(ips)} node(s) in --ips")
+    cluster = get_cluster(ips, ips[node_rank], endpoints,
+                          args.nproc_per_node)
+    pod = cluster.pods[node_rank]  # this launcher manages only its own node
+
+    envs = {}
+    if args.host_devices:
+        envs["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                             f" --xla_force_host_platform_device_count="
+                             f"{args.host_devices}").strip()
+        envs["JAX_PLATFORMS"] = "cpu"
+
+    procs = start_local_trainers(cluster, pod, args.training_script,
+                                 args.training_script_args,
+                                 log_dir=args.log_dir, envs=envs)
+
+    def on_sig(signum, frame):
+        terminate_local_procs(procs)
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, on_sig)
+    signal.signal(signal.SIGINT, on_sig)
+
+    while True:
+        procs = watch_local_trainers(procs)
+        if not procs:
+            return 0
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

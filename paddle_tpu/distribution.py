@@ -1,0 +1,195 @@
+"""Probability distributions (reference: `python/paddle/distribution.py` —
+Distribution:42, Uniform:169, Normal:391, Categorical:641).
+
+TPU re-design: sampling draws from the framework's stateless threefry RNG
+stream (core.random) instead of per-op seeds, so samples are reproducible
+under `paddle.seed` and correct under jit/vmap; math is plain jnp, which XLA
+fuses into surrounding computation.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import call_op, call_op_nograd, unwrap, wrap
+from .core.random import next_key
+from .core.tensor import Tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _as_tensor(x, dtype=jnp.float32):
+    """Keep user Tensors intact (so grads flow to them); lift scalars/arrays."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype=dtype))
+
+
+class Distribution:
+    """Base class (reference: distribution.py:42)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference: distribution.py:169)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+        self.name = name or "Uniform"
+
+    def sample(self, shape, seed=0):
+        import jax
+        key = jax.random.PRNGKey(seed) if seed else next_key()
+        lo, hi = self.low._value, self.high._value
+        base = jnp.broadcast_shapes(lo.shape, hi.shape)
+        u = jax.random.uniform(key, tuple(shape) + base, dtype=jnp.float32)
+        return wrap(lo + u * (hi - lo))
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            lp = -jnp.log(hi - lo)
+            return jnp.where(inside, lp, -jnp.inf)
+        return call_op(f, value, self.low, self.high,
+                       op_name="uniform_log_prob")
+
+    def probs(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, 1.0 / (hi - lo), 0.0)
+        return call_op(f, value, self.low, self.high,
+                       op_name="uniform_probs")
+
+    def entropy(self):
+        return call_op_nograd(lambda lo, hi: jnp.log(hi - lo),
+                              self.low, self.high,
+                              op_name="uniform_entropy")
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference: distribution.py:391)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        self.name = name or "Normal"
+
+    def sample(self, shape, seed=0):
+        import jax
+        key = jax.random.PRNGKey(seed) if seed else next_key()
+        mu, sig = self.loc._value, self.scale._value
+        base = jnp.broadcast_shapes(mu.shape, sig.shape)
+        z = jax.random.normal(key, tuple(shape) + base, dtype=jnp.float32)
+        return wrap(mu + z * sig)
+
+    def log_prob(self, value):
+        def f(v, mu, sig):
+            var = sig * sig
+            return (-((v - mu) ** 2) / (2 * var)
+                    - jnp.log(sig) - 0.5 * math.log(2 * math.pi))
+        return call_op(f, value, self.loc, self.scale,
+                       op_name="normal_log_prob")
+
+    def probs(self, value):
+        def f(v, mu, sig):
+            var = sig * sig
+            return (jnp.exp(-((v - mu) ** 2) / (2 * var))
+                    / (sig * math.sqrt(2 * math.pi)))
+        return call_op(f, value, self.loc, self.scale,
+                       op_name="normal_probs")
+
+    def entropy(self):
+        def f(sig):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(sig)
+        return call_op(f, self.scale, op_name="normal_entropy")
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two Normals (reference: :596)."""
+        def f(mu0, sig0, mu1, sig1):
+            var_ratio = (sig0 / sig1) ** 2
+            t1 = ((mu0 - mu1) / sig1) ** 2
+            return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
+        return call_op(f, self.loc, self.scale,
+                       other.loc, other.scale,
+                       op_name="normal_kl")
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference: distribution.py:641).
+    The reference takes `logits` and normalizes by sum of probs; this follows
+    the same contract (logits = unnormalized log-probabilities)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_tensor(logits)
+        self.name = name or "Categorical"
+
+    @staticmethod
+    def _log_softmax(lg):
+        m = jnp.max(lg, axis=-1, keepdims=True)
+        return lg - (jnp.log(jnp.sum(jnp.exp(lg - m), axis=-1,
+                                     keepdims=True)) + m)
+
+    def sample(self, shape):
+        import jax
+        key = next_key()
+        lg = self.logits._value
+        draws = jax.random.categorical(
+            key, lg, axis=-1, shape=tuple(shape) + lg.shape[:-1])
+        return wrap(draws)
+
+    @staticmethod
+    def _gather_last(lp, idx):
+        """Select class idx per row: batched logits use a per-row gather
+        (take_along_axis), 1-D logits broadcast over any idx shape."""
+        if lp.ndim == 1:
+            return lp[idx]
+        return jnp.take_along_axis(lp, idx[..., None], axis=-1)[..., 0]
+
+    def probs(self, value):
+        def f(lg):
+            p = jnp.exp(self._log_softmax(lg))
+            idx = unwrap(value).astype(jnp.int32)
+            return self._gather_last(p, idx)
+        return call_op(f, self.logits, op_name="categorical_probs")
+
+    def log_prob(self, value):
+        def f(lg):
+            lp = self._log_softmax(lg)
+            idx = unwrap(value).astype(jnp.int32)
+            return self._gather_last(lp, idx)
+        return call_op(f, self.logits, op_name="categorical_log_prob")
+
+    def entropy(self):
+        def f(lg):
+            m = jnp.max(lg, -1, keepdims=True)
+            lse = jnp.log(jnp.sum(jnp.exp(lg - m), -1, keepdims=True)) + m
+            lp = lg - lse
+            return -jnp.sum(jnp.exp(lp) * lp, -1)
+        return call_op(f, self.logits, op_name="categorical_entropy")
+
+    def kl_divergence(self, other):
+        """KL(self || other) (reference: :775)."""
+        def f(a, b):
+            ma = jnp.max(a, -1, keepdims=True)
+            mb = jnp.max(b, -1, keepdims=True)
+            la = a - (jnp.log(jnp.sum(jnp.exp(a - ma), -1, keepdims=True)) + ma)
+            lb = b - (jnp.log(jnp.sum(jnp.exp(b - mb), -1, keepdims=True)) + mb)
+            return jnp.sum(jnp.exp(la) * (la - lb), -1)
+        return call_op(f, self.logits, other.logits,
+                       op_name="categorical_kl")

@@ -4,6 +4,7 @@ Also hosts TPU-first extensions beyond the reference's capability bar:
 ring attention (context parallelism) lives in paddle_tpu.parallel.
 """
 from ..nn.functional.activation import softmax  # noqa: F401
+from . import auto_checkpoint  # noqa: F401
 from ..optimizer.averaging import (  # noqa: F401
     ModelAverage, LookAhead,
 )

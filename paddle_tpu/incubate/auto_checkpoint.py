@@ -1,0 +1,114 @@
+"""Auto-checkpoint (reference: `python/paddle/fluid/incubate/checkpoint/
+auto_checkpoint.py` — ExeTrainStatus, TrainEpochRange:265, train_epoch_range
+loops with epoch-granularity save/restore keyed by job id).
+
+TPU re-design: checkpoints are paddle_tpu.save state-dicts in a
+job-id-keyed directory (local or fuse-mounted cloud path, via
+fleet.utils.fs.LocalFS); restore resumes the epoch loop past completed
+epochs. Hooks register models/optimizers, matching the reference's
+_auto_checkpoint decorator flow.
+"""
+import json
+import os
+import time
+
+from .. import serialization
+from ..distributed.fleet.utils.fs import LocalFS
+
+__all__ = ["TrainEpochRange", "train_epoch_range", "get_checkpoint_dir"]
+
+
+def get_checkpoint_dir():
+    return os.environ.get("PADDLE_AUTO_CHECKPOINT_DIR",
+                          "./auto_checkpoint")
+
+
+class TrainEpochRange:
+    """Iterate epochs with automatic save at epoch end + resume at start
+    (reference: auto_checkpoint.py TrainEpochRange:265)."""
+
+    def __init__(self, max_epoch_num, name, checkpoint_inter=None,
+                 save_checkpoint=True, fs=None):
+        self.max_epoch_num = max_epoch_num
+        self.name = name
+        self.save_checkpoint = save_checkpoint
+        self.checkpoint_inter = checkpoint_inter  # seconds between saves
+        self._last_save = 0.0
+        self._fs = fs or LocalFS()
+        job_id = os.environ.get("PADDLE_JOB_ID", "job_default")
+        self._dir = os.path.join(get_checkpoint_dir(), job_id, name)
+        self._models = {}
+        self._optimizers = {}
+        self.restored_from = None
+        self._start_epoch = 0
+        self._load_meta()
+
+    # -- registration -------------------------------------------------------
+    def add_model(self, model, name="model"):
+        self._models[name] = model
+        return self
+
+    def add_optimizer(self, optimizer, name="opt"):
+        self._optimizers[name] = optimizer
+        return self
+
+    # -- persistence --------------------------------------------------------
+    def _meta_path(self):
+        return os.path.join(self._dir, "meta.json")
+
+    def _load_meta(self):
+        if not self._fs.is_file(self._meta_path()):
+            return
+        with open(self._meta_path()) as f:
+            meta = json.load(f)
+        self._start_epoch = int(meta.get("next_epoch", 0))
+        self.restored_from = meta.get("saved_at_epoch")
+
+    def _restore_states(self):
+        for name, m in self._models.items():
+            p = os.path.join(self._dir, f"{name}.pdparams")
+            if self._fs.is_file(p):
+                m.set_state_dict(serialization.load(p))
+        for name, o in self._optimizers.items():
+            p = os.path.join(self._dir, f"{name}.pdopt")
+            if self._fs.is_file(p):
+                o.set_state_dict(serialization.load(p))
+
+    def _save(self, epoch):
+        if not self.save_checkpoint:
+            return
+        if (self.checkpoint_inter is not None
+                and time.time() - self._last_save < self.checkpoint_inter
+                and epoch + 1 < self.max_epoch_num):
+            return
+        self._fs.mkdirs(self._dir)
+        for name, m in self._models.items():
+            serialization.save(m.state_dict(),
+                               os.path.join(self._dir, f"{name}.pdparams"))
+        for name, o in self._optimizers.items():
+            if hasattr(o, "state_dict"):
+                serialization.save(o.state_dict(),
+                                   os.path.join(self._dir, f"{name}.pdopt"))
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"next_epoch": epoch + 1, "saved_at_epoch": epoch,
+                       "time": time.time()}, f)
+        os.replace(tmp, self._meta_path())
+        self._last_save = time.time()
+
+    # -- iteration ----------------------------------------------------------
+    def get(self):
+        """Yield remaining epoch indices; save state after each completes."""
+        if self._start_epoch > 0:
+            self._restore_states()
+        for epoch in range(self._start_epoch, self.max_epoch_num):
+            yield epoch
+            self._save(epoch)
+
+    def __iter__(self):
+        return self.get()
+
+
+def train_epoch_range(max_epoch_num, name="auto_checkpoint", **kw):
+    """Functional form (reference: auto_checkpoint.py:71 _train_epoch_range)."""
+    return TrainEpochRange(max_epoch_num, name, **kw)

@@ -18,3 +18,4 @@ from .sampler import (  # noqa: F401
     DistributedBatchSampler, WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .shm_worker import get_worker_info  # noqa: F401

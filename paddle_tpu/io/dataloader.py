@@ -87,7 +87,8 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=0, worker_init_fn=None):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 shm_capacity=64 << 20):
         self.dataset = dataset
         self.batch_size = batch_size
         self.drop_last = drop_last
@@ -95,6 +96,10 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.return_list = return_list
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.shm_capacity = shm_capacity
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
         elif not isinstance(dataset, IterableDataset):
@@ -118,6 +123,11 @@ class DataLoader:
     def __iter__(self):
         if self.num_workers == 0:
             return self._sync_iter()
+        if self.use_shared_memory:
+            from .. import _native
+            if _native.lib() is not None:
+                from .shm_worker import MultiprocessIter
+                return MultiprocessIter(self)
         return _PrefetchIter(self)
 
     def _sync_iter(self):

@@ -1,0 +1,247 @@
+"""Multiprocess DataLoader workers over the native shared-memory ring.
+
+Reference: `python/paddle/fluid/dataloader/dataloader_iter.py`
+(_DataLoaderIterMultiProcess) + `worker.py` + the mmap shared-memory
+transport (`memory/allocation/mmap_allocator.cc`). TPU re-design: each forked
+worker owns one SPSC ring in POSIX shm (paddle_tpu/_native pt_ring_*);
+batches are pickled (protocol 5) into the ring; the parent reads rings
+round-robin so global batch order is deterministic and identical to
+single-process iteration. Worker death is detected via waitpid on ring
+timeouts (the reference's _thread_monitor analog).
+"""
+import os
+import pickle
+import signal
+
+import numpy as np
+
+from .. import _native
+
+_WORKER_INFO = None
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    """Inside a worker process: (id, num_workers, dataset); else None.
+    reference: fluid/dataloader/worker.py get_worker_info."""
+    return _WORKER_INFO
+
+
+class _RingWriter:
+    def __init__(self, name, capacity):
+        L = _native.lib()
+        self._L = L
+        self._ring = L.pt_ring_open(name.encode())
+        if not self._ring:
+            raise RuntimeError(f"worker could not open shm ring {name}")
+
+    def send(self, obj, timeout_ms=600000):
+        data = pickle.dumps(obj, protocol=5)
+        rc = self._L.pt_ring_write(self._ring, data, len(data), timeout_ms)
+        if rc == -3:
+            raise RuntimeError(
+                f"batch of {len(data)} bytes exceeds shm ring capacity; "
+                f"raise DataLoader(shm_capacity=...)")
+        if rc != 0:
+            raise RuntimeError(f"shm ring write failed (rc={rc})")
+
+    def close(self):
+        self._L.pt_ring_close_producer(self._ring)
+        self._L.pt_ring_free(self._ring, 0)
+
+
+class _RingReader:
+    def __init__(self, name, capacity):
+        L = _native.lib()
+        self._L = L
+        self._name = name
+        self._ring = L.pt_ring_create(name.encode(), capacity)
+        if not self._ring:
+            raise RuntimeError(f"could not create shm ring {name}")
+
+    def recv(self, timeout_ms):
+        """Returns the next object, or raises TimeoutError / EOFError."""
+        import ctypes
+        n = self._L.pt_ring_next_len(self._ring, timeout_ms)
+        if n == -1:
+            raise TimeoutError
+        if n == -2:
+            raise EOFError
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._L.pt_ring_read(self._ring, buf, n)
+        if got != n:
+            raise EOFError
+        return pickle.loads(buf.raw)
+
+    def close(self, unlink=True):
+        self._L.pt_ring_free(self._ring, 1 if unlink else 0)
+
+
+def _worker_loop(loader, worker_id, num_workers, ring_name, epoch_seed):
+    """Forked child body: produce this worker's share of batches in order."""
+    global _WORKER_INFO
+    signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent handles ^C
+    _WORKER_INFO = WorkerInfo(worker_id, num_workers, loader.dataset)
+    # every worker sees the SAME shuffle permutation for this epoch, and the
+    # parent advanced its RNG drawing epoch_seed, so epochs differ
+    np.random.seed(epoch_seed)
+    writer = _RingWriter(ring_name, 0)
+    try:
+        if loader.worker_init_fn is not None:
+            loader.worker_init_fn(worker_id)
+        from .dataset import IterableDataset
+        if isinstance(loader.dataset, IterableDataset):
+            # each worker consumes the whole iterable but keeps only batches
+            # b where b % num_workers == worker_id (deterministic split)
+            batch, b = [], 0
+            for sample in loader.dataset:
+                batch.append(sample)
+                if len(batch) == loader.batch_size:
+                    if b % num_workers == worker_id:
+                        writer.send(loader.collate_fn(batch))
+                    batch = []
+                    b += 1
+            if batch and not loader.drop_last and b % num_workers == worker_id:
+                writer.send(loader.collate_fn(batch))
+        else:
+            for b, indices in enumerate(loader.batch_sampler):
+                if b % num_workers != worker_id:
+                    continue
+                samples = [loader.dataset[i] for i in indices]
+                writer.send(loader.collate_fn(samples))
+    except BaseException as e:
+        try:
+            writer.send(("__worker_error__", worker_id, repr(e)))
+        except BaseException:
+            pass
+    finally:
+        writer.close()
+
+
+class MultiprocessIter:
+    """Parent-side iterator: deterministic round-robin merge of worker rings."""
+
+    def __init__(self, loader):
+        if _native.lib() is None:
+            raise RuntimeError(
+                "num_workers>0 requires the native runtime (g++ build); "
+                f"build error: {_native._build_err}")
+        self.loader = loader
+        self.num_workers = loader.num_workers
+        # timeout=0 means "no deadline" (paddle convention); we still poll in
+        # slices so dead workers are detected promptly
+        self.timeout_ms = int(loader.timeout * 1000) if loader.timeout else None
+        self._poll_ms = 5000
+        # drawn from the parent RNG: advances it (fresh shuffle every epoch)
+        # and gives all workers one shared permutation
+        self._epoch_seed = int(np.random.randint(0, 2 ** 31 - 1))
+        self._readers = []
+        self._pids = []
+        self._exhausted = [False] * self.num_workers
+        self._next_worker = 0
+        base = f"/pt_dl_{os.getpid()}_{id(self) & 0xffffff}"
+        for w in range(self.num_workers):
+            self._readers.append(
+                _RingReader(f"{base}_{w}", loader.shm_capacity))
+        for w in range(self.num_workers):
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    for r in self._readers:
+                        r.close(unlink=False)
+                except BaseException:
+                    pass
+                try:
+                    _worker_loop(loader, w, self.num_workers, f"{base}_{w}",
+                                 self._epoch_seed)
+                finally:
+                    os._exit(0)
+            self._pids.append(pid)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            w = self._next_worker
+            if all(self._exhausted):
+                self._shutdown()
+                raise StopIteration
+            if self._exhausted[w]:
+                self._next_worker = (w + 1) % self.num_workers
+                continue
+            try:
+                obj = self._recv_polling(w)
+            except EOFError:
+                self._exhausted[w] = True
+                self._next_worker = (w + 1) % self.num_workers
+                continue
+            if (isinstance(obj, tuple) and len(obj) == 3
+                    and obj[0] == "__worker_error__"):
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker {obj[1]} failed: {obj[2]}")
+            self._next_worker = (w + 1) % self.num_workers
+            return self.loader._to_output(obj)
+
+    def _recv_polling(self, w):
+        """Wait for worker w's next message in poll slices: a dead worker is
+        detected within one slice; a merely-slow worker only errors when the
+        user set an explicit timeout and it expired."""
+        waited = 0
+        while True:
+            slice_ms = self._poll_ms
+            if self.timeout_ms is not None:
+                slice_ms = min(slice_ms, self.timeout_ms - waited)
+            try:
+                return self._readers[w].recv(max(1, slice_ms))
+            except TimeoutError:
+                waited += slice_ms
+                self._check_workers(w)  # raises if the worker died
+                if self.timeout_ms is not None and waited >= self.timeout_ms:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker {w} timed out after "
+                        f"{self.timeout_ms} ms")
+
+    def _check_workers(self, w):
+        try:
+            pid, status = os.waitpid(self._pids[w], os.WNOHANG)
+        except ChildProcessError:  # already reaped on a prior poll
+            return
+        if pid != 0 and not (os.WIFEXITED(status)
+                             and os.WEXITSTATUS(status) == 0):
+            self._shutdown()
+            raise RuntimeError(
+                f"DataLoader worker {w} (pid {pid}) exited unexpectedly "
+                f"(status {status})")
+
+    def _shutdown(self):
+        for pid in self._pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for pid in self._pids:
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass
+        for r in self._readers:
+            try:
+                r.close(unlink=True)
+            except BaseException:
+                pass
+        self._pids, self._readers = [], []
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except BaseException:
+            pass

@@ -9,6 +9,7 @@ from . import manipulation, math, random  # noqa: F401
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .random import rand, randn, randint, randperm, normal, uniform, bernoulli, multinomial  # noqa: F401
+from . import sequence  # noqa: F401
 
 from ..core.tensor import Tensor
 

@@ -1,0 +1,155 @@
+"""Sequence / ragged ops (reference: `paddle/fluid/operators/sequence_ops/`
++ the LoD machinery `framework/lod_tensor.h:57`).
+
+TPU re-design: LoD (ragged offsets) is a host-side concept; on device
+everything is padded + length-masked static shapes, which is what XLA needs.
+`RaggedBatch` is the LoDTensor analog: a padded dense tensor + lengths
+vector, with host converters both ways. The sequence_* functional ops work
+on (data, lengths) pairs.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op, call_op_nograd, unwrap, wrap
+from ..core.tensor import Tensor
+
+__all__ = ["RaggedBatch", "sequence_mask", "sequence_pad", "sequence_unpad",
+           "sequence_expand", "sequence_reverse", "sequence_softmax",
+           "sequence_pool"]
+
+
+class RaggedBatch:
+    """LoDTensor analog: `data` [B, T, ...] padded, `lengths` [B] int32.
+
+    reference: framework/lod_tensor.h:109 (LoDTensor), :57 (LoD offsets).
+    The reference keeps ragged rows contiguous with offset tables; on TPU the
+    canonical layout is padded-dense so each batch compiles to one static
+    shape (bucket T upstream to bound recompilation).
+    """
+
+    def __init__(self, data, lengths):
+        self.data = data if isinstance(data, Tensor) else Tensor(data)
+        self.lengths = lengths if isinstance(lengths, Tensor) else \
+            Tensor(np.asarray(lengths, np.int32))
+
+    @classmethod
+    def from_list(cls, rows, pad_value=0.0, maxlen=None):
+        """Host ragged rows -> padded batch. (LoD construction analog.)"""
+        rows = [np.asarray(r) for r in rows]
+        lengths = np.asarray([len(r) for r in rows], np.int32)
+        T = maxlen or (int(lengths.max()) if len(rows) else 0)
+        tail = rows[0].shape[1:] if rows else ()
+        out = np.full((len(rows), T) + tail, pad_value,
+                      dtype=rows[0].dtype if rows else np.float32)
+        for i, r in enumerate(rows):
+            out[i, :len(r)] = r[:T]
+        return cls(out, lengths)
+
+    def to_list(self):
+        d = np.asarray(unwrap(self.data))
+        ls = np.asarray(unwrap(self.lengths))
+        return [d[i, :ls[i]] for i in range(len(ls))]
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """lengths [B] -> mask [B, maxlen] (reference:
+    sequence_ops/sequence_mask_op.cc)."""
+    lv = unwrap(x)
+    T = int(maxlen) if maxlen is not None else int(np.asarray(lv).max())
+
+    def f(lens):
+        return (jnp.arange(T)[None, :] < lens[..., None]).astype(dtype)
+
+    return call_op_nograd(f, x, op_name="sequence_mask")
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, name=None):
+    """Ragged rows (list or RaggedBatch) -> (padded, lengths) (reference:
+    sequence_ops/sequence_pad_op.cc)."""
+    if isinstance(x, RaggedBatch):
+        return x.data, x.lengths
+    rb = RaggedBatch.from_list(x, pad_value, maxlen)
+    return rb.data, rb.lengths
+
+
+def sequence_unpad(x, length, name=None):
+    """(padded, lengths) -> host list of rows (reference:
+    sequence_ops/sequence_unpad_op.cc)."""
+    return RaggedBatch(x, length).to_list()
+
+
+def sequence_expand(x, lengths, name=None):
+    """Repeat row i of x lengths[i] times (reference:
+    sequence_ops/sequence_expand_op.cc, ref_level collapsed to row level).
+    Host-side restructuring (output length is data-dependent)."""
+    xv = np.asarray(unwrap(x))
+    lv = np.asarray(unwrap(lengths))
+    return wrap(jnp.asarray(np.repeat(xv, lv, axis=0)))
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """Reverse each row within its valid length (reference:
+    sequence_ops/sequence_reverse_op.cc)."""
+    if lengths is None:
+        return call_op(lambda v: v[:, ::-1], x, op_name="sequence_reverse")
+
+    def f(v, lens):
+        T = v.shape[1]
+        idx = jnp.arange(T)[None, :]
+        rev = lens[:, None] - 1 - idx
+        src = jnp.where(idx < lens[:, None], rev, idx)
+        return jnp.take_along_axis(
+            v, src.reshape(src.shape + (1,) * (v.ndim - 2)).astype(jnp.int32)
+            if v.ndim > 2 else src.astype(jnp.int32), axis=1)
+
+    return call_op(f, x, lengths, op_name="sequence_reverse")
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Masked softmax over the time axis (reference:
+    sequence_ops/sequence_softmax_op.cc)."""
+
+    def f(v, lens):
+        T = v.shape[1]
+        mask = jnp.arange(T)[None, :] < lens[:, None]
+        neg = jnp.where(mask, v, -jnp.inf)
+        m = jnp.max(neg, axis=1, keepdims=True)
+        e = jnp.exp(neg - m) * mask
+        return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-12)
+
+    return call_op(f, x, lengths, op_name="sequence_softmax")
+
+
+def sequence_pool(x, lengths, pool_type="average", name=None):
+    """Masked pool over time (reference: sequence_ops/sequence_pool_op.cc;
+    SUM/AVERAGE/MAX/LAST/FIRST/SQRT)."""
+    pool_type = pool_type.lower()
+
+    def f(v, lens):
+        T = v.shape[1]
+        mask = (jnp.arange(T)[None, :] < lens[:, None])
+        maskx = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        cnt = jnp.maximum(lens.astype(v.dtype), 1)
+        cnt = cnt.reshape(cnt.shape + (1,) * (v.ndim - 2))
+        if pool_type == "sum":
+            return jnp.where(maskx, v, 0).sum(axis=1)
+        if pool_type == "average":
+            return jnp.where(maskx, v, 0).sum(axis=1) / cnt
+        if pool_type == "sqrt":
+            return jnp.where(maskx, v, 0).sum(axis=1) / jnp.sqrt(cnt)
+        if pool_type == "max":
+            return jnp.where(maskx, v, -jnp.inf).max(axis=1)
+        if pool_type == "first":
+            return v[:, 0]
+        if pool_type == "last":
+            idx = jnp.maximum(lens - 1, 0).astype(jnp.int32)
+            return jnp.take_along_axis(
+                v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)),
+                axis=1).squeeze(1)
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    return call_op(f, x, lengths, op_name=f"sequence_pool_{pool_type}")

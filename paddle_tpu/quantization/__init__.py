@@ -1,0 +1,225 @@
+"""Quantization: QAT (fake-quant training) + PTQ (post-training calibration).
+
+Reference: `python/paddle/fluid/contrib/slim/quantization/` —
+`imperative/qat.py` (ImperativeQuantAware), `post_training_quantization.py`,
+fake-quant ops `operators/fake_quantize_op.cc` (abs_max, moving_average_
+abs_max, channel_wise_abs_max).
+
+TPU re-design: fake-quant is a jax.custom_vjp op (round/clip forward,
+straight-through gradient), so QAT graphs stay fully fusable by XLA; the
+"quantized" inference path keeps bf16/int8-simulated math (real int8
+lowering is an XLA backend concern, not an op-library one).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import call_op, unwrap, wrap
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..nn import functional as F
+
+__all__ = [
+    "fake_quant", "QuantizedLinear", "QuantizedConv2D",
+    "ImperativeQuantAware", "PTQ", "quant_post_static",
+]
+
+
+@jax.custom_vjp
+def _fake_quant_ste(x, scale, qmax):
+    s = scale / qmax
+    return jnp.clip(jnp.round(x / s), -qmax, qmax) * s
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant_ste(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # straight-through: pass grad inside the clip range, zero outside
+    inside = (jnp.abs(x) <= scale).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale), None
+
+
+_fake_quant_ste.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quant(x, scale, bits=8, op_name="fake_quantize"):
+    """Simulated symmetric quantization with STE gradient (reference:
+    fake_quantize_op.cc FakeQuantizeAbsMax)."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def f(xv):
+        sv = unwrap(scale) if isinstance(scale, Tensor) else \
+            jnp.asarray(scale, jnp.float32)
+        return _fake_quant_ste(xv, sv, qmax)
+
+    return call_op(f, x, op_name=op_name)
+
+
+def _absmax(x):
+    return jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+
+
+class _QuantLayerMixin:
+    """Weight abs-max fake-quant + activation moving-average abs-max
+    (reference: imperative/qat.py QuantizedLinear/QuantizedConv2D wrappers +
+    moving_average_abs_max_scale op)."""
+
+    def _init_quant(self, bits, momentum=0.9):
+        self._qbits = bits
+        self._qmomentum = momentum
+        self._act_scale = 1.0
+        self._act_scale_initialized = False
+        self._frozen = False
+
+    def _quant_act(self, x):
+        if not self._frozen:
+            cur = float(np.asarray(jax.device_get(_absmax(unwrap(x)))))
+            if not self._act_scale_initialized:
+                self._act_scale = cur
+                self._act_scale_initialized = True
+            else:
+                m = self._qmomentum
+                self._act_scale = m * self._act_scale + (1 - m) * cur
+        return fake_quant(x, self._act_scale, self._qbits,
+                          op_name="fake_quant_act")
+
+    def _quant_weight(self, w):
+        scale = float(np.asarray(jax.device_get(_absmax(unwrap(w)))))
+        return fake_quant(w, scale, self._qbits, op_name="fake_quant_weight")
+
+    def freeze(self):
+        """Stop updating activation scales (calibration done)."""
+        self._frozen = True
+
+
+class QuantizedLinear(Layer, _QuantLayerMixin):
+    def __init__(self, layer, bits=8):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._init_quant(bits)
+
+    def forward(self, x):
+        return F.linear(self._quant_act(x), self._quant_weight(self.weight),
+                        self.bias)
+
+
+class QuantizedConv2D(Layer, _QuantLayerMixin):
+    def __init__(self, layer, bits=8):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._inner = dict(stride=layer._stride, padding=layer._padding,
+                           dilation=layer._dilation, groups=layer._groups,
+                           data_format=layer._data_format)
+        self._init_quant(bits)
+
+    def forward(self, x):
+        return F.conv2d(self._quant_act(x), self._quant_weight(self.weight),
+                        self.bias, **self._inner)
+
+
+_QUANTIZABLE = {Linear: QuantizedLinear, Conv2D: QuantizedConv2D}
+
+
+class ImperativeQuantAware:
+    """QAT driver (reference: imperative/qat.py ImperativeQuantAware:
+    quantize() swaps Linear/Conv2D sublayers for fake-quant wrappers
+    in place)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_layer_type=("Linear", "Conv2D"), **kw):
+        self._bits = weight_bits
+        self._types = tuple(
+            cls for cls in _QUANTIZABLE
+            if cls.__name__ in quantizable_layer_type)
+
+    def quantize(self, model):
+        self._swap(model)
+        return model
+
+    def _swap(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None:
+                continue
+            if isinstance(sub, self._types):
+                layer._sub_layers[name] = _QUANTIZABLE[type(sub)](
+                    sub, self._bits)
+            else:
+                self._swap(sub)
+
+    @staticmethod
+    def save_quantized_model(model, path, input_spec=None):
+        from .. import jit
+        for sub in model.sublayers(include_self=True):
+            if isinstance(sub, _QuantLayerMixin):
+                sub.freeze()
+        return jit.save(model, path, input_spec=input_spec)
+
+
+class PTQ:
+    """Post-training quantization (reference:
+    post_training_quantization.py PostTrainingQuantization — abs_max /
+    percentile ("hist") activation calibration on sample data)."""
+
+    def __init__(self, activation_bits=8, weight_bits=8,
+                 algo="abs_max", percentile=0.999):
+        self._bits = activation_bits
+        self._algo = algo
+        self._pct = percentile
+
+    def quantize(self, model, calib_loader, max_batches=16):
+        """Swap layers, run calibration batches, freeze scales."""
+        ImperativeQuantAware(self._bits, self._bits).quantize(model)
+        observed = []
+
+        if self._algo == "percentile":
+            # collect per-layer activation samples, then take the percentile
+            samples = {}
+            orig = _QuantLayerMixin._quant_act
+
+            def observing(self_l, x):
+                v = np.abs(np.asarray(unwrap(x))).ravel()
+                samples.setdefault(id(self_l), []).append(v)
+                return orig(self_l, x)
+
+            _QuantLayerMixin._quant_act = observing
+            try:
+                self._run_calib(model, calib_loader, max_batches)
+            finally:
+                _QuantLayerMixin._quant_act = orig
+            for sub in model.sublayers(include_self=True):
+                if isinstance(sub, _QuantLayerMixin) and id(sub) in samples:
+                    allv = np.concatenate(samples[id(sub)])
+                    sub._act_scale = float(np.quantile(allv, self._pct))
+                    sub._act_scale_initialized = True
+        else:
+            self._run_calib(model, calib_loader, max_batches)
+
+        for sub in model.sublayers(include_self=True):
+            if isinstance(sub, _QuantLayerMixin):
+                sub.freeze()
+                observed.append(sub)
+        return model
+
+    @staticmethod
+    def _run_calib(model, loader, max_batches):
+        from ..core.autograd import no_grad
+        model.eval()
+        with no_grad():
+            for i, batch in enumerate(loader):
+                if i >= max_batches:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                model(x)
+
+
+def quant_post_static(model, calib_loader, **kw):
+    """Functional PTQ entry (reference: paddle.static.quantization
+    quant_post_static)."""
+    return PTQ(**kw).quantize(model, calib_loader)

@@ -1,0 +1,116 @@
+"""ASP — automatic structured (N:M) sparsity.
+
+Reference: `python/paddle/fluid/contrib/sparsity/` — `asp.py` (ASPHelper,
+prune_model, decorate), `utils.py` (create_mask, check_sparsity,
+MaskAlgo/CheckMethod). The reference targets NVIDIA 2:4 sparse tensor cores;
+on TPU the same N:M masks serve magnitude-pruning workflows (and XLA folds
+the mask-multiply into the matmul's producer fusion).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["calculate_density", "create_mask", "check_mask_1d",
+           "check_sparsity", "prune_model", "decorate", "ASPHelper"]
+
+
+def calculate_density(x):
+    """Fraction of non-zeros (reference: sparsity/utils.py
+    calculate_density)."""
+    arr = np.asarray(unwrap(x))
+    return float((arr != 0).sum() / arr.size)
+
+
+def _mask_1d_greedy(block, n, m):
+    """Keep the n largest-|.| of every m consecutive elements."""
+    keep = np.argsort(-np.abs(block))[:n]
+    mask = np.zeros(m, block.dtype)
+    mask[keep] = 1
+    return mask
+
+
+def create_mask(weight, func_name="mask_1d", n=2, m=4):
+    """N:M mask along the last axis (reference: sparsity/utils.py
+    create_mask, MaskAlgo.MASK_1D)."""
+    w = np.asarray(unwrap(weight))
+    flat = w.reshape(-1, w.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)
+    mask = np.zeros_like(groups)
+    idx = np.argsort(-np.abs(groups), axis=-1)[..., :n]
+    np.put_along_axis(mask, idx, 1.0, axis=-1)
+    mask = mask.reshape(flat.shape)[:, :cols].reshape(w.shape)
+    return mask.astype(w.dtype)
+
+
+def check_mask_1d(mat, n=2, m=4):
+    """True iff every m-group along the last axis has ≤ (m-n) zeros...
+    i.e. at most n non-zeros (reference: sparsity/utils.py check_mask_1d)."""
+    w = np.asarray(unwrap(mat))
+    flat = w.reshape(-1, w.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)
+    return bool(((groups != 0).sum(-1) <= n).all())
+
+
+def check_sparsity(mat, func_name="check_mask_1d", n=2, m=4):
+    return check_mask_1d(mat, n, m)
+
+
+def _supported(p):
+    # prune matmul-facing 2-D+ weights only (reference skips biases/norms)
+    return not getattr(p, "is_bias", False) and len(p.shape) >= 2
+
+
+class ASPHelper:
+    """Holds masks and re-applies them after optimizer steps (reference:
+    sparsity/asp.py ASPHelper — _minimize inserts mask-mul after opt)."""
+
+    _masks = {}
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo="mask_1d",
+                    with_mask=True):
+        for name, p in model.named_parameters():
+            if not _supported(p):
+                continue
+            mask = create_mask(p, mask_algo, n, m)
+            cls._masks[id(p)] = mask
+            p.set_value(np.asarray(unwrap(p)) * mask)
+        return cls._masks
+
+    @classmethod
+    def reapply_masks(cls, params):
+        for p in params:
+            mask = cls._masks.get(id(p))
+            if mask is not None:
+                p.set_value(np.asarray(unwrap(p)) * mask)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """reference: sparsity/asp.py prune_model."""
+    return ASPHelper.prune_model(model, n, m, mask_algo, with_mask)
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update (reference:
+    sparsity/asp.py decorate -> ASPHelper._minimize)."""
+    orig_step = optimizer.step
+
+    def step(*a, **k):
+        out = orig_step(*a, **k)
+        params = [p for g in optimizer._param_groups for p in g["params"]]
+        ASPHelper.reapply_masks(params)
+        return out
+
+    optimizer.step = step
+    return optimizer

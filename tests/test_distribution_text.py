@@ -1,0 +1,165 @@
+"""paddle.distribution + text/classic datasets + metrics.
+
+Mirrors reference tests test_distribution.py, text dataset tests, and
+metric tests from fluid/tests/unittests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+
+def test_normal_log_prob_entropy_kl():
+    n = Normal(0.0, 1.0)
+    x = paddle.to_tensor(np.array([0.0, 1.0, -2.0], np.float32))
+    lp = np.asarray(n.log_prob(x).numpy())
+    expect = -0.5 * np.array([0.0, 1.0, 4.0]) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(lp, expect, rtol=1e-5)
+    ent = float(np.asarray(n.entropy().numpy()))
+    np.testing.assert_allclose(ent, 0.5 * np.log(2 * np.pi) + 0.5, rtol=1e-5)
+    m = Normal(1.0, 2.0)
+    kl = float(np.asarray(n.kl_divergence(m).numpy()))
+    # closed form: log(s1/s0) + (s0^2 + (m0-m1)^2)/(2 s1^2) - 1/2
+    expect_kl = np.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+    np.testing.assert_allclose(kl, expect_kl, rtol=1e-5)
+
+
+def test_normal_sample_statistics():
+    paddle.seed(0)
+    n = Normal(3.0, 0.5)
+    s = np.asarray(n.sample((20000,)).numpy())
+    assert abs(s.mean() - 3.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+
+
+def test_uniform_log_prob_and_sample():
+    u = Uniform(1.0, 3.0)
+    x = paddle.to_tensor(np.array([2.0, 0.0], np.float32))
+    lp = np.asarray(u.log_prob(x).numpy())
+    np.testing.assert_allclose(lp[0], -np.log(2.0), rtol=1e-6)
+    assert lp[1] == -np.inf
+    paddle.seed(1)
+    s = np.asarray(u.sample((5000,)).numpy())
+    assert s.min() >= 1.0 and s.max() < 3.0
+    assert abs(s.mean() - 2.0) < 0.05
+    ent = float(np.asarray(u.entropy().numpy()))
+    np.testing.assert_allclose(ent, np.log(2.0), rtol=1e-6)
+
+
+def test_categorical():
+    logits = np.log(np.array([0.1, 0.2, 0.7], np.float32))
+    c = Categorical(logits)
+    probs = np.exp(np.asarray(
+        c.log_prob(paddle.to_tensor(np.arange(3))).numpy()))
+    np.testing.assert_allclose(probs, [0.1, 0.2, 0.7], rtol=1e-5)
+    ent = float(np.asarray(c.entropy().numpy()))
+    np.testing.assert_allclose(
+        ent, -(0.1 * np.log(0.1) + 0.2 * np.log(0.2) + 0.7 * np.log(0.7)),
+        rtol=1e-5)
+    c2 = Categorical(np.zeros(3, np.float32))
+    kl = float(np.asarray(c.kl_divergence(c2).numpy()))
+    assert kl > 0
+    paddle.seed(0)
+    s = np.asarray(c.sample((4000,)).numpy())
+    freq = np.bincount(s, minlength=3) / len(s)
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.03)
+
+
+def test_distribution_grad_flows():
+    mu = paddle.to_tensor(np.float32(0.5))
+    mu.stop_gradient = False
+    n = Normal(mu, 1.0)
+    lp = n.log_prob(paddle.to_tensor(np.float32(1.5)))
+    lp.backward()
+    # d/dmu log N(x|mu,1) = (x - mu) = 1.0
+    np.testing.assert_allclose(float(np.asarray(mu.grad.numpy())), 1.0,
+                               rtol=1e-5)
+
+
+def test_text_datasets_schema():
+    from paddle_tpu.text import Imdb, Imikolov, UCIHousing, WMT16, Conll05st
+    imdb = Imdb(mode="train")
+    doc, label = imdb[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    ng = Imikolov(mode="test", window_size=5)
+    assert len(ng[0]) == 5
+    uci = UCIHousing(mode="train")
+    x, y = uci[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    wmt = WMT16(mode="test")
+    src, trg, nxt = wmt[0]
+    assert trg[0] == 0 and nxt[-1] == 1 and len(trg) == len(nxt)
+    srl = Conll05st(mode="train")
+    w, p, l = srl[0]
+    assert len(w) == len(p) == len(l)
+
+
+def test_classic_dataset_readers():
+    from paddle_tpu import dataset
+    r = dataset.uci_housing.train()()
+    x, y = next(iter(r))
+    assert x.shape == (13,)
+    r10 = dataset.cifar.test10()()
+    img, label = next(iter(r10))
+    assert img.shape[0] == 3
+
+
+def test_uci_housing_trains():
+    """A linear regressor must fit the synthetic housing data (signal check)."""
+    from paddle_tpu.text import UCIHousing
+    ds = UCIHousing(mode="train")
+    lin = paddle.nn.Linear(13, 1)
+    opt = paddle.optimizer.Adam(parameters=lin.parameters(),
+                                learning_rate=0.05)
+    loader = paddle.io.DataLoader(ds, batch_size=64, shuffle=True)
+    first = last = None
+    for epoch in range(12):
+        for x, y in loader:
+            loss = paddle.nn.functional.mse_loss(lin(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.numpy())
+    last = float(loss.numpy())
+    assert last < first * 0.2, (first, last)
+
+
+def test_viterbi_decode():
+    from paddle_tpu.text import viterbi_decode
+    # hand-checkable 2-tag chain
+    pot = np.array([[[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]]], np.float32)
+    trans = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    score, path = viterbi_decode(paddle.to_tensor(pot),
+                                 paddle.to_tensor(trans))
+    path = np.asarray(path.numpy())[0]
+    assert path.shape == (3,)
+    # brute-force check
+    best, best_p = -1e9, None
+    for a in (0, 1):
+        for b in (0, 1):
+            for c in (0, 1):
+                s = pot[0, 0, a] + pot[0, 1, b] + pot[0, 2, c] \
+                    + trans[a, b] + trans[b, c]
+                if s > best:
+                    best, best_p = s, [a, b, c]
+    assert list(path) == best_p
+    np.testing.assert_allclose(float(np.asarray(score.numpy())[0]), best,
+                               rtol=1e-5)
+
+
+def test_metrics_auc_precision_recall():
+    from paddle_tpu.metric import Auc, Precision, Recall
+    preds = np.array([[0.2, 0.8], [0.9, 0.1], [0.4, 0.6], [0.7, 0.3]],
+                     np.float32)
+    labels = np.array([[1], [0], [1], [1]], np.int64)
+    auc = Auc()
+    auc.update(preds, labels)
+    assert 0.0 <= auc.accumulate() <= 1.0
+    p = Precision()
+    p.update(preds[:, 1], labels[:, 0])
+    assert 0.0 <= p.accumulate() <= 1.0
+    r = Recall()
+    r.update(preds[:, 1], labels[:, 0])
+    assert 0.0 <= r.accumulate() <= 1.0

