@@ -23,19 +23,45 @@ _build_err = None
 _lock = threading.Lock()
 
 
+def _src_digest():
+    import hashlib
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
 def _needs_build():
+    """Cache keyed on a content hash of the source (stored in a sidecar
+    file), never on mtimes: after a fresh clone mtimes are checkout order,
+    and an unauditable stale/committed binary must not win over the
+    reviewed source."""
     if not os.path.exists(_LIB):
         return True
-    return os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    try:
+        with open(_LIB + ".hash") as f:
+            return f.read().strip() != _src_digest()
+    except OSError:
+        return True
 
 
 def _build():
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        "-fvisibility=hidden", "-o", _LIB + ".tmp", _SRC, "-lrt",
-    ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
-    os.replace(_LIB + ".tmp", _LIB)
+    import tempfile
+    # per-process temp name: concurrent first imports (launched trainers)
+    # must not race on one shared tmp path
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+            "-fvisibility=hidden", "-o", tmp, _SRC, "-lrt",
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.chmod(tmp, 0o755)  # mkstemp creates 0600; the lib must be
+        os.replace(tmp, _LIB)  # readable by other users of the install
+        with open(_LIB + ".hash", "w") as f:
+            f.write(_src_digest())
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _bind(lib):

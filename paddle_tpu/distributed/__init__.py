@@ -11,6 +11,7 @@ from .parallel_env import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     all_reduce, all_gather, reduce, broadcast, scatter, alltoall, send, recv,
+    p2p_transfer,
     barrier, new_group, wait, split, ReduceOp,
 )
 from .parallel import DataParallel  # noqa: F401

@@ -108,8 +108,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
         def _bcast(v):
-            # take src's value on every rank: gather then index
-            return jax.lax.all_gather(v, ax)[src]
+            # mask + psum: every rank contributes 0 except src, so only one
+            # copy crosses the wire (vs all_gather+index which materialises
+            # nranks copies to keep one).
+            idx = jax.lax.axis_index(ax)
+            masked = jnp.where(idx == src, v, jnp.zeros_like(v))
+            return jax.lax.psum(masked, ax)
         out = call_op(_bcast, tensor, op_name="c_broadcast")
         tensor._value = out._value
         tensor._tape_node = out._tape_node
@@ -146,13 +150,57 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     return out_tensor_list
 
 
+def p2p_transfer(tensor, src, dst, group=None):
+    """SPMD point-to-point: every rank executes this; the value held by
+    `src` lands on `dst` (other ranks receive zeros). This is the ppermute
+    form of a matched reference send_v2/recv_v2 pair
+    (operators/collective/send_v2_op.cc) — in a single-program mesh the
+    send and the recv are one collective-permute, not two rank-gated ops."""
+    ax = _axis(group)
+    if not _in_named_trace(ax):
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "eager multi-process p2p_transfer is not supported; wrap it "
+                "in shard_map with the group's mesh axis bound")
+        return tensor  # world of one: transfer-to-self
+    out = call_op(
+        lambda v: jax.lax.ppermute(v, ax, perm=[(src, dst)]),
+        tensor, op_name="p2p_transfer")
+    return out
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    """p2p send (reference send_v2): inside shard_map this is a ppermute
-    handled by the pipeline helpers; eager single-process is a no-op."""
-    return tensor
+    """p2p send (reference send_v2). Rank-gated send/recv cannot be traced
+    into a single SPMD program — raise loudly instead of silently dropping
+    the transfer; use p2p_transfer(tensor, src, dst) or the pipeline
+    helpers (fleet.meta_parallel pp_utils) which express the pair as one
+    ppermute."""
+    ax = _axis(group)
+    if _in_named_trace(ax):
+        raise NotImplementedError(
+            "send() inside an SPMD region is rank-gated control flow, which "
+            "a single traced program cannot express; use "
+            "paddle_tpu.distributed.p2p_transfer(tensor, src, dst, group) "
+            "(one ppermute for the matched send/recv pair) instead")
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "eager multi-process send() is not supported; wrap the transfer "
+            "in shard_map and use p2p_transfer")
+    return tensor  # world of one: send-to-self
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if _in_named_trace(ax):
+        raise NotImplementedError(
+            "recv() inside an SPMD region is rank-gated control flow, which "
+            "a single traced program cannot express; use "
+            "paddle_tpu.distributed.p2p_transfer(tensor, src, dst, group) "
+            "(one ppermute for the matched send/recv pair) instead")
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "eager multi-process recv() is not supported; wrap the transfer "
+            "in shard_map and use p2p_transfer")
     return tensor
 
 

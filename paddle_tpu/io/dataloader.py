@@ -20,6 +20,47 @@ from .dataset import IterableDataset
 from .sampler import BatchSampler
 
 
+_warned_fork = False
+
+
+def _fork_is_safe():
+    """os.fork() from a process holding an initialised accelerator backend
+    inherits XLA's threads/locks into the child — fine for XLA:CPU (workers
+    stay numpy-only), a deadlock/corruption risk with a live TPU client.
+    Fall back to threaded prefetch there instead of forking."""
+    import os
+
+    def _warn(msg):
+        global _warned_fork
+        if not _warned_fork:
+            _warned_fork = True
+            import warnings
+            warnings.warn(msg, RuntimeWarning)
+
+    try:
+        import jax
+        from jax._src import xla_bridge
+        if not hasattr(xla_bridge, "_backends"):
+            raise AttributeError("xla_bridge._backends gone")
+        if not xla_bridge._backends:  # not initialised: child stays clean
+            return True
+        if jax.default_backend() == "cpu":
+            return True
+        _warn("DataLoader(num_workers>0): accelerator backend already "
+              "initialised; using threaded prefetch instead of forked "
+              "shared-memory workers (fork would inherit the live TPU "
+              "runtime)")
+        return False
+    except Exception:
+        # detection broke (private jax API moved): fail CLOSED unless the
+        # platform is known-cpu — a safety check that fails open is no check
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            return True
+        _warn("DataLoader(num_workers>0): could not determine accelerator "
+              "state; using threaded prefetch instead of forked workers")
+        return False
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (list, tuple)):
@@ -123,7 +164,7 @@ class DataLoader:
     def __iter__(self):
         if self.num_workers == 0:
             return self._sync_iter()
-        if self.use_shared_memory:
+        if self.use_shared_memory and _fork_is_safe():
             from .. import _native
             if _native.lib() is not None:
                 from .shm_worker import MultiprocessIter

@@ -83,15 +83,47 @@ class _RingReader:
         self._L.pt_ring_free(self._ring, 1 if unlink else 0)
 
 
-def _worker_loop(loader, worker_id, num_workers, ring_name, epoch_seed):
-    """Forked child body: produce this worker's share of batches in order."""
+def _to_numpy_tree(obj):
+    """Device-free view of a sample/batch: forked workers must never touch
+    the inherited XLA runtime (jnp array construction re-enters it), so
+    everything crossing the ring is plain numpy; the parent re-wraps."""
+    from ..core.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._value)
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
+        return type(obj)(*(_to_numpy_tree(o) for o in obj))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(loader, worker_id, num_workers, ring_name, epoch_seed,
+                 batches):
+    """Forked child body: produce this worker's share of batches in order.
+
+    `batches` is this worker's slice of the batch index lists, materialised
+    in the PARENT (the sampler's shuffle permutation is drawn exactly once,
+    parent-side — worker RNG state cannot change the data split; reference
+    ships indices to workers the same way, dataloader_iter.py)."""
     global _WORKER_INFO
     signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent handles ^C
     _WORKER_INFO = WorkerInfo(worker_id, num_workers, loader.dataset)
-    # every worker sees the SAME shuffle permutation for this epoch, and the
-    # parent advanced its RNG drawing epoch_seed, so epochs differ
-    np.random.seed(epoch_seed)
+    if batches is None:
+        # IterableDataset split relies on every worker replaying the SAME
+        # stream (keep batches b where b % W == id) — seeds must be identical
+        np.random.seed(epoch_seed)
+    else:
+        # map-style: the split is fixed by parent-materialised indices, so
+        # per-worker streams are safe (and give independent augmentations)
+        np.random.seed(epoch_seed + worker_id)
     writer = _RingWriter(ring_name, 0)
+
+    def _collate(samples):
+        return _to_numpy_tree(loader.collate_fn(
+            [_to_numpy_tree(s) for s in samples]))
+
     try:
         if loader.worker_init_fn is not None:
             loader.worker_init_fn(worker_id)
@@ -104,17 +136,15 @@ def _worker_loop(loader, worker_id, num_workers, ring_name, epoch_seed):
                 batch.append(sample)
                 if len(batch) == loader.batch_size:
                     if b % num_workers == worker_id:
-                        writer.send(loader.collate_fn(batch))
+                        writer.send(_collate(batch))
                     batch = []
                     b += 1
             if batch and not loader.drop_last and b % num_workers == worker_id:
-                writer.send(loader.collate_fn(batch))
+                writer.send(_collate(batch))
         else:
-            for b, indices in enumerate(loader.batch_sampler):
-                if b % num_workers != worker_id:
-                    continue
+            for indices in batches:
                 samples = [loader.dataset[i] for i in indices]
-                writer.send(loader.collate_fn(samples))
+                writer.send(_collate(samples))
     except BaseException as e:
         try:
             writer.send(("__worker_error__", worker_id, repr(e)))
@@ -139,8 +169,16 @@ class MultiprocessIter:
         self.timeout_ms = int(loader.timeout * 1000) if loader.timeout else None
         self._poll_ms = 5000
         # drawn from the parent RNG: advances it (fresh shuffle every epoch)
-        # and gives all workers one shared permutation
         self._epoch_seed = int(np.random.randint(0, 2 ** 31 - 1))
+        # Materialise the epoch's batch index lists HERE, in the parent:
+        # the sampler's permutation is drawn from parent RNG exactly once and
+        # workers receive index slices, so nothing a worker does to its own
+        # RNG can duplicate or drop samples.
+        from .dataset import IterableDataset
+        if isinstance(loader.dataset, IterableDataset):
+            self._batches = None
+        else:
+            self._batches = [list(ix) for ix in loader.batch_sampler]
         self._readers = []
         self._pids = []
         self._exhausted = [False] * self.num_workers
@@ -159,7 +197,9 @@ class MultiprocessIter:
                     pass
                 try:
                     _worker_loop(loader, w, self.num_workers, f"{base}_{w}",
-                                 self._epoch_seed)
+                                 self._epoch_seed,
+                                 None if self._batches is None
+                                 else self._batches[w::self.num_workers])
                 finally:
                     os._exit(0)
             self._pids.append(pid)

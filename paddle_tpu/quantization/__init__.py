@@ -69,14 +69,22 @@ class _QuantLayerMixin:
     (reference: imperative/qat.py QuantizedLinear/QuantizedConv2D wrappers +
     moving_average_abs_max_scale op)."""
 
-    def _init_quant(self, bits, momentum=0.9):
-        self._qbits = bits
+    def _init_quant(self, weight_bits, activation_bits=None, momentum=0.9):
+        self._qbits = weight_bits
+        self._qabits = activation_bits if activation_bits is not None \
+            else weight_bits
         self._qmomentum = momentum
         self._act_scale = 1.0
         self._act_scale_initialized = False
         self._frozen = False
+        # per-instance calibration hook (PTQ percentile observer); instance
+        # state, never a class-wide patch, so concurrent models can't
+        # interfere and an exception can't leave the class corrupted
+        self._act_observer = None
 
     def _quant_act(self, x):
+        if self._act_observer is not None:
+            self._act_observer(self, x)
         if not self._frozen:
             cur = float(np.asarray(jax.device_get(_absmax(unwrap(x)))))
             if not self._act_scale_initialized:
@@ -85,7 +93,7 @@ class _QuantLayerMixin:
             else:
                 m = self._qmomentum
                 self._act_scale = m * self._act_scale + (1 - m) * cur
-        return fake_quant(x, self._act_scale, self._qbits,
+        return fake_quant(x, self._act_scale, self._qabits,
                           op_name="fake_quant_act")
 
     def _quant_weight(self, w):
@@ -98,11 +106,11 @@ class _QuantLayerMixin:
 
 
 class QuantizedLinear(Layer, _QuantLayerMixin):
-    def __init__(self, layer, bits=8):
+    def __init__(self, layer, bits=8, activation_bits=None):
         super().__init__()
         self.weight = layer.weight
         self.bias = layer.bias
-        self._init_quant(bits)
+        self._init_quant(bits, activation_bits)
 
     def forward(self, x):
         return F.linear(self._quant_act(x), self._quant_weight(self.weight),
@@ -110,14 +118,14 @@ class QuantizedLinear(Layer, _QuantLayerMixin):
 
 
 class QuantizedConv2D(Layer, _QuantLayerMixin):
-    def __init__(self, layer, bits=8):
+    def __init__(self, layer, bits=8, activation_bits=None):
         super().__init__()
         self.weight = layer.weight
         self.bias = layer.bias
         self._inner = dict(stride=layer._stride, padding=layer._padding,
                            dilation=layer._dilation, groups=layer._groups,
                            data_format=layer._data_format)
-        self._init_quant(bits)
+        self._init_quant(bits, activation_bits)
 
     def forward(self, x):
         return F.conv2d(self._quant_act(x), self._quant_weight(self.weight),
@@ -135,6 +143,7 @@ class ImperativeQuantAware:
     def __init__(self, weight_bits=8, activation_bits=8,
                  quantizable_layer_type=("Linear", "Conv2D"), **kw):
         self._bits = weight_bits
+        self._abits = activation_bits
         self._types = tuple(
             cls for cls in _QUANTIZABLE
             if cls.__name__ in quantizable_layer_type)
@@ -149,7 +158,7 @@ class ImperativeQuantAware:
                 continue
             if isinstance(sub, self._types):
                 layer._sub_layers[name] = _QUANTIZABLE[type(sub)](
-                    sub, self._bits)
+                    sub, self._bits, self._abits)
             else:
                 self._swap(sub)
 
@@ -169,42 +178,42 @@ class PTQ:
 
     def __init__(self, activation_bits=8, weight_bits=8,
                  algo="abs_max", percentile=0.999):
-        self._bits = activation_bits
+        self._abits = activation_bits
+        self._wbits = weight_bits
         self._algo = algo
         self._pct = percentile
 
     def quantize(self, model, calib_loader, max_batches=16):
         """Swap layers, run calibration batches, freeze scales."""
-        ImperativeQuantAware(self._bits, self._bits).quantize(model)
-        observed = []
+        ImperativeQuantAware(self._wbits, self._abits).quantize(model)
+        qlayers = [sub for sub in model.sublayers(include_self=True)
+                   if isinstance(sub, _QuantLayerMixin)]
 
         if self._algo == "percentile":
             # collect per-layer activation samples, then take the percentile
             samples = {}
-            orig = _QuantLayerMixin._quant_act
 
             def observing(self_l, x):
                 v = np.abs(np.asarray(unwrap(x))).ravel()
                 samples.setdefault(id(self_l), []).append(v)
-                return orig(self_l, x)
 
-            _QuantLayerMixin._quant_act = observing
+            for sub in qlayers:
+                sub._act_observer = observing
             try:
                 self._run_calib(model, calib_loader, max_batches)
             finally:
-                _QuantLayerMixin._quant_act = orig
-            for sub in model.sublayers(include_self=True):
-                if isinstance(sub, _QuantLayerMixin) and id(sub) in samples:
+                for sub in qlayers:
+                    sub._act_observer = None
+            for sub in qlayers:
+                if id(sub) in samples:
                     allv = np.concatenate(samples[id(sub)])
                     sub._act_scale = float(np.quantile(allv, self._pct))
                     sub._act_scale_initialized = True
         else:
             self._run_calib(model, calib_loader, max_batches)
 
-        for sub in model.sublayers(include_self=True):
-            if isinstance(sub, _QuantLayerMixin):
-                sub.freeze()
-                observed.append(sub)
+        for sub in qlayers:
+            sub.freeze()
         return model
 
     @staticmethod

@@ -214,33 +214,54 @@ def viterbi_decode(potentials, transition_params, lengths=None,
 
     pot = unwrap(potentials)
     trans = unwrap(transition_params)
+    lens = None if lengths is None else unwrap(lengths)
 
-    def decode(pot, trans):
+    def decode(pot, trans, lens):
         B, T, N = pot.shape
+        lens_arr = (jnp.full((B,), T, dtype=jnp.int32) if lens is None
+                    else lens.astype(jnp.int32))
+        # reference convention: with include_bos_eos_tag the last two tags of
+        # transition_params are BOS (N-2) and EOS (N-1)
+        alpha0 = pot[:, 0, :]
+        if include_bos_eos_tag:
+            alpha0 = alpha0 + trans[N - 2][None, :]
 
-        def step(alpha, emit):
+        ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+
+        def step(alpha, x):
+            emit, t = x
             # alpha: [B, N] best score ending in tag j
             scores = alpha[:, :, None] + trans[None, :, :]  # [B, prev, next]
             best_prev = jnp.argmax(scores, axis=1)          # [B, N]
             alpha2 = jnp.max(scores, axis=1) + emit         # [B, N]
-            return alpha2, best_prev
+            # past a sequence's end: carry alpha unchanged and let the
+            # backtrace pass the final tag through (identity backpointer),
+            # so padded steps contribute no transitions/emissions
+            active = (t < lens_arr)[:, None]
+            return (jnp.where(active, alpha2, alpha),
+                    jnp.where(active, best_prev, ident))
 
-        alpha0 = pot[:, 0, :]
         alpha, backptrs = jax.lax.scan(
-            step, alpha0, jnp.moveaxis(pot[:, 1:, :], 1, 0))
+            step, alpha0,
+            (jnp.moveaxis(pot[:, 1:, :], 1, 0), jnp.arange(1, T)))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, N - 1][None, :]
         last = jnp.argmax(alpha, axis=-1)                   # [B]
         score = jnp.max(alpha, axis=-1)
 
         def back(tag, bp):
+            # bp slot k maps tag@(k+1) -> tag@k; emitting prev puts tag@k in
+            # output slot k (emitting the incoming carry would shift the
+            # whole path by one position)
             prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
-            return prev, tag
+            return prev, prev
 
         _, path_rev = jax.lax.scan(back, last, backptrs, reverse=True)
         paths = jnp.concatenate(
             [jnp.moveaxis(path_rev, 0, 1), last[:, None]], axis=1)
         return score, paths
 
-    s, p = decode(pot, trans)
+    s, p = decode(pot, trans, lens)
     return wrap(s), wrap(p)
 
 

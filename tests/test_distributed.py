@@ -208,6 +208,44 @@ def test_collective_functional_in_shard_map():
     np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
 
 
+def test_broadcast_and_p2p_in_shard_map():
+    """broadcast is mask+psum (one copy over the wire); p2p_transfer moves
+    src's shard to dst via one ppermute; send/recv raise loudly in SPMD."""
+    mesh = dist.make_mesh({"dp": 8})
+    dist.set_mesh(mesh)
+    group = dist.new_group(axis_name="dp")
+
+    from paddle_tpu.core.tensor import Tensor
+
+    def bcast_body(x):
+        t = Tensor(x)
+        dist.broadcast(t, src=3, group=group)
+        return t._value
+
+    x = np.arange(8, dtype=np.float32)
+    out = jax.shard_map(bcast_body, mesh=mesh, in_specs=P("dp"),
+                        out_specs=P("dp"))(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+
+    def p2p_body(x):
+        return dist.p2p_transfer(Tensor(x), src=2, dst=5, group=group)._value
+
+    out = jax.shard_map(p2p_body, mesh=mesh, in_specs=P("dp"),
+                        out_specs=P("dp"))(x)
+    want = np.zeros(8, np.float32)
+    want[5] = 2.0
+    np.testing.assert_allclose(np.asarray(out), want)
+
+    def send_body(x):
+        dist.send(Tensor(x), dst=1, group=group)
+        return x
+
+    import pytest
+    with pytest.raises(Exception, match="p2p_transfer"):
+        jax.shard_map(send_body, mesh=mesh, in_specs=P("dp"),
+                      out_specs=P("dp"))(x)
+
+
 def test_pipeline_layer_segmentation():
     from paddle_tpu.distributed.fleet.meta_parallel import (
         LayerDesc, PipelineLayer)

@@ -132,7 +132,8 @@ def test_viterbi_decode():
     pot = np.array([[[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]]], np.float32)
     trans = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
     score, path = viterbi_decode(paddle.to_tensor(pot),
-                                 paddle.to_tensor(trans))
+                                 paddle.to_tensor(trans),
+                                 include_bos_eos_tag=False)
     path = np.asarray(path.numpy())[0]
     assert path.shape == (3,)
     # brute-force check
@@ -147,6 +148,43 @@ def test_viterbi_decode():
     assert list(path) == best_p
     np.testing.assert_allclose(float(np.asarray(score.numpy())[0]), best,
                                rtol=1e-5)
+
+
+def test_viterbi_decode_lengths_and_bos_eos():
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(7)
+    B, T, N = 3, 6, 5  # tags 3,4 are BOS/EOS when include_bos_eos_tag
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.array([6, 3, 1], np.int64)
+
+    def brute(b, L, with_tag):
+        import itertools
+        best, best_p = -1e18, None
+        for tags in itertools.product(range(N), repeat=L):
+            s = pot[b, 0, tags[0]]
+            if with_tag:
+                s += trans[N - 2, tags[0]]
+            for t in range(1, L):
+                s += trans[tags[t - 1], tags[t]] + pot[b, t, tags[t]]
+            if with_tag:
+                s += trans[tags[L - 1], N - 1]
+            if s > best:
+                best, best_p = s, list(tags)
+        return best, best_p
+
+    for with_tag in (False, True):
+        score, path = viterbi_decode(paddle.to_tensor(pot),
+                                     paddle.to_tensor(trans),
+                                     lengths=paddle.to_tensor(lens),
+                                     include_bos_eos_tag=with_tag)
+        score = np.asarray(score.numpy())
+        path = np.asarray(path.numpy())
+        for b in range(B):
+            L = int(lens[b])
+            want_s, want_p = brute(b, L, with_tag)
+            np.testing.assert_allclose(score[b], want_s, rtol=1e-5)
+            assert list(path[b, :L]) == want_p, (b, with_tag)
 
 
 def test_metrics_auc_precision_recall():
