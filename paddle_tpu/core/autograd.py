@@ -12,6 +12,8 @@ one XLA computation.
 import threading
 from contextlib import contextmanager
 
+import numpy as np
+from jax import dtypes as _jax_dtypes
 import jax.numpy as jnp
 
 __all__ = [
@@ -85,7 +87,12 @@ class TapeNode:
         out = []
         for c, (shape, dtype) in zip(cots, self.out_meta):
             if c is None:
-                c = jnp.zeros(shape, dtype)
+                if jnp.issubdtype(dtype, jnp.inexact):
+                    c = jnp.zeros(shape, dtype)
+                else:
+                    # integer/bool outputs (e.g. loop counters carried through
+                    # a control-flow op): jax.vjp expects float0 cotangents
+                    c = np.zeros(shape, _jax_dtypes.float0)
             elif c.dtype != dtype:
                 # AMP boundary: downstream ran in a different precision
                 c = c.astype(dtype)

@@ -15,7 +15,7 @@ from . import autograd
 from .dtype import is_floating
 
 __all__ = ["call_op", "call_op_nograd", "wrap", "unwrap", "_STATIC_HOOK",
-           "add_observer", "remove_observer"]
+           "add_observer", "remove_observer", "OpCapture", "capture_ops"]
 
 # When paddle.static program_guard is active, this holds Program.record and
 # every op call is captured into the program instead of the autograd tape.
@@ -47,6 +47,58 @@ def _is_tensor(x):
     from .tensor import Tensor
 
     return isinstance(x, Tensor)
+
+
+# Closure-capture for control flow: while a capture is active, every
+# differentiated Tensor that an op reads and that was NOT created inside the
+# captured region is recorded as an external operand. Control-flow lowering
+# (nn/control_flow.py) uses this to turn closure-captured parameters (e.g. RNN
+# weights read inside a while_loop body) into explicit lax.cond/scan operands
+# so the tape can differentiate through the XLA construct. The reference gets
+# the same information from sub-block var scoping
+# (paddle/fluid/operators/controlflow/while_op.cc external-var analysis).
+# Thread-local like _GradState: a DataLoader worker thread running ops must
+# not pollute a capture active on the tracing thread.
+import threading as _threading
+
+
+class _CaptureState(_threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_CAPTURE = _CaptureState()
+
+
+class OpCapture:
+    def __init__(self):
+        self._created = set()
+        self._ext_ids = set()
+        self.external = []  # external diff Tensors, in first-read order
+
+    def mark_created(self, tensors):
+        for t in tensors:
+            self._created.add(id(t))
+
+    def note_inputs(self, tensors):
+        for t in tensors:
+            i = id(t)
+            if i not in self._created and i not in self._ext_ids:
+                self._ext_ids.add(i)
+                self.external.append(t)
+
+
+class capture_ops:
+    def __init__(self, cap):
+        self._cap = cap
+
+    def __enter__(self):
+        _CAPTURE.stack.append(self._cap)
+        return self._cap
+
+    def __exit__(self, *exc):
+        _CAPTURE.stack.pop()
+        return False
 
 
 def unwrap(x):
@@ -125,6 +177,9 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
                 diff_positions.append(("k", k))
                 diff_tensors.append(v)
 
+    if _CAPTURE.stack and diff_tensors:
+        _CAPTURE.stack[-1].note_inputs(diff_tensors)
+
     if not diff_tensors:
         return _call_op_nograd_impl(fn, *args, op_name=op_name, **kwargs)
 
@@ -147,6 +202,8 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
         t._tape_node = node
         t._tape_index = i
         tensors.append(t)
+    if _CAPTURE.stack:
+        _CAPTURE.stack[-1].mark_created(tensors)
     if len(tensors) == 1:
         return tensors[0]
     return tuple(tensors)

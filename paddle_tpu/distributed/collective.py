@@ -113,7 +113,8 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             # nranks copies to keep one).
             idx = jax.lax.axis_index(ax)
             masked = jnp.where(idx == src, v, jnp.zeros_like(v))
-            return jax.lax.psum(masked, ax)
+            # psum promotes bool→int32; restore the caller's dtype
+            return jax.lax.psum(masked, ax).astype(v.dtype)
         out = call_op(_bcast, tensor, op_name="c_broadcast")
         tensor._value = out._value
         tensor._tape_node = out._tape_node

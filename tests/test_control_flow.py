@@ -1,0 +1,321 @@
+"""Control flow: while_loop / cond / case / switch_case.
+
+Mirrors the reference's `test_while_loop_op.py` / `test_cond.py` /
+`test_case.py` / `test_switch_case.py` coverage classes: output parity with
+numpy, gradient checks (incl. closure weights), and behavior under
+`@to_static` with data-dependent predicates.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core import state
+from paddle_tpu.jit import to_static
+
+
+def t(x, stop_gradient=True, dtype=None):
+    return Tensor(np.asarray(x), dtype=dtype, stop_gradient=stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+
+class TestCond:
+    def test_eager_concrete_pred(self):
+        x = t([1.0, 2.0], stop_gradient=False)
+        out = nn.cond(t(True), lambda: x * 2, lambda: x * 3)
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+        out = nn.cond(t(False), lambda: x * 2, lambda: x * 3)
+        np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
+
+    def test_eager_grad_through_taken_branch(self):
+        x = t([1.0, 2.0], stop_gradient=False)
+        out = nn.cond(t(True), lambda: (x * x).sum(), lambda: x.sum())
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+    def test_traced_data_dependent(self):
+        @to_static
+        def f(x):
+            # pred depends on data → must lower to lax.cond
+            return nn.cond(x.sum() > 0, lambda: x * 2, lambda: x * -1)
+
+        np.testing.assert_allclose(f(t([1.0, 2.0])).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(f(t([-1.0, -2.0])).numpy(), [1.0, 2.0])
+
+    def test_traced_grad_with_closure_weight(self):
+        w = t([2.0, 3.0], stop_gradient=False)
+        w.persistable = True
+        uid = state.register(w)
+        try:
+            @to_static
+            def f(x):
+                out = nn.cond(x.sum() > 0,
+                              lambda: (x * w).sum(),
+                              lambda: (x - w).sum())
+                out.backward()
+                return out
+
+            x = t([1.0, 2.0], stop_gradient=False)
+            f(x)
+            # taken branch: d(x*w)/dw = x
+            np.testing.assert_allclose(w.grad.numpy(), [1.0, 2.0])
+        finally:
+            state.unregister(uid)
+
+    def test_traced_multi_output(self):
+        @to_static
+        def f(x):
+            a, b = nn.cond(x.sum() > 0,
+                           lambda: (x + 1, x + 2),
+                           lambda: (x - 1, x - 2))
+            return a + b
+
+        np.testing.assert_allclose(f(t([1.0])).numpy(), [5.0])
+        np.testing.assert_allclose(f(t([-5.0])).numpy(), [-13.0])
+
+    def test_mismatched_structures_raise(self):
+        @to_static
+        def f(x):
+            return nn.cond(x.sum() > 0,
+                           lambda: (x, x),
+                           lambda: x)
+
+        with pytest.raises(ValueError, match="different structures"):
+            f(t([1.0]))
+
+
+# ---------------------------------------------------------------------------
+# while_loop
+# ---------------------------------------------------------------------------
+
+class TestWhileLoop:
+    def test_eager_counter(self):
+        i = t(0, dtype="int64")
+        ten = t(10, dtype="int64")
+        out = nn.while_loop(lambda i: i < ten, lambda i: [i + 1], [i])
+        assert int(out[0].numpy()) == 10
+
+    def test_eager_grad(self):
+        x = t([1.0, 1.0], stop_gradient=False)
+        i = t(0, dtype="int64")
+
+        def body(i, acc):
+            return [i + 1, acc * 2.0]
+
+        out = nn.while_loop(lambda i, acc: i < t(3, dtype="int64"),
+                            body, [i, x])
+        loss = out[1].sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0, 8.0])
+
+    def test_traced_nograd(self):
+        @to_static
+        def f(n):
+            with paddle.no_grad():
+                i = paddle.zeros([], dtype="int32")
+                s = paddle.zeros([], dtype="float32")
+                i, s = nn.while_loop(
+                    lambda i, s: i < n,
+                    lambda i, s: [i + 1, s + paddle.cast(i, "float32")],
+                    [i, s])
+            return s
+
+        # sum of 0..n-1, with n data-dependent
+        assert float(f(t(5, dtype="int32")).numpy()) == 10.0
+        assert float(f(t(7, dtype="int32")).numpy()) == 21.0
+
+    def test_traced_grad_rnn_style(self):
+        """RNN over time steps via while_loop with a closure weight; grads
+        must flow to the weight through the masked-scan lowering."""
+        w = t(np.full((4, 4), 0.1, np.float32), stop_gradient=False)
+        w.persistable = True
+        uid = state.register(w)
+        try:
+            @to_static
+            def step(x, n):
+                h = paddle.zeros([2, 4], dtype="float32")
+                i = paddle.zeros([], dtype="int32")
+
+                def body(i, h):
+                    # h_{t+1} = tanh(h W + x_t)
+                    xt = x[:, :]  # same input each step (keeps shapes static)
+                    return [i + 1, paddle.tanh(paddle.matmul(h, w) + xt)]
+
+                i, h = nn.while_loop(lambda i, h: i < n, body, [i, h],
+                                     maximum_trip_count=8)
+                loss = h.sum()
+                loss.backward()
+                return loss
+
+            x = t(np.ones((2, 4), np.float32))
+            l3 = float(step(x, t(3, dtype="int32")).numpy())
+            g3 = np.array(w.grad.numpy())
+            assert np.abs(g3).sum() > 0  # grads reached the closure weight
+            w.clear_grad()
+            l5 = float(step(x, t(5, dtype="int32")).numpy())
+            g5 = np.array(w.grad.numpy())
+            # more steps → different loss and grads (data-dependent trip count)
+            assert l3 != l5
+            assert not np.allclose(g3, g5)
+        finally:
+            state.unregister(uid)
+
+    def test_traced_grad_numeric_check(self):
+        """Numeric-vs-analytic gradient through the masked-scan while."""
+        w = t([0.5], stop_gradient=False)
+        w.persistable = True
+        uid = state.register(w)
+        try:
+            @to_static
+            def f(n):
+                i = paddle.zeros([], dtype="int32")
+                acc = paddle.ones([1], dtype="float32")
+                i, acc = nn.while_loop(
+                    lambda i, a: i < n,
+                    lambda i, a: [i + 1, a * w],
+                    [i, acc], maximum_trip_count=6)
+                loss = acc.sum()
+                loss.backward()
+                return loss
+
+            n = t(3, dtype="int32")
+            f(n)
+            # loss = w^3 → dloss/dw = 3 w^2
+            np.testing.assert_allclose(w.grad.numpy(), [3 * 0.5 ** 2],
+                                       rtol=1e-5)
+        finally:
+            state.unregister(uid)
+
+    def test_traced_grad_without_bound_raises(self):
+        w = t([2.0], stop_gradient=False)
+        w.persistable = True
+        uid = state.register(w)
+        try:
+            @to_static
+            def f(n):
+                i = paddle.zeros([], dtype="int32")
+                v = paddle.ones([1], dtype="float32")
+                return nn.while_loop(lambda i, v: i < n,
+                                     lambda i, v: [i + 1, v * w],
+                                     [i, v])
+
+            with pytest.raises(Exception, match="maximum_trip_count"):
+                f(t(3, dtype="int32"))
+        finally:
+            state.unregister(uid)
+
+    def test_traced_grad_truncation_poisons_with_nan(self):
+        """If the bound is too small the loop must not silently truncate:
+        float outputs are NaN-poisoned so monitoring catches it."""
+        w = t([1.1], stop_gradient=False)
+        w.persistable = True
+        uid = state.register(w)
+        try:
+            @to_static
+            def f(n):
+                i = paddle.zeros([], dtype="int32")
+                acc = paddle.ones([1], dtype="float32")
+                i, acc = nn.while_loop(
+                    lambda i, a: i < n,
+                    lambda i, a: [i + 1, a * w],
+                    [i, acc], maximum_trip_count=4)
+                return acc
+
+            ok = f(t(4, dtype="int32"))  # exactly at the bound: fine
+            assert np.isfinite(ok.numpy()).all()
+            bad = f(t(6, dtype="int32"))  # needs 6 > 4 trips: poisoned
+            assert np.isnan(bad.numpy()).all()
+        finally:
+            state.unregister(uid)
+
+    def test_bad_loop_vars(self):
+        with pytest.raises(ValueError):
+            nn.while_loop(lambda: True, lambda: [], [])
+
+
+# ---------------------------------------------------------------------------
+# case / switch_case
+# ---------------------------------------------------------------------------
+
+class TestCaseSwitch:
+    def test_case_eager(self):
+        x = t([1.0])
+        out = nn.case([(t(False), lambda: x + 1), (t(True), lambda: x + 2)],
+                      default=lambda: x + 9)
+        np.testing.assert_allclose(out.numpy(), [3.0])
+        out = nn.case([(t(False), lambda: x + 1), (t(False), lambda: x + 2)],
+                      default=lambda: x + 9)
+        np.testing.assert_allclose(out.numpy(), [10.0])
+
+    def test_case_traced_first_true_wins(self):
+        @to_static
+        def f(x):
+            return nn.case([(x.sum() > 0, lambda: x + 1),
+                            (x.sum() > -10, lambda: x + 2)],
+                           default=lambda: x + 9)
+
+        np.testing.assert_allclose(f(t([1.0])).numpy(), [2.0])
+        np.testing.assert_allclose(f(t([-1.0])).numpy(), [1.0])
+        np.testing.assert_allclose(f(t([-100.0])).numpy(), [-91.0])
+
+    def test_switch_case_eager(self):
+        x = t([1.0])
+        fns = {1: lambda: x * 1, 2: lambda: x * 2, 3: lambda: x * 3}
+        out = nn.switch_case(t(2, dtype="int32"), fns)
+        np.testing.assert_allclose(out.numpy(), [2.0])
+        # unmatched → default = highest-key branch (reference semantics)
+        out = nn.switch_case(t(7, dtype="int32"), fns)
+        np.testing.assert_allclose(out.numpy(), [3.0])
+
+    def test_switch_case_traced(self):
+        @to_static
+        def f(idx, x):
+            return nn.switch_case(
+                idx, {0: lambda: x + 10, 2: lambda: x + 20},
+                default=lambda: x - 1)
+
+        x = t([1.0])
+        np.testing.assert_allclose(f(t(0, dtype="int32"), x).numpy(), [11.0])
+        np.testing.assert_allclose(f(t(2, dtype="int32"), x).numpy(), [21.0])
+        np.testing.assert_allclose(f(t(5, dtype="int32"), x).numpy(), [0.0])
+
+    def test_switch_case_traced_grad(self):
+        w = t([2.0], stop_gradient=False)
+        w.persistable = True
+        uid = state.register(w)
+        try:
+            @to_static
+            def f(idx, x):
+                out = nn.switch_case(
+                    idx, {0: lambda: (x * w).sum(),
+                          1: lambda: (x * w * w).sum()})
+                out.backward()
+                return out
+
+            x = t([3.0])
+            f(t(1, dtype="int32"), x)
+            # d(x*w^2)/dw = 2xw = 12
+            np.testing.assert_allclose(w.grad.numpy(), [12.0], rtol=1e-5)
+        finally:
+            state.unregister(uid)
+
+
+# ---------------------------------------------------------------------------
+# TensorArray
+# ---------------------------------------------------------------------------
+
+class TestTensorArray:
+    def test_write_read_length(self):
+        arr = nn.create_array()
+        nn.array_write(t([1.0]), t(0, dtype="int64"), arr)
+        nn.array_write(t([2.0]), t(1, dtype="int64"), arr)
+        assert int(nn.array_length(arr).numpy()) == 2
+        np.testing.assert_allclose(nn.array_read(arr, t(1, dtype="int64")).numpy(),
+                                   [2.0])
+        nn.array_write(t([5.0]), t(0, dtype="int64"), arr)  # overwrite
+        np.testing.assert_allclose(nn.array_read(arr, t(0, dtype="int64")).numpy(),
+                                   [5.0])
