@@ -101,6 +101,30 @@ class capture_ops:
         return False
 
 
+class bind_values:
+    """Temporarily rebind Tensors' values (e.g. to traced operands) while a
+    closure re-runs functionally. Used by control-flow lowering and the
+    StableHLO exporter."""
+
+    def __init__(self, tensors, values):
+        self._tensors = tensors
+        self._values = values
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = [(t._value, t._tape_node) for t in self._tensors]
+        for t, v in zip(self._tensors, self._values):
+            t._value = v
+            t._tape_node = None
+        return self
+
+    def __exit__(self, *exc):
+        for t, (v, node) in zip(self._tensors, self._saved):
+            t._value = v
+            t._tape_node = node
+        return False
+
+
 def unwrap(x):
     return x._value if _is_tensor(x) else x
 
@@ -177,8 +201,14 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
                 diff_positions.append(("k", k))
                 diff_tensors.append(v)
 
-    if _CAPTURE.stack and diff_tensors:
-        _CAPTURE.stack[-1].note_inputs(diff_tensors)
+    if _CAPTURE.stack:
+        # capture every Tensor input: diff tensors need gradient operands,
+        # non-diff ones (feeds, int tensors, frozen weights) still need to be
+        # operands so static-program replay and re-tracing see live values,
+        # not the values baked at capture time
+        _CAPTURE.stack[-1].note_inputs(
+            [a for a in args if _is_tensor(a)]
+            + [v for v in kwargs.values() if _is_tensor(v)])
 
     if not diff_tensors:
         return _call_op_nograd_impl(fn, *args, op_name=op_name, **kwargs)
@@ -222,10 +252,20 @@ def call_op_nograd(fn, *args, op_name=None, **kwargs):
 def _call_op_nograd_impl(fn, *args, op_name=None, **kwargs):
     if _STATIC_HOOK[0] is not None:
         return _STATIC_HOOK[0](fn, args, kwargs, op_name)
+    if _CAPTURE.stack:
+        _CAPTURE.stack[-1].note_inputs(
+            [a for a in args if _is_tensor(a)]
+            + [v for v in kwargs.values() if _is_tensor(v)])
     a = _amp_cast(op_name or getattr(fn, "__name__", "op"),
                   [unwrap(x) for x in args])
     k = {key: unwrap(v) for key, v in kwargs.items()}
     out = fn(*a, **k)
     if isinstance(out, tuple):
-        return tuple(wrap(o) for o in out)
-    return wrap(out)
+        out = tuple(wrap(o) for o in out)
+        if _CAPTURE.stack:
+            _CAPTURE.stack[-1].mark_created(out)
+        return out
+    out = wrap(out)
+    if _CAPTURE.stack:
+        _CAPTURE.stack[-1].mark_created((out,))
+    return out

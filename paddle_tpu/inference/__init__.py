@@ -1,7 +1,13 @@
 """Inference API (reference: `paddle/fluid/inference/api/analysis_predictor.cc`
-+ `python/paddle/inference/`). TPU re-design: AnalysisPredictor's
-ir-pass-optimize + NaiveExecutor pipeline collapses to load → jit-compile →
-serve; XLA does the graph optimization the 40 fuse passes did.
++ `python/paddle/inference/`).
+
+TPU re-design: AnalysisPredictor's ir-pass-optimize + NaiveExecutor pipeline
+collapses to deserialize-StableHLO → jit-compile → serve (XLA does the graph
+optimization the reference's 40 fuse passes did, at load time). The Predictor
+needs only the `.pdmodel`/`.pdiparams` artifact pair written by
+`paddle.jit.save(..., input_spec=...)` or `paddle.static.save_inference_model`
+— never the model's Python class (parity with `analysis_predictor.cc:389` Run,
+which serves from the serialized `__model__` alone).
 """
 import numpy as np
 
@@ -9,62 +15,103 @@ from ..core.tensor import Tensor
 
 
 class Config:
-    """AnalysisConfig analog."""
+    """AnalysisConfig analog. Only the artifact paths matter on TPU; the
+    CUDA/IR knobs are accepted for API compatibility and recorded as flags."""
 
     def __init__(self, model_path=None, params_path=None):
         self.model_path = model_path
         self.params_path = params_path
         self._use_tpu = True
+        self._ir_optim = True
+        self._memory_optim = False
+        self._cpu_math_threads = 1
 
-    def enable_use_gpu(self, *a, **k):
-        pass
+    # prog_file/params_file accessors (reference AnalysisConfig API)
+    def prog_file(self):
+        return self.model_path
+
+    def params_file(self):
+        return self.params_path
+
+    def enable_use_gpu(self, memory_pool_mb=100, device_id=0):
+        pass  # TPU build: device selection is via paddle.set_device
 
     def disable_gpu(self):
         pass
 
     def switch_ir_optim(self, flag=True):
-        pass  # XLA always optimizes
+        self._ir_optim = flag  # XLA always optimizes; recorded only
 
     def enable_memory_optim(self):
-        pass
+        self._memory_optim = True
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        self._cpu_math_threads = n
 
 
 class Predictor:
+    """Serves a saved artifact. Handle-based I/O mirrors the reference's
+    ZeroCopyTensor flow: get_input_handle().copy_from_cpu(); run();
+    get_output_handle().copy_to_cpu()."""
+
     def __init__(self, config):
-        from ..jit.io import load as jit_load
         path = config.model_path
         for suffix in (".pdmodel",):
             if path and path.endswith(suffix):
                 path = path[: -len(suffix)]
-        self._layer = jit_load(path)
+        from ..jit.export import has_artifact, ServedProgram
+        if has_artifact(path, params_path=config.params_path):
+            self._served = ServedProgram(path,
+                                         params_path=config.params_path)
+            self._input_names = self._served.input_names
+            self._output_names = self._served.output_names
+            self._runner = self._served
+        else:  # legacy same-codebase artifact
+            from ..jit.io import load as jit_load
+            layer = jit_load(path)
+            self._served = None
+            self._input_names = getattr(layer, "input_names", None) or []
+            self._output_names = getattr(layer, "output_names", None) or []
+            self._runner = lambda *xs: _as_list(layer(*xs))
         self._inputs = {}
         self._outputs = None
 
     def get_input_names(self):
-        return ["input_" + str(i) for i in range(8)]
+        return list(self._input_names)
 
     def get_input_handle(self, name):
         return _IOHandle(self._inputs, name)
 
     def get_output_names(self):
+        if self._output_names:
+            return list(self._output_names)
+        # legacy artifact, pre-run: at least one output always exists
         return ["output_0"] if self._outputs is None else [
             f"output_{i}" for i in range(len(self._outputs))]
 
     def get_output_handle(self, name):
-        idx = int(name.split("_")[-1])
-        return _OutHandle(self, idx)
+        if self._output_names and name in self._output_names:
+            return _OutHandle(self, self._output_names.index(name))
+        return _OutHandle(self, int(name.split("_")[-1]))
 
     def run(self, inputs=None):
         if inputs is None:
-            inputs = [self._inputs[k] for k in sorted(self._inputs)]
-        outs = self._layer(*[Tensor(np.asarray(x)) for x in inputs])
-        if not isinstance(outs, (tuple, list)):
-            outs = [outs]
-        self._outputs = [o.numpy() for o in outs]
+            order = self._input_names or sorted(self._inputs)
+            missing = [n for n in order if n not in self._inputs]
+            if missing:
+                raise ValueError(
+                    f"missing inputs {missing}; expected {order}")
+            inputs = [self._inputs[k] for k in order]
+        outs = self._runner(*[Tensor(np.asarray(x)) for x in inputs])
+        self._outputs = [np.asarray(o._value if isinstance(o, Tensor) else o)
+                         for o in _as_list(outs)]
         return self._outputs
+
+
+def _as_list(x):
+    if isinstance(x, (tuple, list)):
+        return list(x)
+    return [x]
 
 
 class _IOHandle:
@@ -76,7 +123,7 @@ class _IOHandle:
         self.store[self.name] = np.asarray(arr)
 
     def reshape(self, shape):
-        pass
+        pass  # shapes come from the fed array
 
 
 class _OutHandle:

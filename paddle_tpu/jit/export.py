@@ -1,0 +1,185 @@
+"""StableHLO program export: the process-independent model artifact.
+
+TPU-native analog of the reference's serialized ProgramDesc + params pair
+(`/root/reference/python/paddle/fluid/io.py:1246` save_inference_model writes
+`__model__` protobuf + persistables; `paddle/fluid/inference/io.cc` reloads it
+with no Python in sight). Here the portable program IR is **StableHLO** via
+`jax.export`: the forward is traced as a pure function of
+`(params_list, *inputs)`, serialized to bytes, and served by deserializing —
+no access to the model's Python class is needed at load site.
+
+Artifact layout (matching the reference's two-file convention):
+- ``{prefix}.pdmodel``   — zip: ``program.bin`` (jax.export bytes) +
+  ``meta.json`` (format version, input/output names, param names, specs).
+- ``{prefix}.pdiparams`` — npz of parameter arrays, ``p0..pN`` in meta order.
+
+Batch-size polymorphism: `InputSpec` dims that are None/-1 become symbolic
+export dimensions — axis 0 shares one symbol ("batch") across inputs, other
+dynamic axes get unique symbols. This is the XLA-native replacement for the
+reference's unconstrained feed shapes.
+"""
+import io as _io
+import json
+import os
+import zipfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from ..core import autograd
+from ..core.dtype import convert_dtype
+from ..core.dispatch import unwrap, bind_values
+from ..core.tensor import Tensor
+
+_FORMAT_VERSION = 1
+_SUFFIX_PARAMS = ".pdiparams"
+_SUFFIX_MODEL = ".pdmodel"
+
+
+def _input_structs(input_specs):
+    """InputSpec/Tensor/array list → jax.ShapeDtypeStruct list (symbolic dims
+    for None/-1 entries in InputSpec shapes)."""
+    structs, names = [], []
+    scope = None
+    n_sym = 0
+    for i, spec in enumerate(input_specs):
+        if isinstance(spec, Tensor):
+            structs.append(jax.ShapeDtypeStruct(tuple(spec.shape), spec.dtype))
+            names.append(spec.name or f"x{i}")
+            continue
+        if isinstance(spec, (np.ndarray, jnp.ndarray)):
+            structs.append(jax.ShapeDtypeStruct(np.shape(spec), spec.dtype))
+            names.append(f"x{i}")
+            continue
+        shape = list(spec.shape)
+        dtype = convert_dtype(spec.dtype) or np.dtype("float32")
+        if any(d is None or (isinstance(d, int) and d < 0) for d in shape):
+            if scope is None:
+                scope = jax_export.SymbolicScope()
+            dims = []
+            for ax, d in enumerate(shape):
+                if d is None or (isinstance(d, int) and d < 0):
+                    sym = "batch" if ax == 0 else f"dyn{n_sym}"
+                    n_sym += ax != 0
+                    dims.append(jax_export.symbolic_shape(sym, scope=scope)[0])
+                else:
+                    dims.append(d)
+            structs.append(jax.ShapeDtypeStruct(tuple(dims), dtype))
+        else:
+            structs.append(jax.ShapeDtypeStruct(tuple(shape), dtype))
+        names.append(getattr(spec, "name", None) or f"x{i}")
+    return structs, names
+
+
+def export_callable(fn, state_items, input_specs, output_names=None):
+    """Export `fn(*input_tensors)` as StableHLO.
+
+    `state_items`: [(name, Tensor)] — parameters/buffers the function reads
+    (they become the leading `params` argument of the exported program).
+    Returns (serialized_bytes, params_arrays, meta_dict).
+    """
+    names = [n for n, _ in state_items]
+    tensors = [t for _, t in state_items]
+    params = [np.asarray(unwrap(t)) for t in tensors]
+    out_info = {}
+
+    def pure(params_list, *inputs):
+        with bind_values(tensors, params_list), autograd.no_grad():
+            out = fn(*[Tensor(x) for x in inputs])
+        leaves, treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        out_info["n"] = len(leaves)
+        return [unwrap(l) for l in leaves]
+
+    in_structs, input_names = _input_structs(input_specs)
+    param_structs = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params]
+    exported = jax_export.export(
+        jax.jit(pure), platforms=("cpu", "tpu"))(param_structs, *in_structs)
+    blob = exported.serialize()
+
+    n_out = out_info.get("n", 1)
+    if output_names is None:
+        output_names = [f"output_{i}" for i in range(n_out)]
+    meta = {
+        "format_version": _FORMAT_VERSION,
+        "param_names": names,
+        "input_names": input_names,
+        "input_specs": [
+            {"shape": [d if isinstance(d, int) else None for d in s.shape],
+             "dtype": np.dtype(s.dtype).name} for s in in_structs],
+        "output_names": list(output_names)[:n_out],
+    }
+    return blob, params, meta
+
+
+def write_artifact(path_prefix, blob, params, meta):
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with zipfile.ZipFile(path_prefix + _SUFFIX_MODEL, "w") as z:
+        z.writestr("program.bin", blob)
+        z.writestr("meta.json", json.dumps(meta))
+    buf = _io.BytesIO()
+    np.savez(buf, **{f"p{i}": p for i, p in enumerate(params)})
+    with open(path_prefix + _SUFFIX_PARAMS, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def save_exported(path_prefix, fn, state_items, input_specs,
+                  output_names=None):
+    blob, params, meta = export_callable(fn, state_items, input_specs,
+                                         output_names)
+    write_artifact(path_prefix, blob, params, meta)
+
+
+def has_artifact(path_prefix, params_path=None):
+    p = path_prefix + _SUFFIX_MODEL
+    params = params_path or (path_prefix + _SUFFIX_PARAMS)
+    if not (os.path.exists(p) and os.path.exists(params)):
+        return False
+    try:
+        with zipfile.ZipFile(p) as z:
+            return "program.bin" in z.namelist()
+    except zipfile.BadZipFile:
+        return False  # legacy pickle .pdmodel
+
+
+class ServedProgram:
+    """A loaded model artifact: deserialized StableHLO + params. Serves
+    without the model's Python class (reference: AnalysisPredictor::Run,
+    `analysis_predictor.cc:389` — load __model__, run NaiveExecutor)."""
+
+    def __init__(self, path_prefix, params_path=None):
+        with zipfile.ZipFile(path_prefix + _SUFFIX_MODEL) as z:
+            blob = z.read("program.bin")
+            self.meta = json.loads(z.read("meta.json"))
+        params_file = params_path or (path_prefix + _SUFFIX_PARAMS)
+        if not os.path.exists(params_file):
+            raise FileNotFoundError(
+                f"params file not found: {params_file} (model: "
+                f"{path_prefix + _SUFFIX_MODEL})")
+        data = np.load(params_file)
+        self.params = [jnp.asarray(data[f"p{i}"])
+                       for i in range(len(self.meta["param_names"]))]
+        self._exported = jax_export.deserialize(blob)
+        self._call = jax.jit(self._exported.call)
+
+    @property
+    def input_names(self):
+        return list(self.meta["input_names"])
+
+    @property
+    def output_names(self):
+        return list(self.meta["output_names"])
+
+    def __call__(self, *inputs):
+        arrays = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                  for x in inputs]
+        out = self._call(self.params, *arrays)
+        return list(out)
+
+    def state_dict(self):
+        return {n: Tensor(p) for n, p in
+                zip(self.meta["param_names"], self.params)}

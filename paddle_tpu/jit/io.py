@@ -1,13 +1,19 @@
 """jit.save / jit.load — inference model export.
 
 Reference: `python/paddle/fluid/dygraph/jit.py:515/876` (save/load →
-TranslatedLayer) and `fluid/io.py:1246 save_inference_model`. The serialized
-artifact here is a state_dict archive + a pickled layer constructor spec; the
-serving runner (paddle_tpu.inference.Predictor) loads it and compiles the
-forward once. A StableHLO export path is planned for cross-process serving.
+TranslatedLayer) and `fluid/io.py:1246 save_inference_model`.
+
+Two artifacts are written:
+- With `input_spec`: a **process-independent** StableHLO artifact
+  (`.pdmodel` zip + `.pdiparams`) via jit/export.py — serveable by
+  `paddle_tpu.inference.Predictor` with no access to the model class
+  (the analog of the reference's `__model__` ProgramDesc).
+- Always: a state_dict archive + best-effort pickled layer
+  (`.pdlayer` + `.pdiparams.npz`) for same-codebase training reload.
 """
 import os
 import pickle
+import warnings
 
 import numpy as np
 
@@ -15,6 +21,7 @@ from ..core.tensor import Tensor
 
 _SUFFIX_PARAMS = ".pdiparams"
 _SUFFIX_MODEL = ".pdmodel"
+_SUFFIX_LAYER = ".pdlayer"
 
 
 def _save_state_dict_np(state_dict, path):
@@ -26,7 +33,11 @@ def _save_state_dict_np(state_dict, path):
 
 
 def save(layer, path, input_spec=None, **config):
-    """Save layer params + spec for later `jit.load` / Predictor serving."""
+    """Save layer params + spec for later `jit.load` / Predictor serving.
+
+    With `input_spec` (list of InputSpec/Tensors) the forward is additionally
+    exported to a StableHLO `.pdmodel` artifact that serves in any process.
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     sd = layer.state_dict()
     names = _save_state_dict_np(sd, path + _SUFFIX_PARAMS + ".npz")
@@ -34,15 +45,35 @@ def save(layer, path, input_spec=None, **config):
         "names": names,
         "class_module": type(layer).__module__,
         "class_name": type(layer).__qualname__,
-        "input_spec": input_spec,
+        "input_spec": None,
     }
     # Best effort: pickle the layer object itself for exact reload.
     try:
-        with open(path + _SUFFIX_MODEL, "wb") as f:
+        with open(path + _SUFFIX_LAYER, "wb") as f:
             pickle.dump({"meta": meta, "layer": layer}, f)
     except Exception:
-        with open(path + _SUFFIX_MODEL, "wb") as f:
+        with open(path + _SUFFIX_LAYER, "wb") as f:
             pickle.dump({"meta": meta, "layer": None}, f)
+
+    specs = input_spec if input_spec is not None else config.get(
+        "example_inputs")
+    if specs is None:
+        warnings.warn(
+            "jit.save without input_spec writes only the same-codebase "
+            "reload artifact; pass input_spec to export a "
+            "process-independent .pdmodel (StableHLO) for serving")
+        return
+    from .export import save_exported
+    # per-sublayer save/restore: a blanket layer.train() would clobber
+    # mixed modes (e.g. a frozen .eval() backbone inside a training model)
+    modes = [(l, l.training)
+             for _, l in layer.named_sublayers(include_self=True)]
+    layer.eval()
+    try:
+        save_exported(path, layer.forward, list(sd.items()), list(specs))
+    finally:
+        for l, m in modes:
+            l.training = m
 
 
 class TranslatedLayer:
@@ -67,14 +98,53 @@ class TranslatedLayer:
         return self._layer.state_dict()
 
 
+class ServedLayer:
+    """Inference layer backed by a loaded StableHLO artifact — callable like
+    the original model, no model class needed (reference: TranslatedLayer
+    loaded from __model__ ProgramDesc, jit.py:876)."""
+
+    def __init__(self, served):
+        self._served = served
+
+    def __call__(self, *args, **kwargs):
+        outs = self._served(*args)
+        tensors = [o if isinstance(o, Tensor) else Tensor(o) for o in outs]
+        return tensors[0] if len(tensors) == 1 else tuple(tensors)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        return self._served.state_dict()
+
+    @property
+    def input_names(self):
+        return self._served.input_names
+
+    @property
+    def output_names(self):
+        return self._served.output_names
+
+
 def load(path, **config):
-    with open(path + _SUFFIX_MODEL, "rb") as f:
+    from .export import has_artifact, ServedProgram
+    if has_artifact(path):
+        return ServedLayer(ServedProgram(path))
+
+    # same-codebase reload path (pickled layer + npz params)
+    layer_file = path + _SUFFIX_LAYER
+    if not os.path.exists(layer_file):
+        layer_file = path + _SUFFIX_MODEL  # pre-StableHLO saves
+    with open(layer_file, "rb") as f:
         blob = pickle.load(f)
     layer = blob["layer"]
     if layer is None:
         raise RuntimeError(
             f"{path}: layer class could not be pickled at save time; "
-            "reconstruct the layer and use set_state_dict + load_params")
+            "reconstruct the layer and use set_state_dict + load_params, or "
+            "re-save with input_spec for a class-free StableHLO artifact")
     data = np.load(path + _SUFFIX_PARAMS + ".npz")
     names = blob["meta"]["names"]
     sd = {name: data[f"t{i}"] for i, name in enumerate(names)}

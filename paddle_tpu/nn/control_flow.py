@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core import autograd, dispatch
-from ..core.dispatch import call_op, call_op_nograd, unwrap
+from ..core.dispatch import call_op, call_op_nograd, unwrap, bind_values
 from ..core.tensor import Tensor
 
 __all__ = ["while_loop", "cond", "case", "switch_case",
@@ -38,6 +38,28 @@ __all__ = ["while_loop", "cond", "case", "switch_case",
 
 def _is_traced(v):
     return isinstance(v, jax.core.Tracer)
+
+
+def _static_recording():
+    """True under static.program_guard: the predicate holds a build-time
+    placeholder value, so the construct must be recorded as one data-dependent
+    op (the reference records a conditional_block/while sub-block) rather than
+    frozen to the placeholder's branch."""
+    return dispatch._STATIC_HOOK[0] is not None
+
+
+class _suspend_static_hook:
+    """Run capture passes outside program recording so branch-probe ops don't
+    leak into the Program; only the fused control-flow op is recorded."""
+
+    def __enter__(self):
+        self._saved = dispatch._STATIC_HOOK[0]
+        dispatch._STATIC_HOOK[0] = None
+        return self
+
+    def __exit__(self, *exc):
+        dispatch._STATIC_HOOK[0] = self._saved
+        return False
 
 
 def _as_pred(v):
@@ -50,38 +72,26 @@ def _flatten_out(out):
     return [unwrap(l) for l in leaves], treedef
 
 
-class _bind_values:
-    """Temporarily rebind captured Tensors' values (to vjp-traced operands)
-    while a branch closure re-runs functionally."""
-
-    def __init__(self, tensors, values):
-        self._tensors = tensors
-        self._values = values
-        self._saved = None
-
-    def __enter__(self):
-        self._saved = [(t._value, t._tape_node) for t in self._tensors]
-        for t, v in zip(self._tensors, self._values):
-            t._value = v
-            t._tape_node = None
-        return self
-
-    def __exit__(self, *exc):
-        for t, (v, node) in zip(self._tensors, self._saved):
-            t._value = v
-            t._tape_node = node
-        return False
-
-
 def _capture(branch, *args):
     """Run `branch(*args)` once, recording external diff Tensors it reads.
     `args` (the loop vars) are parameters, not closures — excluded."""
     cap = dispatch.OpCapture()
     arg_leaves, _ = jax.tree_util.tree_flatten(
         args, is_leaf=lambda x: isinstance(x, Tensor))
+    created = {id(a) for a in arg_leaves if isinstance(a, Tensor)}
     cap.mark_created([a for a in arg_leaves if isinstance(a, Tensor)])
-    with dispatch.capture_ops(cap):
+    with dispatch.capture_ops(cap), _suspend_static_hook():
         out = branch(*args)
+    # a branch may return an external tensor *directly* (no op reads it);
+    # it must still become an operand or its value would bake in as a
+    # constant and its gradient would silently drop
+    out_leaves, _ = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    direct = [t for t in out_leaves
+              if isinstance(t, Tensor) and id(t) not in created
+              and not t.stop_gradient
+              and jnp.issubdtype(jnp.asarray(unwrap(t)).dtype, jnp.inexact)]
+    cap.note_inputs(direct)
     return cap.external, out
 
 
@@ -98,7 +108,8 @@ def _merge_ext(*ext_lists):
 def _functional(branch, ext, ext_vals, *args):
     """Re-run a branch with captured externals bound to functional values,
     tape recording off (the enclosing call_op owns differentiation)."""
-    with _bind_values(ext, ext_vals), autograd.no_grad():
+    with bind_values(ext, ext_vals), autograd.no_grad(), \
+            _suspend_static_hook():
         out = branch(*args)
     vals, treedef = _flatten_out(out)
     return vals, treedef
@@ -116,7 +127,7 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     predicate: `lax.cond` with closure tensors as differentiated operands.
     """
     pred_v = unwrap(pred) if isinstance(pred, Tensor) else pred
-    if not _is_traced(pred_v):
+    if not _is_traced(pred_v) and not _static_recording():
         taken = true_fn if bool(np.asarray(pred_v).reshape(())) else false_fn
         return taken() if taken is not None else None
     if true_fn is None or false_fn is None:
@@ -191,17 +202,26 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
     idx_v = unwrap(branch_index) if isinstance(branch_index, Tensor) \
         else branch_index
-    if not _is_traced(idx_v):
+    if not _is_traced(idx_v) and not _static_recording():
         k = int(np.asarray(idx_v).reshape(()))
         return table.get(k, default)()
 
-    # position i selects table[keys[i]]; position len(keys) = default
+    # position i selects table[keys[i]]; position len(keys) = default.
+    # The index mapping is itself an op (recorded under program_guard so the
+    # data dependency on branch_index survives into the Program).
     fns = [table[k] for k in keys] + [default]
-    pos = jnp.full(jnp.shape(jnp.reshape(idx_v, ())), len(keys), jnp.int32)
-    flat_idx = jnp.reshape(idx_v, ()).astype(jnp.int32)
-    for i, k in enumerate(keys):
-        pos = jnp.where(flat_idx == k, jnp.int32(i), pos)
-    return _switch_on_position(Tensor(pos), fns, "switch_case")
+
+    def _pos_fn(iv):
+        flat_idx = jnp.reshape(iv, ()).astype(jnp.int32)
+        pos = jnp.int32(len(keys))
+        for i, k in enumerate(keys):
+            pos = jnp.where(flat_idx == k, jnp.int32(i), pos)
+        return pos
+
+    idx_t = branch_index if isinstance(branch_index, Tensor) \
+        else Tensor(idx_v)
+    pos_t = call_op_nograd(_pos_fn, idx_t, op_name="switch_index")
+    return _switch_on_position(pos_t, fns, "switch_case")
 
 
 def case(pred_fn_pairs, default=None, name=None):
@@ -214,17 +234,23 @@ def case(pred_fn_pairs, default=None, name=None):
     if default is None:
         default = pairs[-1][1]
     preds = [unwrap(p) if isinstance(p, Tensor) else p for p, _ in pairs]
-    if not any(_is_traced(p) for p in preds):
+    if not any(_is_traced(p) for p in preds) and not _static_recording():
         for p, fn in zip(preds, (fn for _, fn in pairs)):
             if bool(np.asarray(p).reshape(())):
                 return fn()
         return default()
 
-    stacked = jnp.stack([_as_pred(p) for p in preds])
-    first_true = jnp.argmax(stacked).astype(jnp.int32)  # first True wins
-    pos = jnp.where(jnp.any(stacked), first_true, jnp.int32(len(pairs)))
+    pred_tensors = [p if isinstance(p, Tensor) else Tensor(p)
+                    for p, _ in pairs]
+
+    def _pos_fn(*ps):
+        stacked = jnp.stack([_as_pred(p) for p in ps])
+        first_true = jnp.argmax(stacked).astype(jnp.int32)  # first True wins
+        return jnp.where(jnp.any(stacked), first_true, jnp.int32(len(pairs)))
+
+    pos_t = call_op_nograd(_pos_fn, *pred_tensors, op_name="case_index")
     fns = [fn for _, fn in pairs] + [default]
-    return _switch_on_position(Tensor(pos), fns, "case")
+    return _switch_on_position(pos_t, fns, "case")
 
 
 # ---------------------------------------------------------------------------
@@ -248,9 +274,12 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None,
         raise ValueError("loop_vars must be a non-empty list/tuple")
     vars_ = list(loop_vars)
 
-    first = cond(*vars_)
-    first_v = unwrap(first) if isinstance(first, Tensor) else first
-    if not _is_traced(first_v):
+    if _static_recording():
+        first_v = None  # placeholder values must not pick the path
+    else:
+        first = cond(*vars_)
+        first_v = unwrap(first) if isinstance(first, Tensor) else first
+    if first_v is not None and not _is_traced(first_v):
         while bool(np.asarray(
                 unwrap(c) if isinstance((c := cond(*vars_)), Tensor) else c
                 ).reshape(())):

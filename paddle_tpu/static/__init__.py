@@ -27,14 +27,50 @@ def _static_mode():
     return _STATIC_MODE[0]
 
 
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference: `paddle.static.create_parameter`
+    (`python/paddle/fluid/layers/tensor.py`)."""
+    from ..core.tensor import Parameter
+    from ..nn import initializer as I
+    from ..nn.layer.layers import ParamAttr
+    attr = ParamAttr._to_attr(attr)
+    init = (attr.initializer or default_initializer
+            or (I._default_bias_init() if is_bias
+                else I._default_weight_init()))
+    value = init(list(shape), dtype)
+    p = Parameter(value, name=name or attr.name)
+    return p
+
+
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
                          program=None):
-    from ..jit.io import save as _jit_save
+    """Serialize the program pruned to feed→fetch as a StableHLO artifact
+    (reference: `fluid/io.py:1246` — prune + ProgramDesc + persistables)."""
+    from ..jit.export import save_exported
     prog = program or default_main_program()
-    _jit_save(prog.as_layer(feed_vars, fetch_vars), path_prefix)
+    layer = prog.as_layer(feed_vars, fetch_vars)
+    specs = []
+    for v in feed_vars:
+        name = v.name
+        slot_shape_dtype = prog.feed_vars.get(name)
+        if slot_shape_dtype is not None:
+            _, shape, dtype = slot_shape_dtype
+            specs.append(InputSpec([None if s == -1 else s for s in shape],
+                                   dtype=dtype, name=name))
+        else:
+            specs.append(v)
+    # the program's persistable slots (parameters/buffers it replays against)
+    # are exactly the reference's pruned persistables set
+    items = [(t.name, t) for t in prog.params.values()]
+    save_exported(path_prefix, layer.forward, items, specs,
+                  output_names=[getattr(v, "name", f"output_{i}")
+                                for i, v in enumerate(fetch_vars)])
 
 
 def load_inference_model(path_prefix, executor):
     from ..jit.io import load as _jit_load
     layer = _jit_load(path_prefix)
-    return layer, None, None
+    feed_names = getattr(layer, "input_names", None)
+    fetch_names = getattr(layer, "output_names", None)
+    return layer, feed_names, fetch_names

@@ -15,6 +15,7 @@ import threading
 from contextlib import contextmanager
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.dispatch import _STATIC_HOOK
@@ -47,6 +48,7 @@ class Program:
         self._keepalive = []  # strong refs so id() stays valid
         self.feed_vars = {}  # name -> (slot, shape, dtype)
         self.params = {}  # slot -> Parameter
+        self._produced = set()  # slots written by a recorded op
         self._optimizer = None
         self._loss_slot = None
         self._compiled = {}
@@ -68,11 +70,24 @@ class Program:
         return s
 
     def record(self, fn, args, kwargs, op_name):
+        feed_slots = {v[0] for v in self.feed_vars.values()}
+
+        def _slot_arg(a):
+            s = self._slot_of(a)
+            # a Tensor that no program op produced and that isn't a feed or
+            # parameter is an eager-created input (constant, or a tensor made
+            # inside a control-flow capture): thread it in as a param-style
+            # input so replay reads its live value instead of KeyError-ing
+            if (s not in self._produced and s not in feed_slots
+                    and s not in self.params):
+                self.params[s] = a
+            return _Slot(s)
+
         arg_slots = []
         in_vals = []
         for a in args:
             if isinstance(a, Tensor):
-                arg_slots.append(_Slot(self._slot_of(a)))
+                arg_slots.append(_slot_arg(a))
                 in_vals.append(a._value)
             else:
                 arg_slots.append(a)
@@ -81,13 +96,24 @@ class Program:
         kw_vals = {}
         for k, v in kwargs.items():
             if isinstance(v, Tensor):
-                kw_slots[k] = _Slot(self._slot_of(v))
+                kw_slots[k] = _slot_arg(v)
                 kw_vals[k] = v._value
             else:
                 kw_slots[k] = v
                 kw_vals[k] = v
-        # build-time shape propagation: run eagerly on placeholder values
-        out = fn(*in_vals, **kw_vals)
+        # build-time shape propagation: run eagerly on placeholder values.
+        # Control-flow ops are evaluated abstractly instead — a while_loop's
+        # trip count on placeholder values is meaningless and could not
+        # terminate (the reference builds sub-blocks without executing them).
+        if op_name in ("while", "conditional_block", "switch"):
+            shapes = jax.eval_shape(lambda *a, **k: fn(*a, **k),
+                                    *in_vals, **kw_vals)
+            if isinstance(shapes, (tuple, list)):
+                out = tuple(jnp.zeros(s.shape, s.dtype) for s in shapes)
+            else:
+                out = jnp.zeros(shapes.shape, shapes.dtype)
+        else:
+            out = fn(*in_vals, **kw_vals)
         outs = out if isinstance(out, tuple) else (out,)
         out_tensors = []
         out_slots = []
@@ -95,6 +121,7 @@ class Program:
             t = Tensor(o)
             out_slots.append(self._slot_of(t))
             out_tensors.append(t)
+        self._produced.update(out_slots)
         self.ops.append(_OpRecord(fn, arg_slots, kw_slots, out_slots, op_name))
         if len(out_tensors) == 1:
             return out_tensors[0]
@@ -209,9 +236,16 @@ class Executor:
         feed = feed or {}
         fetch_list = fetch_list or []
 
+        def _feed_val(x):
+            if isinstance(x, Tensor):
+                return x._value
+            if isinstance(x, jax.core.Tracer):
+                return x  # export/to_static tracing a program replay
+            return np.asarray(x)
+
         feed_names = sorted(feed.keys())
         feed_slots = [prog.feed_vars[n][0] for n in feed_names]
-        feed_vals = [np.asarray(feed[n]) for n in feed_names]
+        feed_vals = [_feed_val(feed[n]) for n in feed_names]
         fetch_slots = [prog._slot_of(v, create=False) for v in fetch_list]
         param_slots = sorted(prog.params.keys())
         param_vals = [prog.params[s]._value for s in param_slots]
@@ -241,7 +275,8 @@ class Executor:
                 t._value = v
         else:
             fetched = compiled(feed_vals, param_vals)
-        if return_numpy:
+        if return_numpy and not any(isinstance(v, jax.core.Tracer)
+                                    for v in fetched):
             return [np.asarray(v) for v in fetched]
         return [Tensor(v) for v in fetched]
 

@@ -305,6 +305,62 @@ class TestCaseSwitch:
 
 
 # ---------------------------------------------------------------------------
+# static program mode: constructs must stay data-dependent, not freeze to
+# the build-time placeholder's branch
+# ---------------------------------------------------------------------------
+
+class TestStaticProgramControlFlow:
+    def test_cond_in_program(self):
+        import paddle_tpu.static as static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2], "float32")
+            out = nn.cond(x.sum() > 0, lambda: x * 2, lambda: x * -1)
+        exe = static.Executor()
+        (r,) = exe.run(prog, feed={"x": np.array([1., 2.], np.float32)},
+                       fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(r), [2.0, 4.0])
+        (r,) = exe.run(prog, feed={"x": np.array([-1., -2.], np.float32)},
+                       fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(r), [1.0, 2.0])
+
+    def test_while_in_program(self):
+        import paddle_tpu.static as static
+        prog = static.Program()
+        with static.program_guard(prog):
+            n = static.data("n", [], "int32")
+            i = paddle.zeros([], dtype="int32")
+            s = paddle.zeros([], dtype="float32")
+            with paddle.no_grad():
+                i2, s2 = nn.while_loop(
+                    lambda i, s: i < n,
+                    lambda i, s: [i + 1, s + paddle.cast(i, "float32")],
+                    [i, s])
+        exe = static.Executor()
+        (r,) = exe.run(prog, feed={"n": np.int32(5)}, fetch_list=[s2])
+        assert float(np.asarray(r)) == 10.0
+        (r,) = exe.run(prog, feed={"n": np.int32(7)}, fetch_list=[s2])
+        assert float(np.asarray(r)) == 21.0
+
+    def test_switch_case_in_program(self):
+        import paddle_tpu.static as static
+        prog = static.Program()
+        with static.program_guard(prog):
+            idx = static.data("idx", [], "int32")
+            x = static.data("x", [2], "float32")
+            out = nn.switch_case(idx, {0: lambda: x + 10, 1: lambda: x * 5},
+                                 default=lambda: x - 1)
+        exe = static.Executor()
+        feed_x = np.array([1., 2.], np.float32)
+        (r,) = exe.run(prog, feed={"idx": np.int32(1), "x": feed_x},
+                       fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(r), [5.0, 10.0])
+        (r,) = exe.run(prog, feed={"idx": np.int32(9), "x": feed_x},
+                       fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(r), [0.0, 1.0])
+
+
+# ---------------------------------------------------------------------------
 # TensorArray
 # ---------------------------------------------------------------------------
 
