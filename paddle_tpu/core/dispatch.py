@@ -201,17 +201,11 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
                 diff_positions.append(("k", k))
                 diff_tensors.append(v)
 
-    if _CAPTURE.stack:
-        # capture every Tensor input: diff tensors need gradient operands,
-        # non-diff ones (feeds, int tensors, frozen weights) still need to be
-        # operands so static-program replay and re-tracing see live values,
-        # not the values baked at capture time
-        _CAPTURE.stack[-1].note_inputs(
-            [a for a in args if _is_tensor(a)]
-            + [v for v in kwargs.values() if _is_tensor(v)])
-
     if not diff_tensors:
         return _call_op_nograd_impl(fn, *args, op_name=op_name, **kwargs)
+
+    if _CAPTURE.stack:
+        _note_capture_inputs(args, kwargs)
 
     name = op_name or getattr(fn, "__name__", "op")
 
@@ -249,23 +243,32 @@ def call_op_nograd(fn, *args, op_name=None, **kwargs):
     return _call_op_nograd_impl(fn, *args, op_name=op_name, **kwargs)
 
 
+def _note_capture_inputs(args, kwargs):
+    # capture every Tensor input: diff tensors need gradient operands,
+    # non-diff ones (feeds, int tensors, frozen weights) still need to be
+    # operands so static-program replay and re-tracing see live values,
+    # not the values baked at capture time
+    _CAPTURE.stack[-1].note_inputs(
+        [a for a in args if _is_tensor(a)]
+        + [v for v in kwargs.values() if _is_tensor(v)])
+
+
 def _call_op_nograd_impl(fn, *args, op_name=None, **kwargs):
     if _STATIC_HOOK[0] is not None:
         return _STATIC_HOOK[0](fn, args, kwargs, op_name)
-    if _CAPTURE.stack:
-        _CAPTURE.stack[-1].note_inputs(
-            [a for a in args if _is_tensor(a)]
-            + [v for v in kwargs.values() if _is_tensor(v)])
+    capturing = bool(_CAPTURE.stack)
+    if capturing:
+        _note_capture_inputs(args, kwargs)
     a = _amp_cast(op_name or getattr(fn, "__name__", "op"),
                   [unwrap(x) for x in args])
     k = {key: unwrap(v) for key, v in kwargs.items()}
     out = fn(*a, **k)
     if isinstance(out, tuple):
         out = tuple(wrap(o) for o in out)
-        if _CAPTURE.stack:
+        if capturing:
             _CAPTURE.stack[-1].mark_created(out)
         return out
     out = wrap(out)
-    if _CAPTURE.stack:
+    if capturing:
         _CAPTURE.stack[-1].mark_created((out,))
     return out

@@ -1,17 +1,35 @@
 """PipelineParallel runner.
 
 Reference: `fleet/meta_parallel/pipeline_parallel.py:32` (train_batch:114 —
-microbatch loop with send/recv p2p) and the static 1F1B schedule
-(`framework/section_worker.cc:148`). Single-controller TPU version: the
-microbatch loop runs 1F1B order on the host with activations handed between
-stages directly (the p2p protocol collapses — stage boundaries are data-flow
-edges). Gradients accumulate across microbatches; the optimizer steps once
-per train_batch, matching reference semantics. The in-XLA shard_map pipeline
-(paddle_tpu.parallel.pipeline) is the performance path for uniform stacks.
+microbatch loop with send_v2/recv_v2 p2p) scheduled like the static 1F1B
+worker (`framework/section_worker.cc:148-175`).
+
+TPU single-controller redesign: stages are **placed** — each pipeline
+stage's parameters live on its own device along the mesh's 'pp' axis, and
+activations cross stage boundaries through a gradient-tracked device_put
+(the ICI hop that send_v2/recv_v2 performed over NCCL). The microbatch
+loop runs the canonical 1F1B order on the host: S-1 warmup forwards, then
+strict 1F1B steady state, then cooldown backwards — so at most S
+microbatch graphs (activations) are ever live, the schedule's memory
+contract. The in-XLA shard_map pipeline (paddle_tpu.parallel.pipeline) is
+the whole-program performance path for uniform stacks; this runner is the
+semantic-parity path for arbitrary heterogeneous PipelineLayer stacks.
 """
+from collections import deque
+
+import jax
+
+from ....core.dispatch import call_op
 from ....core.tensor import Tensor
 from ....nn.layer.layers import Layer
 from .... import ops
+
+
+def _stage_device(mesh, s):
+    ax = mesh.axis_names.index("pp")
+    idx = [0] * len(mesh.axis_names)
+    idx[ax] = s
+    return mesh.devices[tuple(idx)]
 
 
 class PipelineParallel(Layer):
@@ -24,9 +42,57 @@ class PipelineParallel(Layer):
         self.micro_batch_size = cfg.get("micro_batch_size", None)
         self.schedule_mode = cfg.get("schedule_mode", "1F1B")
         self.num_stages = layers.num_stages
+        self._stage_devs = None
+        self._placement_tried = False
+        self._last_schedule = []  # [("F"|"B", microbatch)] of the last batch
+
+    # ---------------------------------------------------------- placement
+    def _maybe_place_stages(self):
+        """Pin each stage's params/buffers to its device on the 'pp' axis
+        (the analog of the reference running each SectionWorker on its own
+        rank's GPU)."""
+        if self._placement_tried:
+            return
+        self._placement_tried = True
+        from ...parallel_env import current_mesh
+        mesh = current_mesh()
+        S = self.num_stages
+        if (mesh is None or "pp" not in mesh.axis_names
+                or mesh.shape["pp"] < S or S <= 1):
+            return
+        devs = [_stage_device(mesh, s) for s in range(S)]
+        for s in range(S):
+            for kind, item in self._layers.get_stage_layers(s):
+                if kind == "shared":
+                    continue  # shared layers stay with their first stage
+                if isinstance(item, Layer):
+                    for p in item.parameters():
+                        if p is not None:
+                            p._value = jax.device_put(p._value, devs[s])
+                    for _, b in item.named_buffers():
+                        if b is not None:
+                            b._value = jax.device_put(b._value, devs[s])
+        self._stage_devs = devs
+
+    def _to_stage(self, x, s):
+        """Gradient-tracked inter-stage hop (send_v2/recv_v2 analog):
+        forward moves the activation to stage s's device; the VJP moves the
+        cotangent back across the same edge."""
+        dev = self._stage_devs[s]
+        return call_op(lambda v: jax.device_put(v, dev), x,
+                       op_name="p2p_transfer")
+
+    def _forward_staged(self, x):
+        if self._stage_devs is None:
+            return self._layers(x)
+        for s in range(self.num_stages):
+            x = self._to_stage(x, s)
+            x = self._layers.forward_stage(s, x)
+        return x
 
     def forward(self, x):
-        return self._layers(x)
+        self._maybe_place_stages()
+        return self._forward_staged(x)
 
     def _split_micro(self, data):
         """Split the global batch into accumulate_steps microbatches."""
@@ -36,22 +102,45 @@ class PipelineParallel(Layer):
         ys = ops.split(y, n, axis=0) if n > 1 else [y]
         return list(zip(xs, ys))
 
+    # ---------------------------------------------------------- schedules
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         assert self._layers._loss_fn is not None, "PipelineLayer needs loss_fn"
+        self._maybe_place_stages()
         micros = self._split_micro(data)
+        M = len(micros)
+        S = self.num_stages
+        self._last_schedule = []
+        pending = deque()  # (microbatch, loss) graphs awaiting backward
         total_loss = None
 
-        # 1F1B order on a single controller degenerates to fw+bw per
-        # microbatch with gradient accumulation (identical math).
-        for x, y in micros:
-            out = self._layers(x)
-            loss = self._layers._loss_fn(out, y)
-            loss = loss / len(micros)
+        def fwd(m):
+            x, y = micros[m]
+            out = self._forward_staged(x)
+            loss = self._layers._loss_fn(out, y) / M
+            pending.append((m, loss))
+            self._last_schedule.append(("F", m))
+            return loss.detach()
+
+        def bwd():
+            m, loss = pending.popleft()
             if scaler is not None:
                 scaler.scale(loss).backward()
             else:
                 loss.backward()
-            total_loss = loss if total_loss is None else total_loss + loss.detach()
+            self._last_schedule.append(("B", m))
+
+        # canonical 1F1B: warmup forwards, steady 1F1B, cooldown backwards —
+        # at most S graphs in flight (vs M for F-then-B)
+        warmup = min(S, M) if self.schedule_mode == "1F1B" else M
+        for m in range(warmup):
+            d = fwd(m)
+            total_loss = d if total_loss is None else total_loss + d
+        for m in range(warmup, M):
+            bwd()
+            d = fwd(m)
+            total_loss = total_loss + d
+        while pending:
+            bwd()
 
         if scaler is not None:
             scaler.step(optimizer)
@@ -64,14 +153,24 @@ class PipelineParallel(Layer):
 
     def eval_batch(self, data, compute_loss=True):
         from ....core.autograd import no_grad
+        self._maybe_place_stages()
         micros = self._split_micro(data)
         total = None
         with no_grad():
             for x, y in micros:
-                out = self._layers(x)
+                out = self._forward_staged(x)
                 if compute_loss:
                     loss = self._layers._loss_fn(out, y) / len(micros)
                     total = loss if total is None else total + loss
                 else:
                     total = out
         return total
+
+    def max_in_flight(self):
+        """Peak number of simultaneously-live microbatch graphs in the last
+        train_batch — the activation-liveness the 1F1B schedule bounds."""
+        live = peak = 0
+        for kind, _ in self._last_schedule:
+            live += 1 if kind == "F" else -1
+            peak = max(peak, live)
+        return peak

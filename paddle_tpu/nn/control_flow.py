@@ -83,14 +83,13 @@ def _capture(branch, *args):
     with dispatch.capture_ops(cap), _suspend_static_hook():
         out = branch(*args)
     # a branch may return an external tensor *directly* (no op reads it);
-    # it must still become an operand or its value would bake in as a
-    # constant and its gradient would silently drop
+    # it must still become an operand — diff or not — or its value at
+    # capture time (a build placeholder, a stale weight) would bake in as a
+    # constant and any gradient through it would silently drop
     out_leaves, _ = jax.tree_util.tree_flatten(
         out, is_leaf=lambda x: isinstance(x, Tensor))
     direct = [t for t in out_leaves
-              if isinstance(t, Tensor) and id(t) not in created
-              and not t.stop_gradient
-              and jnp.issubdtype(jnp.asarray(unwrap(t)).dtype, jnp.inexact)]
+              if isinstance(t, Tensor) and id(t) not in created]
     cap.note_inputs(direct)
     return cap.external, out
 
@@ -111,7 +110,9 @@ def _functional(branch, ext, ext_vals, *args):
     with bind_values(ext, ext_vals), autograd.no_grad(), \
             _suspend_static_hook():
         out = branch(*args)
-    vals, treedef = _flatten_out(out)
+        # flatten INSIDE the bind scope: a branch may return a bound tensor
+        # directly, and its value must be read before restore
+        vals, treedef = _flatten_out(out)
     return vals, treedef
 
 

@@ -14,6 +14,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
@@ -62,6 +63,177 @@ def spmd_pipeline(stage_fn, stacked_params, microbatches, axis_name="pp"):
     # broadcast last stage's outputs to every stage (replicated result)
     mask = (stage == n_stages - 1).astype(outputs.dtype)
     return jax.lax.psum(outputs * mask, axis_name)
+
+
+def ring_buffer_size(n_stages, n_micro):
+    """Activation-residual ring size for the 1F1B schedule: stage s holds at
+    most 2(S-s)-1 in-flight microbatch inputs, so min(M, 2S-1) slots bound
+    every stage — O(S) activation memory, vs GPipe's O(M). This is the
+    memory contract `section_worker.cc:148-175`'s 1F1B exists to provide."""
+    return min(n_micro, 2 * n_stages - 1)
+
+
+def spmd_pipeline_1f1b(stage_fn, last_fn, stacked_params, last_params,
+                       microbatches, labels, first_fn=None, first_params=None,
+                       axis_name="pp"):
+    """One fused 1F1B fwd+bwd pipeline step. Run inside shard_map with
+    `axis_name` bound.
+
+    Reference schedule: `framework/section_worker.cc:148-175` (1F1B) —
+    re-designed as a single XLA scan: step t has stage s forward microbatch
+    (t-s) and backward microbatch (t-(2S-2-s)), both masked to their windows,
+    so in steady state every device does one F and one B per step and
+    activation liveness is O(S) (see ring_buffer_size). Backward is explicit
+    (recompute-based VJP from saved stage inputs), not jax.grad-through-scan —
+    that is what keeps residuals off the scan carry and the memory bounded.
+
+    stage_fn(params_slice, hidden) -> hidden  (shape-preserving middle stack)
+    first_fn(first_params, raw_microbatch) -> hidden  (stage 0 only; lifts
+        the uniform restriction: embedding lives inside the pipeline)
+    last_fn(last_params, hidden, label) -> scalar loss  (stage S-1 only)
+    stacked_params: leading axis n_stages, sharded over axis_name outside.
+    microbatches: [M, ...raw] replicated; labels: [M, ...] replicated.
+
+    Returns (mean_loss, stage_grads(lead axis 1 → P(axis_name)),
+             first_grads, last_grads) — first/last grads are psum-replicated.
+    """
+    S = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0),
+                                    stacked_params)
+    M = microbatches.shape[0]
+    # static stage count for schedule lengths (psum of 1 is static under
+    # shard_map: it equals the mesh axis size)
+    S_static = int(S) if not isinstance(S, jax.core.Tracer) else None
+    if S_static is None:
+        raise ValueError("spmd_pipeline_1f1b needs a static pp axis size")
+    B = ring_buffer_size(S_static, M)
+    T = M + 2 * S_static - 2
+    is_first = stage == 0
+    is_last = stage == S_static - 1
+    fwd_perm = [(i, (i + 1) % S_static) for i in range(S_static)]
+    bwd_perm = [(i, (i - 1) % S_static) for i in range(S_static)]
+
+    if first_fn is None:
+        first_fn = lambda _, x: x
+        first_params = jnp.zeros((), jnp.float32)
+
+    def _hidden_of(raw):
+        return first_fn(first_params, raw)
+
+    hidden_struct = jax.eval_shape(_hidden_of, microbatches[0])
+    # device-varying cast: cond branches must agree on varying-ness even when
+    # one side is built only from replicated inputs (idempotent)
+    def _v(z):
+        try:
+            vma = jax.typeof(z).vma
+        except Exception:
+            vma = frozenset()
+        if axis_name in vma:
+            return z
+        return lax.pcast(z, (axis_name,), to="varying")
+
+    # first/last params become device-varying copies: otherwise jax.grad
+    # would insert a psum for these replicated inputs INSIDE a varying-pred
+    # cond branch — a collective only some devices execute (deadlock). Their
+    # cross-stage grad reduction happens once, explicitly, at the end.
+    first_params = jax.tree_util.tree_map(_v, first_params)
+    last_params = jax.tree_util.tree_map(_v, last_params)
+
+    def stage_in(raw_in, hidden_in):
+        # stage 0 computes its input from the raw microbatch (embed);
+        # other stages consume the wire buffer
+        return lax.cond(is_first,
+                        lambda: _v(first_fn(first_params, raw_in).astype(
+                            hidden_struct.dtype)),
+                        lambda: hidden_in)
+
+    def bwd_scalar(p, fp, lp, raw_in, hidden_in, label, cot):
+        """Scalar whose gradient is the stage's VJP: the loss itself on the
+        last stage, <y, cot> elsewhere (vdot trick = seeded VJP)."""
+        x = lax.cond(
+            is_first,
+            lambda: _v(first_fn(fp, raw_in).astype(hidden_struct.dtype)),
+            lambda: hidden_in)
+        y = stage_fn(p, x)
+        return lax.cond(
+            is_last,
+            lambda: _v(last_fn(lp, y, label).astype(jnp.float32)),
+            lambda: _v(jnp.vdot(y.astype(jnp.float32),
+                                cot.astype(jnp.float32))))
+
+    bwd_grads = jax.grad(bwd_scalar, argnums=(0, 1, 2, 4))
+
+    def step_fn(carry, t):
+        fwd_recv, bwd_recv, act_buf, loss_buf, gP, gF, gL = carry
+
+        # ---- forward half: microbatch mf = t - stage -------------------
+        mf = t - stage
+        do_fwd = (mf >= 0) & (mf < M)
+        mf_c = jnp.clip(mf, 0, M - 1)
+        raw_f = microbatches[mf_c]
+        x = stage_in(raw_f, fwd_recv)
+        y = stage_fn(params, x)
+        loss_f = lax.cond(
+            is_last,
+            lambda: _v(last_fn(last_params, y,
+                               labels[mf_c]).astype(jnp.float32)),
+            lambda: _v(jnp.float32(0)))
+        slot_f = mf_c % B
+        act_buf = act_buf.at[slot_f].set(
+            jnp.where(do_fwd, x, act_buf[slot_f]))
+        loss_buf = loss_buf.at[mf_c].set(
+            jnp.where(do_fwd & is_last, loss_f, loss_buf[mf_c]))
+
+        # ---- backward half: microbatch mb = t - (2S-2-stage) -----------
+        mb = t - (2 * S_static - 2 - stage)
+        do_bwd = (mb >= 0) & (mb < M)
+        mb_c = jnp.clip(mb, 0, M - 1)
+        x_saved = act_buf[mb_c % B]
+        g_p, g_f, g_l, dx = bwd_grads(params, first_params, last_params,
+                                      microbatches[mb_c], x_saved,
+                                      labels[mb_c], bwd_recv)
+        # where, not mask-multiply: out-of-window bwd runs on garbage inputs
+        # and 0 * NaN would poison the accumulators (e.g. log(0) in a
+        # cross-entropy last_fn during warmup steps)
+        _acc = lambda a, g: jnp.where(do_bwd, a + g.astype(a.dtype), a)
+        gP = jax.tree_util.tree_map(_acc, gP, g_p)
+        gF = jax.tree_util.tree_map(_acc, gF, g_f)
+        gL = jax.tree_util.tree_map(_acc, gL, g_l)
+
+        # wire: activations flow down, cotangents flow up (ICI neighbors)
+        fwd_recv = lax.ppermute(y, axis_name, fwd_perm)
+        bwd_recv = lax.ppermute(dx, axis_name, bwd_perm)
+        return (fwd_recv, bwd_recv, act_buf, loss_buf, gP, gF, gL), None
+
+    _vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    zeros_h = lambda: _vary(jnp.zeros(hidden_struct.shape,
+                                      hidden_struct.dtype))
+    zeros_like_tree = lambda tree: jax.tree_util.tree_map(
+        lambda x: _vary(jnp.zeros(jnp.shape(x), jnp.result_type(x))), tree)
+    carry0 = (zeros_h(), zeros_h(),
+              _vary(jnp.zeros((B,) + tuple(hidden_struct.shape),
+                              hidden_struct.dtype)),
+              _vary(jnp.zeros((M,), jnp.float32)),
+              zeros_like_tree(params),
+              zeros_like_tree(first_params),
+              zeros_like_tree(last_params))
+    (_, _, _, loss_buf, gP, gF, gL), _ = lax.scan(
+        step_fn, carry0, jnp.arange(T))
+
+    # mean loss (only the last stage filled loss_buf) replicated to all
+    last_mask = is_last.astype(jnp.float32)
+    mean_loss = jax.lax.psum(jnp.sum(loss_buf) * last_mask, axis_name) / M
+    inv_m = 1.0 / M  # grads of the mean, not the sum
+    gP = jax.tree_util.tree_map(
+        lambda g: jnp.expand_dims(g * jnp.asarray(inv_m, g.dtype), 0), gP)
+    gF = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * jnp.asarray(inv_m, g.dtype), axis_name),
+        gF)
+    gL = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * jnp.asarray(inv_m, g.dtype), axis_name),
+        gL)
+    return mean_loss, gP, gF, gL
 
 
 def pipelined_transformer_step(block_fn, embed_fn, head_loss_fn):

@@ -342,6 +342,19 @@ class TestStaticProgramControlFlow:
         (r,) = exe.run(prog, feed={"n": np.int32(7)}, fetch_list=[s2])
         assert float(np.asarray(r)) == 21.0
 
+    def test_cond_branch_returning_feed_directly(self):
+        """A branch that returns the feed tensor untouched must still see
+        the fed value at replay, not the build placeholder."""
+        import paddle_tpu.static as static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2], "float32")
+            out = nn.cond(x.sum() > 0, lambda: x, lambda: x * -1)
+        exe = static.Executor()
+        (r,) = exe.run(prog, feed={"x": np.array([1., 2.], np.float32)},
+                       fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(r), [1.0, 2.0])
+
     def test_switch_case_in_program(self):
         import paddle_tpu.static as static
         prog = static.Program()
