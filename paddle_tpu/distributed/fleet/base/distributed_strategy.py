@@ -61,6 +61,8 @@ class DistributedStrategy:
         self.lamb_configs = {}
         self.lars = False
         self.lars_configs = {}
+        self.fp16_allreduce = False
+        self.asp = False
         self.a_sync = False
         self.a_sync_configs = {}
         self.heter_ccl_mode = False

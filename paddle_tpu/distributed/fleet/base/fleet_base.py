@@ -69,6 +69,14 @@ def distributed_model(model):
             from ..meta_parallel.sharding_parallel import shard_parameters
             shard_parameters(model, mesh=hcg.mesh)
 
+    # recompute is a model-graph property: wrap the checkpointed sublayers
+    # (reference recompute_optimizer rewrites backward; here jax.checkpoint
+    # semantics attach to the matched layers)
+    if _strategy is not None and _strategy.recompute:
+        from ..meta_optimizers.recompute import apply_recompute
+        apply_recompute(model, _strategy.recompute_configs.get(
+            "checkpoints", []))
+
     if hcg.get_pipe_parallel_world_size() > 1 and isinstance(model, PipelineLayer):
         return PipelineParallel(model, hcg, _strategy)
     if hcg.get_model_parallel_world_size() > 1:
@@ -80,28 +88,23 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy=None):
     """reference: fleet_base.py:783 → meta-optimizer stack resolved by
-    strategy_compiler. TPU: plain wrapper nesting — sharding (a state layout)
-    innermost, then gradient-merge, then localsgd — all of which trace into
-    the single compiled train step."""
+    strategy_compiler. TPU: the StrategyCompiler resolves the same flag set
+    to an ordered wrapper nesting (innermost = state layout, outermost =
+    loss scaling) — all of which traces into the single compiled step. The
+    resolved stack is kept on the returned optimizer
+    (`_meta_optimizer_names`) for inspection tests, the analog of the
+    reference's rewritten-program op assertions."""
     global _strategy
     strategy = strategy or _strategy or DistributedStrategy()
     hcg = get_hybrid_communicate_group()
 
-    from ..meta_optimizers import (
-        DygraphShardingOptimizer, GradientMergeOptimizer, LocalSGDOptimizer,
-    )
-    if hcg is not None and (strategy.sharding
-                            or hcg.get_sharding_parallel_world_size() > 1):
-        optimizer = DygraphShardingOptimizer(optimizer, hcg)
-    if strategy.gradient_merge:
-        cfg = strategy.gradient_merge_configs
-        optimizer = GradientMergeOptimizer(
-            optimizer, k_steps=cfg.get("k_steps", 1), avg=cfg.get("avg", True))
-    if strategy.localsgd:
-        group = hcg.get_data_parallel_group() if hcg is not None else None
-        k = getattr(strategy, "localsgd_configs", {}).get("k_steps", 1) or 1
-        optimizer = LocalSGDOptimizer(optimizer, k_steps=k, group=group)
-    return HybridParallelOptimizer(optimizer, hcg, strategy)
+    from ..meta_optimizers.strategy_compiler import StrategyCompiler
+    compiler = StrategyCompiler()
+    stack = compiler.resolve(strategy, hcg, optimizer)
+    optimizer = StrategyCompiler.apply(stack, optimizer)
+    wrapped = HybridParallelOptimizer(optimizer, hcg, strategy)
+    wrapped._meta_optimizer_names = [name for name, _ in stack]
+    return wrapped
 
 
 class HybridParallelOptimizer:

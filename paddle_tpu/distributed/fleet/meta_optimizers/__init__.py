@@ -10,3 +10,9 @@ reference's strategy_compiler enforces falls out of plain wrapper nesting.
 from .gradient_merge import GradientMergeOptimizer  # noqa: F401
 from .localsgd import LocalSGDOptimizer  # noqa: F401
 from .sharding import DygraphShardingOptimizer, shard_optimizer_state  # noqa: F401
+from .dgc import DGCMomentumOptimizer  # noqa: F401
+from .fp16_allreduce import FP16AllReduceOptimizer  # noqa: F401
+from .amp import AMPOptimizer  # noqa: F401
+from .asp import ASPOptimizer  # noqa: F401
+from .recompute import RecomputeOptimizer, apply_recompute  # noqa: F401
+from .strategy_compiler import StrategyCompiler  # noqa: F401

@@ -1,0 +1,45 @@
+"""AMP meta-optimizer (reference: `fleet/meta_optimizers/amp_optimizer.py:20`
+— decorates the program with fp16 casts + dynamic loss scaling ops).
+
+TPU: auto_cast handles the cast insertion at dispatch time (bf16-first);
+this wrapper supplies the reference's loss-scaling state machine via
+GradScaler so `fleet.distributed_optimizer(opt, strategy.amp=True)` gives
+the same minimize/step contract the static rewriter gave."""
+from ....amp.grad_scaler import GradScaler
+
+
+class AMPOptimizer:
+    def __init__(self, inner_optimizer, amp_configs=None):
+        cfg = dict(amp_configs or {})
+        self._inner = inner_optimizer
+        self._scaler = GradScaler(
+            enable=True,
+            init_loss_scaling=cfg.get("init_loss_scaling", 32768.0),
+            incr_ratio=cfg.get("incr_ratio", 2.0),
+            decr_ratio=cfg.get("decr_ratio", 0.5),
+            incr_every_n_steps=cfg.get("incr_every_n_steps", 1000),
+            decr_every_n_nan_or_inf=cfg.get("decr_every_n_nan_or_inf", 2),
+            use_dynamic_loss_scaling=cfg.get("use_dynamic_loss_scaling",
+                                             True))
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def scaler(self):
+        return self._scaler
+
+    def scale(self, loss):
+        return self._scaler.scale(loss)
+
+    def step(self):
+        self._scaler.step(self._inner)
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        self._scaler.scale(loss).backward()
+        self._scaler.step(self._inner)
+        self.clear_grad()
+        return None, None

@@ -1,0 +1,25 @@
+"""ASP meta-optimizer (reference: `fleet/meta_optimizers/asp_optimizer.py`
+→ OptimizerWithSparsityGuarantee in contrib sparsity/asp.py — re-applies the
+2:4 masks after every optimizer step so pruned weights stay zero)."""
+from ....sparsity import ASPHelper
+
+
+class ASPOptimizer:
+    def __init__(self, inner_optimizer):
+        self._inner = inner_optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        ASPHelper.reapply_masks(list(self._inner._parameters()))
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None

@@ -1,0 +1,58 @@
+"""Recompute meta-optimizer (reference:
+`fleet/meta_optimizers/recompute_optimizer.py:20` → backward.py:743
+checkpoint-aware append_backward).
+
+TPU: activation rematerialization is a model-graph property, so the strategy
+is applied to the model (`apply_recompute`, called from
+fleet.distributed_model) — matched sublayers re-run their forward inside the
+backward via fleet.utils.recompute (jax.checkpoint semantics with RNG
+replay). The optimizer-side wrapper exists for API parity and records what
+was wrapped, the analog of the reference's program-inspection handle."""
+import fnmatch
+
+
+def apply_recompute(model, checkpoints):
+    """Wrap sublayers matching any `checkpoints` pattern (fnmatch or
+    substring on the qualified sublayer name) with activation recompute.
+    Returns the list of wrapped sublayer names."""
+    from ..utils.recompute import recompute
+    wrapped = []
+    pats = list(checkpoints or [])
+    if not pats:
+        return wrapped
+    for name, sub in model.named_sublayers():
+        if getattr(sub, "_recompute_wrapped", False):
+            continue
+        if any(fnmatch.fnmatch(name, p) or p in name for p in pats):
+            orig = sub.forward
+
+            def make(orig_fwd):
+                return lambda *a, **k: recompute(orig_fwd, *a, **k)
+
+            sub.forward = make(orig)
+            sub._recompute_wrapped = True
+            wrapped.append(name)
+    return wrapped
+
+
+class RecomputeOptimizer:
+    """API-parity wrapper; the actual recompute lives on the model."""
+
+    def __init__(self, inner_optimizer, wrapped_layers=()):
+        self._inner = inner_optimizer
+        self.wrapped_layers = list(wrapped_layers)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None

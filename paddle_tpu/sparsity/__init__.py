@@ -73,27 +73,34 @@ def _supported(p):
 
 class ASPHelper:
     """Holds masks and re-applies them after optimizer steps (reference:
-    sparsity/asp.py ASPHelper — _minimize inserts mask-mul after opt)."""
+    sparsity/asp.py ASPHelper — _minimize inserts mask-mul after opt).
+    Entries are weakref-verified: id(p) alone would alias a dead parameter's
+    mask onto whatever new tensor reuses its id."""
 
-    _masks = {}
+    _masks = {}  # id(param) -> (weakref(param), mask)
 
     @classmethod
     def prune_model(cls, model, n=2, m=4, mask_algo="mask_1d",
                     with_mask=True):
+        import weakref
+
         for name, p in model.named_parameters():
             if not _supported(p):
                 continue
             mask = create_mask(p, mask_algo, n, m)
-            cls._masks[id(p)] = mask
+            key = id(p)
+            cls._masks[key] = (
+                weakref.ref(p, lambda _r, _k=key: cls._masks.pop(_k, None)),
+                mask)
             p.set_value(np.asarray(unwrap(p)) * mask)
-        return cls._masks
+        return {k: m for k, (_, m) in cls._masks.items()}
 
     @classmethod
     def reapply_masks(cls, params):
         for p in params:
-            mask = cls._masks.get(id(p))
-            if mask is not None:
-                p.set_value(np.asarray(unwrap(p)) * mask)
+            entry = cls._masks.get(id(p))
+            if entry is not None and entry[0]() is p:
+                p.set_value(np.asarray(unwrap(p)) * entry[1])
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
