@@ -46,22 +46,44 @@ class Generator:
         self._key_t.set_value(state)
 
 
-default_generator = Generator()
+# The default generator is created lazily (PEP 562 module __getattr__):
+# building a PRNG key is a jax computation, and running one at import time
+# would initialize the XLA backend before multi-process users can call
+# jax.distributed.initialize (init_parallel_env). TP RNG trackers reassign
+# `default_generator`, which simply shadows the lazy attribute.
+_lazy_default = None
+
+
+def _default():
+    global _lazy_default
+    g = globals().get("default_generator")
+    if g is not None:
+        return g
+    if _lazy_default is None:
+        _lazy_default = Generator()
+    return _lazy_default
+
+
+def __getattr__(name):
+    if name == "default_generator":
+        return _default()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def seed(s):
     """`paddle.seed` analog."""
-    default_generator.manual_seed(int(s))
-    return default_generator
+    g = _default()
+    g.manual_seed(int(s))
+    return g
 
 
 def get_rng_state():
-    return default_generator.get_state()
+    return _default().get_state()
 
 
 def set_rng_state(state):
-    default_generator.set_state(state)
+    _default().set_state(state)
 
 
 def next_key():
-    return default_generator.next_key()
+    return _default().next_key()

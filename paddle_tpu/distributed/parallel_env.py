@@ -42,17 +42,36 @@ def make_mesh(axes, devices=None):
 
 
 def init_parallel_env():
-    """Single-host: nothing to bootstrap (XLA owns the collectives). Multi-host
-    under a launcher: initialize the jax coordination service from env."""
-    if "PADDLE_TRAINER_ENDPOINTS" in os.environ and jax.process_count() == 1:
+    """Single-host: nothing to bootstrap (XLA owns the collectives).
+    Multi-process under a launcher/spawn: initialize the jax coordination
+    service from the env contract (the reference's gen_comm_id TCP
+    rendezvous maps to this service)."""
+    # the axon TPU plugin wins over the JAX_PLATFORMS *env var*; an explicit
+    # config update is required to actually select the requested backend
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+    # NB: no jax.process_count() probe here — any backend-touching call
+    # before jax.distributed.initialize would lock the process into a
+    # single-process backend
+    global _dist_initialized
+    if not _dist_initialized and "PADDLE_TRAINER_ENDPOINTS" in os.environ:
         eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         if len(eps) > 1:
             jax.distributed.initialize(
-                coordinator_address=eps[0],
+                coordinator_address=os.environ.get(
+                    "JAX_COORDINATOR_ADDRESS", eps[0]),
                 num_processes=len(eps),
                 process_id=rank)
+            _dist_initialized = True
     return ParallelEnv()
+
+
+_dist_initialized = False
 
 
 class ParallelEnv:

@@ -1,24 +1,109 @@
 """spawn (reference: `python/paddle/distributed/spawn.py:333`).
 
-One JAX process drives all local TPU chips, so single-host spawn runs the
-target in-process (nprocs>1 only makes sense multi-host, where the launcher
-sets the coordination env and each host runs one process).
+Real N-process spawn on one host — the reference's (and its test suite's)
+multi-process-on-localhost strategy. Each child process initializes the JAX
+coordination service (`jax.distributed.initialize`) over a free local port;
+cross-process collectives then run through XLA's CPU (Gloo) or TPU backends.
+With the default nprocs=-1 on a single host the target runs in-process: one
+JAX process drives all local chips, and in-host parallelism is the device
+mesh, not processes.
 """
+import multiprocessing
 import os
+import socket
+import traceback
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_target(func, args, rank, nprocs, port, options, queue):
+    try:
+        endpoints = [f"127.0.0.1:{port + i}" for i in range(nprocs)]
+        os.environ["PADDLE_TRAINER_ID"] = str(rank)
+        os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+        os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+        os.environ["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
+        os.environ["JAX_COORDINATOR_ADDRESS"] = endpoints[0]
+        os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
+        os.environ["JAX_PROCESS_ID"] = str(rank)
+
+        backend = options.get("backend")
+        devices_per_proc = int(options.get("devices_per_proc", 1))
+        if backend == "cpu":
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{devices_per_proc}").strip()
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        elif backend:
+            os.environ["JAX_PLATFORMS"] = backend
+
+        from . import parallel_env
+        parallel_env.init_parallel_env()
+        result = func(*args)
+        queue.put((rank, "ok", result))
+    except Exception:
+        queue.put((rank, "error", traceback.format_exc()))
+        raise
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    if nprocs in (-1, 1) or "PADDLE_TRAINER_ENDPOINTS" not in os.environ:
+    """Start `nprocs` coordinated processes running func(*args).
+
+    `func` must be picklable (module-level). options: backend="cpu" for the
+    host-simulated path (the reference test strategy), devices_per_proc=N
+    for N XLA host devices per process, timeout=seconds. Each child sets the
+    reference env contract (PADDLE_TRAINER_ID/ENDPOINTS) and bootstraps the
+    JAX coordination service before calling func.
+    """
+    if nprocs in (-1, 1):
         result = func(*args)
-        return _Context([result])
-    raise NotImplementedError(
-        "multi-host spawn: use paddle_tpu.distributed.launch with one process "
-        "per host; in-host parallelism is the device mesh")
+        return _Context([(0, "ok", result)])
+
+    ctx = multiprocessing.get_context("spawn")
+    queue = ctx.Queue()
+    port = _free_port()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_target,
+                        args=(func, args, rank, nprocs, port, options, queue),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    context = _Context(None, procs=procs, queue=queue,
+                       timeout=options.get("timeout", 300))
+    if join:
+        context.join()
+    return context
 
 
 class _Context:
-    def __init__(self, results):
+    def __init__(self, results, procs=None, queue=None, timeout=300):
         self.results = results
+        self._procs = procs or []
+        self._queue = queue
+        self._timeout = timeout
 
     def join(self):
+        if self.results is not None:
+            return True
+        out = {}
+        try:
+            for _ in self._procs:
+                rank, status, payload = self._queue.get(timeout=self._timeout)
+                out[rank] = (rank, status, payload)
+        finally:
+            for p in self._procs:
+                p.join(self._timeout)
+                if p.is_alive():
+                    p.terminate()
+        for rank in sorted(out):
+            _, status, payload = out[rank]
+            if status == "error":
+                raise RuntimeError(f"spawned rank {rank} failed:\n{payload}")
+        self.results = [out[r] for r in sorted(out)]
         return True

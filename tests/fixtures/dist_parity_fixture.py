@@ -1,0 +1,62 @@
+"""TestDistBase model fixture (reference: the dist_mnist.py-style trainer
+scripts run by `test_dist_base.py:744` — train a fixed model, print per-step
+losses to stdout for the harness to compare across world sizes).
+
+Runs the full framework path: init_parallel_env (JAX coordination service
+bootstrap in multi-process mode) → fleet.init → distributed_model
+(DataParallel over the global 'dp' mesh) → @to_static compiled train step
+with dp-sharded batches.
+"""
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.distributed.fleet as fleet
+    from jax.sharding import PartitionSpec as P
+    import jax
+
+    dist.init_parallel_env()
+    world = jax.device_count()
+
+    paddle.seed(42)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    if world > 1:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": world, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = fleet.distributed_model(model)
+    inner = model
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    def step(xb, yb):
+        loss = nn.functional.mse_loss(inner(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    sfn = paddle.jit.to_static(step)
+    if world > 1:
+        sfn._arg_pspecs = [P("dp"), P("dp")]
+
+    rng = np.random.RandomState(7)
+    for i in range(5):
+        # every process feeds the identical GLOBAL batch (single-controller
+        # global-view semantics; GSPMD keeps only the local dp shard)
+        x = rng.rand(8, 16).astype(np.float32)
+        y = rng.rand(8, 4).astype(np.float32)
+        loss = sfn(paddle.to_tensor(x), paddle.to_tensor(y))
+        print(f"LOSS {i} {float(np.asarray(loss._value)):.8f}", flush=True)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
