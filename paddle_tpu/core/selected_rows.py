@@ -1,0 +1,64 @@
+"""SelectedRows — the sparse row-gradient representation.
+
+Reference: `paddle/fluid/framework/selected_rows.h:41` (rows + value +
+height) and its consumers: sparse embedding gradients
+(`operators/lookup_table_op.cc` W@GRAD as SelectedRows) and row-wise
+optimizer updates (`operators/optimizers/adam_op.h` lazy_mode,
+`operators/math/selected_rows_functor.cc` merge-add).
+
+TPU redesign: XLA has no sparse tensors, but the *semantic* — embedding
+grads touch only the looked-up rows, and optimizers may update only those
+rows — is kept: SelectedRows carries (rows, values, height); merge_add
+segment-sums duplicate rows on device; optimizers consume it via
+`_apply_sparse` (row-gather, update, row-scatter) instead of a dense
+full-table update.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """rows: int32 [K]; values: [K, ...] per-row data; height: table rows."""
+
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.values = values if isinstance(values, jnp.ndarray) \
+            else jnp.asarray(values)
+        self.height = int(height)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype), self.height)
+
+    def merge_add(self, other=None):
+        """Deduplicate rows by segment-sum (reference:
+        selected_rows_functor.cc MergeAdd). With `other`, merges both."""
+        rows, vals = self.rows, self.values
+        if other is not None:
+            assert other.height == self.height
+            rows = jnp.concatenate([rows, other.rows])
+            vals = jnp.concatenate([vals, other.values.astype(vals.dtype)])
+        uniq, inv = jnp.unique(rows, return_inverse=True,
+                               size=rows.shape[0], fill_value=self.height)
+        summed = jax.ops.segment_sum(vals, inv, num_segments=rows.shape[0])
+        return SelectedRows(uniq, summed, self.height)
+
+    def to_dense(self):
+        """Densify (reference: math::scatter::MergeAdd then tensor copy)."""
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"row_shape={tuple(self.values.shape[1:])})")

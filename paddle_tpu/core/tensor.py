@@ -111,6 +111,20 @@ class Tensor:
         self._retain_grads = True
 
     def _accumulate_grad(self, cot):
+        from .selected_rows import SelectedRows
+        if isinstance(cot, SelectedRows):
+            # sparse row gradient (reference: SelectedRows W@GRAD); merges
+            # with a prior sparse grad, densifies if a dense grad exists
+            if self._grad is None:
+                self._grad = cot
+            elif isinstance(self._grad, SelectedRows):
+                self._grad = self._grad.merge_add(cot)
+            else:
+                self._grad = self._grad + cot.to_dense().astype(
+                    self._grad.dtype)
+            return
+        if isinstance(self._grad, SelectedRows):
+            self._grad = self._grad.to_dense().astype(cot.dtype)
         if cot.dtype != self.dtype:
             cot = cot.astype(self.dtype)
         self._grad = cot if self._grad is None else self._grad + cot

@@ -164,6 +164,39 @@ class Auc(Metric):
         return [self._name]
 
 
+def auc(input, label, num_thresholds=4095, stat_pos=None, stat_neg=None,  # noqa: A002
+        curve="ROC", slide_steps=0):
+    """Functional AUC op (reference: `operators/metrics/auc_op.cc`): bucket
+    predictions by threshold, accumulate pos/neg stats, integrate TPR over
+    FPR. Returns (auc_value, stat_pos, stat_neg) — feed the stats back in
+    for streaming accumulation, as the reference's persistable stat vars do.
+    """
+    p = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
+    l = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+    if p.ndim == 2 and p.shape[1] == 2:
+        p = p[:, 1]
+    p = p.reshape(-1)
+    l = l.reshape(-1)
+    sp = (np.zeros(num_thresholds + 1) if stat_pos is None
+          else np.asarray(stat_pos.numpy() if isinstance(stat_pos, Tensor)
+                          else stat_pos).copy())
+    sn = (np.zeros(num_thresholds + 1) if stat_neg is None
+          else np.asarray(stat_neg.numpy() if isinstance(stat_neg, Tensor)
+                          else stat_neg).copy())
+    bins = np.minimum((p * num_thresholds).astype(np.int64), num_thresholds)
+    np.add.at(sp, bins[l.astype(bool)], 1)
+    np.add.at(sn, bins[~l.astype(bool)], 1)
+    tot_pos, tot_neg = sp.sum(), sn.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        value = 0.0
+    else:
+        pos = sp[::-1].cumsum()
+        neg = sn[::-1].cumsum()
+        value = float(np.trapezoid(pos / tot_pos, neg / tot_neg))
+    return (Tensor(np.float32(value)), Tensor(sp.astype(np.int64)),
+            Tensor(sn.astype(np.int64)))
+
+
 def accuracy(input, label, k=1):  # noqa: A002
     """Functional accuracy (reference: `operators/metrics/accuracy_op.cc`)."""
     values, indices = ops.topk(input, k)

@@ -26,7 +26,11 @@ def linear(x, weight, bias=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False):
-    del sparse  # dense gather on TPU; SelectedRows path is CPU/PS-specific
+    """reference: operators/lookup_table_v2_op.cc. With sparse=True the
+    gradient is a SelectedRows (rows = looked-up ids, values = summed
+    cotangents) instead of a dense zero-filled table — the reference's
+    W@GRAD-as-SelectedRows path (selected_rows.h:41), consumed by the
+    optimizers' row-wise _apply_sparse updates."""
 
     def _embed(w, idx):
         out = jnp.take(w, idx, axis=0)
@@ -35,7 +39,38 @@ def embedding(x, weight, padding_idx=None, sparse=False):
             out = jnp.where(mask, 0.0, out)
         return out
 
-    return call_op(_embed, weight, unwrap(x), op_name="embedding")
+    if not sparse:
+        return call_op(_embed, weight, unwrap(x), op_name="embedding")
+
+    from ...core import autograd
+    from ...core.selected_rows import SelectedRows
+    from ...core.tensor import Tensor
+
+    idx = unwrap(x)
+    out_val = _embed(unwrap(weight), idx)
+    if (not autograd.grad_enabled() or not isinstance(weight, Tensor)
+            or weight.stop_gradient):
+        from ...core.dispatch import wrap
+        return wrap(out_val)
+
+    flat_idx = jnp.reshape(idx, (-1,))
+    height = int(unwrap(weight).shape[0])
+
+    def vjp_fn(cots):
+        cot = cots[0]
+        vals = jnp.reshape(cot, (flat_idx.shape[0],) + cot.shape[idx.ndim:])
+        if padding_idx is not None:
+            vals = jnp.where((flat_idx == padding_idx)[..., None], 0.0, vals)
+        sr = SelectedRows(flat_idx, vals, height).merge_add()
+        return (sr,)
+
+    node = autograd.TapeNode(vjp_fn, [weight],
+                             [(out_val.shape, out_val.dtype)],
+                             name="lookup_table_sparse")
+    out = Tensor(out_val, stop_gradient=False)
+    out._tape_node = node
+    out._tape_index = 0
+    return out
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):

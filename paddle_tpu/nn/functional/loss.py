@@ -77,9 +77,33 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
 
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):  # noqa: A002
-    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index,
-                         reduction=reduction, use_softmax=False,
-                         soft_label=False)
+    """Negative log likelihood over LOG-probabilities (reference
+    nll_loss: loss = -input[label]; unlike cross_entropy(use_softmax=False),
+    which consumes probabilities)."""
+    lbl = unwrap(label)
+
+    def _nll(logp, *rest):
+        w = rest[0] if weight is not None else None
+        idx = lbl
+        if idx.ndim == logp.ndim:
+            idx = jnp.squeeze(idx, axis=-1)
+        idx = idx.astype(jnp.int32)
+        valid = idx != ignore_index
+        safe_idx = jnp.where(valid, idx, 0)
+        picked = jnp.squeeze(jnp.take_along_axis(
+            logp, jnp.expand_dims(safe_idx, -1), axis=-1), -1)
+        loss = -jnp.where(valid, picked, 0.0)
+        if w is not None:
+            loss = loss * jnp.take(w, safe_idx) * valid
+            if reduction == "mean":
+                denom = jnp.sum(jnp.take(w, safe_idx) * valid)
+                return jnp.sum(loss) / jnp.maximum(denom, 1)
+        elif reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(loss, reduction)
+
+    args = (input,) + ((weight,) if weight is not None else ())
+    return call_op(_nll, *args, op_name="nll_loss")
 
 
 def mse_loss(input, label, reduction="mean"):  # noqa: A002

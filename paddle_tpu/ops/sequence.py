@@ -15,7 +15,9 @@ from ..core.tensor import Tensor
 
 __all__ = ["RaggedBatch", "sequence_mask", "sequence_pad", "sequence_unpad",
            "sequence_expand", "sequence_reverse", "sequence_softmax",
-           "sequence_pool"]
+           "sequence_pool", "sequence_concat", "sequence_slice",
+           "sequence_expand_as", "sequence_first_step", "sequence_last_step",
+           "sequence_enumerate", "sequence_erase"]
 
 
 class RaggedBatch:
@@ -122,6 +124,86 @@ def sequence_softmax(x, lengths, name=None):
         return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-12)
 
     return call_op(f, x, lengths, op_name="sequence_softmax")
+
+
+def sequence_concat(inputs, name=None):
+    """Row-wise concatenation of ragged batches: row i of the result is the
+    concatenation of row i from every input (reference:
+    sequence_ops/sequence_concat_op.cc). Returns a RaggedBatch."""
+    rbs = [x if isinstance(x, RaggedBatch) else RaggedBatch.from_list(x)
+           for x in inputs]
+    rows = [rb.to_list() for rb in rbs]
+    merged = [np.concatenate([r[i] for r in rows], axis=0)
+              for i in range(len(rows[0]))]
+    return RaggedBatch.from_list(merged)
+
+
+def sequence_slice(x, offset, length, name=None):
+    """Per-row slice [offset[i], offset[i]+length[i]) (reference:
+    sequence_ops/sequence_slice_op.cc). Output padded to max(length)."""
+    rb = x if isinstance(x, RaggedBatch) else RaggedBatch.from_list(x)
+    off = np.asarray(unwrap(offset)).reshape(-1)
+    ln = np.asarray(unwrap(length)).reshape(-1)
+    rows = rb.to_list()
+    out = [r[int(o):int(o) + int(l)] for r, o, l in zip(rows, off, ln)]
+    return RaggedBatch.from_list(out)
+
+
+def sequence_expand_as(x, y, name=None):
+    """Repeat row i of x so the result aligns with y's row lengths
+    (reference: sequence_ops/sequence_expand_as_op.cc)."""
+    lengths = y.lengths if isinstance(y, RaggedBatch) else y
+    return sequence_expand(x, lengths, name=name)
+
+
+def sequence_first_step(x, lengths=None, name=None):
+    """reference: fluid/layers/sequence_lod.py sequence_first_step →
+    sequence_pool FIRST."""
+    if isinstance(x, RaggedBatch):
+        x, lengths = x.data, x.lengths
+    return sequence_pool(x, lengths, pool_type="first", name=name)
+
+
+def sequence_last_step(x, lengths=None, name=None):
+    """reference: sequence_last_step → sequence_pool LAST."""
+    if isinstance(x, RaggedBatch):
+        x, lengths = x.data, x.lengths
+    return sequence_pool(x, lengths, pool_type="last", name=name)
+
+
+def sequence_enumerate(x, win_size, pad_value=0, name=None):
+    """All win_size-length subsequences per row, padded with pad_value past
+    each row's end (reference: sequence_ops/sequence_enumerate_op.cc).
+    (data [B,T] int, lengths) -> [B, T, win_size]."""
+    if isinstance(x, RaggedBatch):
+        data, lengths = x.data, x.lengths
+    else:
+        data, lengths = x, None
+
+    def f(v, *rest):
+        lens = rest[0] if rest else jnp.full((v.shape[0],), v.shape[1],
+                                             jnp.int32)
+        T = v.shape[1]
+        pos = jnp.arange(T)[:, None] + jnp.arange(win_size)[None, :]  # [T,W]
+        valid = pos[None, :, :] < lens[:, None, None]
+        g = v[:, jnp.minimum(pos, T - 1)]  # [B, T, W]
+        return jnp.where(valid, g, pad_value)
+
+    args = (data,) + ((lengths,) if lengths is not None else ())
+    return call_op_nograd(f, *args, op_name="sequence_enumerate")
+
+
+def sequence_erase(x, tokens, name=None):
+    """Remove the given token values from each row (reference:
+    sequence_ops/sequence_erase_op.cc). Host restructuring — output rows are
+    data-dependent lengths; returns a RaggedBatch."""
+    rb = x if isinstance(x, RaggedBatch) else RaggedBatch.from_list(x)
+    toks = set(int(t) for t in np.asarray(tokens).reshape(-1))
+    rows = []
+    for r in rb.to_list():
+        keep = ~np.isin(r, list(toks))
+        rows.append(r[keep])
+    return RaggedBatch.from_list(rows)
 
 
 def sequence_pool(x, lengths, pool_type="average", name=None):

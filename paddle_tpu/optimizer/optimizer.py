@@ -126,20 +126,73 @@ class Optimizer:
         return g
 
     def step(self):
+        from ..core.selected_rows import SelectedRows
         params_grads = [(p, p._grad) for p in self._parameters()
                         if not p.stop_gradient and p._grad is not None]
+        dense = [(p, g) for p, g in params_grads
+                 if not isinstance(g, SelectedRows)]
+        sparse = [(p, g) for p, g in params_grads
+                  if isinstance(g, SelectedRows)]
         if self._grad_clip is not None:
-            params_grads = self._grad_clip(
-                [(p, g) for p, g in params_grads])
+            dense = self._grad_clip([(p, g) for p, g in dense])
         self._step_count._value = self._step_count._value + 1
         lr = self._lr.value()
-        for p, g in params_grads:
+        for p, g in dense:
             if g is None:
                 continue
             g = g.astype(jnp.float32) if g.dtype == jnp.bfloat16 else g
             plr = lr * p.__dict__.get("optimize_attr", {}).get("learning_rate", 1.0)
             new_val = self._apply_one(p, g, plr)
             p._value = new_val.astype(p._value.dtype)
+        for p, g in sparse:
+            plr = lr * p.__dict__.get("optimize_attr", {}).get("learning_rate", 1.0)
+            self._apply_sparse(p, g, plr)
+
+    def _apply_sparse(self, p, sr, lr):
+        """Row-wise update for a SelectedRows grad (reference: the sparse
+        branches of sgd_op.h / adam_op.h lazy_mode). Default: run the dense
+        update formula on the gathered rows only, scatter back — touched
+        rows see exactly the dense math; untouched rows (and their
+        accumulators) are untouched, which is lazy_mode semantics."""
+        rows, vals = sr.rows, sr.values.astype(jnp.float32)
+        valid = rows < sr.height
+        safe_rows = jnp.where(valid, rows, 0)
+
+        class _RowView:
+            """Stands in for the param/accumulator during _apply_one."""
+            pass
+
+        full = p._value
+        gathered = full[safe_rows].astype(jnp.float32)
+        view = _RowView()
+        view._value = gathered
+        view.__dict__["optimize_attr"] = p.__dict__.get("optimize_attr", {})
+        view.regularizer = getattr(p, "regularizer", None)
+        view.name = p.name
+        # accumulator row views, scattered back after the update
+        acc_keys = [k for k in self._accumulators if k[1] == id(p)]
+        saved = {}
+        for k in acc_keys:
+            acc = self._accumulators[k]
+            saved[k] = acc._value
+            row_acc = Tensor(acc._value[safe_rows])
+            self._accumulators[(k[0], id(view))] = row_acc
+        try:
+            new_rows = self._apply_one(view, vals, lr)
+            new_rows = jnp.where(valid[:, None], new_rows, gathered)
+            p._value = full.at[safe_rows].set(
+                new_rows.astype(full.dtype))
+            for k in acc_keys:
+                row_acc = self._accumulators.pop((k[0], id(view)))
+                acc = self._accumulators[k]
+                upd = jnp.where(valid[:, None] if row_acc._value.ndim > 1
+                                else valid, row_acc._value,
+                                saved[k][safe_rows])
+                acc._value = saved[k].at[safe_rows].set(upd)
+        finally:
+            for k in list(self._accumulators):
+                if k[1] == id(view):
+                    del self._accumulators[k]
 
     minimize_step = step
 

@@ -16,7 +16,116 @@ from ..core.dispatch import call_op, call_op_nograd, unwrap, wrap
 from ..core.tensor import Tensor
 
 __all__ = ["yolo_box", "prior_box", "box_coder", "nms", "multiclass_nms",
-           "roi_align", "distribute_fpn_proposals"]
+           "roi_align", "distribute_fpn_proposals", "psroi_pool",
+           "generate_proposals"]
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI pooling (reference:
+    `operators/detection/psroi_pool_op.cc`): input channels are grouped as
+    C = out_channels*ph*pw; bin (i,j) of each RoI average-pools its spatial
+    region from channel group (c, i, j). Dense jnp math: per-bin region
+    masks instead of the reference's per-pixel CUDA kernel; grads flow
+    through the masked means.
+    """
+    ph, pw = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    N, C, H, W = [int(s) for s in unwrap(x).shape]
+    if C % (ph * pw) != 0:
+        raise ValueError(f"psroi_pool needs channels {C} divisible by "
+                         f"{ph}x{pw}")
+    c_out = C // (ph * pw)
+    R = int(unwrap(boxes).shape[0])
+    bn = np.asarray(unwrap(boxes_num)).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)[:R].astype(np.int32)
+
+    def f(xv, bv):
+        rois = bv.astype(jnp.float32) * spatial_scale
+        x1, y1, x2, y2 = rois[:, 0], rois[:, 1], rois[:, 2], rois[:, 3]
+        rh = jnp.maximum(y2 - y1, 0.1) / ph  # reference clamps tiny rois
+        rw = jnp.maximum(x2 - x1, 0.1) / pw
+        # channel regroup: index c*ph*pw + i*pw + j -> [R, c_out, ph, pw, H, W]
+        xg = xv[jnp.asarray(batch_idx)].reshape(R, c_out, ph, pw, H, W)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        outs = []
+        for i in range(ph):
+            row = []
+            for j in range(pw):
+                hs = jnp.clip(jnp.floor(y1 + i * rh), 0, H)
+                he = jnp.clip(jnp.ceil(y1 + (i + 1) * rh), 0, H)
+                ws = jnp.clip(jnp.floor(x1 + j * rw), 0, W)
+                we = jnp.clip(jnp.ceil(x1 + (j + 1) * rw), 0, W)
+                mh = (ys[None, :] >= hs[:, None]) & (ys[None, :] < he[:, None])
+                mw = (xs[None, :] >= ws[:, None]) & (xs[None, :] < we[:, None])
+                m = (mh[:, None, :, None] & mw[:, None, None, :])
+                area = jnp.maximum((he - hs) * (we - ws), 1.0)
+                bin_feat = xg[:, :, i, j]  # [R, c_out, H, W]
+                s = jnp.sum(jnp.where(m, bin_feat, 0.0), axis=(2, 3))
+                row.append(s / area[:, None])
+            outs.append(jnp.stack(row, axis=-1))  # [R, c_out, pw]
+        return jnp.stack(outs, axis=-2)  # [R, c_out, ph, pw]
+
+    return call_op(f, x, boxes, op_name="psroi_pool")
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False):
+    """RPN proposal generation (reference:
+    `operators/detection/generate_proposals_op.cc`): per image — score-sort
+    anchors, decode deltas (center-size parameterization), clip to image,
+    drop boxes smaller than min_size, NMS, keep post_nms_top_n. The decode
+    runs as dense jnp; the data-dependent selection/NMS tail runs on host
+    (same CPU placement as the reference kernel). Returns padded
+    [N, post_nms_top_n, 4] rois + [N, post_nms_top_n] scores (+ rois_num).
+    """
+    sc = np.asarray(unwrap(scores), np.float32)        # [N, A, H, W]
+    bd = np.asarray(unwrap(bbox_deltas), np.float32)   # [N, 4A, H, W]
+    ims = np.asarray(unwrap(img_size), np.float32)     # [N, 2] (h, w)
+    an = np.asarray(unwrap(anchors), np.float32).reshape(-1, 4)
+    var = np.asarray(unwrap(variances), np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+
+    all_rois = np.zeros((N, post_nms_top_n, 4), np.float32)
+    all_scores = np.zeros((N, post_nms_top_n), np.float32)
+    rois_num = np.zeros((N,), np.int32)
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)           # [H*W*A]
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], var[order]
+        # decode (box_coder DECODE_CENTER_SIZE with per-anchor variance)
+        aw = a[:, 2] - a[:, 0] + 1.0
+        ah = a[:, 3] - a[:, 1] + 1.0
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], np.log(1000 / 16.0))) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], np.log(1000 / 16.0))) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2, cy + h / 2], axis=1)
+        # clip to image, filter small
+        ih, iw = ims[n]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - 1)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            k = nms(Tensor(boxes), iou_threshold=nms_thresh,
+                    scores=Tensor(s), top_k=post_nms_top_n)
+            k = np.asarray(k.numpy())
+            m = len(k)
+            all_rois[n, :m] = boxes[k]
+            all_scores[n, :m] = s[k]
+            rois_num[n] = m
+    out = (wrap(jnp.asarray(all_rois)), wrap(jnp.asarray(all_scores)))
+    if return_rois_num:
+        out = out + (wrap(jnp.asarray(rois_num)),)
+    return out
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,

@@ -1,0 +1,237 @@
+"""New op families: sequence tail ops, psroi_pool / generate_proposals,
+SelectedRows sparse gradients, functional auc.
+
+References: operators/sequence_ops/, detection/psroi_pool_op.cc,
+detection/generate_proposals_op.cc, framework/selected_rows.h:41,
+operators/optimizers (sparse branches), operators/metrics/auc_op.cc.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.selected_rows import SelectedRows
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import sequence as seq
+
+
+class TestSequenceTail:
+    def _rb(self, rows):
+        return seq.RaggedBatch.from_list([np.asarray(r, np.float32)
+                                          for r in rows])
+
+    def test_sequence_concat(self):
+        a = self._rb([[1, 2], [3]])
+        b = self._rb([[4], [5, 6, 7]])
+        out = seq.sequence_concat([a, b]).to_list()
+        np.testing.assert_allclose(out[0], [1, 2, 4])
+        np.testing.assert_allclose(out[1], [3, 5, 6, 7])
+
+    def test_sequence_slice(self):
+        x = self._rb([[1, 2, 3, 4], [5, 6, 7]])
+        out = seq.sequence_slice(x, np.array([1, 0]), np.array([2, 1]))
+        rows = out.to_list()
+        np.testing.assert_allclose(rows[0], [2, 3])
+        np.testing.assert_allclose(rows[1], [5])
+
+    def test_sequence_expand_as(self):
+        x = Tensor(np.array([[1.0], [2.0]], np.float32))
+        y = self._rb([[0, 0, 0], [0, 0]])
+        out = seq.sequence_expand_as(x, y)
+        np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1),
+                                   [1, 1, 1, 2, 2])
+
+    def test_first_last_step(self):
+        x = self._rb([[1, 2, 3], [4, 5]])
+        first = seq.sequence_first_step(x)
+        last = seq.sequence_last_step(x)
+        np.testing.assert_allclose(first.numpy(), [1, 4])
+        np.testing.assert_allclose(last.numpy(), [3, 5])
+
+    def test_sequence_enumerate(self):
+        data = Tensor(np.array([[1, 2, 3, 4]], np.int32))
+        lens = Tensor(np.array([4], np.int32))
+        out = seq.sequence_enumerate(
+            seq.RaggedBatch(data, lens), win_size=2, pad_value=0)
+        got = np.asarray(out.numpy())[0]
+        np.testing.assert_array_equal(
+            got, [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+    def test_sequence_erase(self):
+        x = seq.RaggedBatch.from_list(
+            [np.array([1, 2, 2, 3], np.int64), np.array([2, 4], np.int64)])
+        out = seq.sequence_erase(x, [2]).to_list()
+        np.testing.assert_array_equal(out[0], [1, 3])
+        np.testing.assert_array_equal(out[1], [4])
+
+
+class TestPSRoIPool:
+    def test_matches_numpy_reference(self):
+        rng = np.random.RandomState(0)
+        ph = pw = 2
+        c_out = 3
+        x = rng.rand(1, c_out * ph * pw, 8, 8).astype(np.float32)
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0], [2.0, 2.0, 7.0, 6.0]],
+                         np.float32)
+        out = paddle.vision.ops.psroi_pool(
+            Tensor(x), Tensor(boxes), Tensor(np.array([2], np.int32)),
+            output_size=2, spatial_scale=1.0)
+        got = np.asarray(out.numpy())
+        assert got.shape == (2, c_out, ph, pw)
+
+        # independent numpy reference (psroi_pool_op.cc math)
+        want = np.zeros_like(got)
+        for r, (x1, y1, x2, y2) in enumerate(boxes):
+            rh = max(y2 - y1, 0.1) / ph
+            rw = max(x2 - x1, 0.1) / pw
+            for c in range(c_out):
+                for i in range(ph):
+                    for j in range(pw):
+                        hs = int(np.clip(np.floor(y1 + i * rh), 0, 8))
+                        he = int(np.clip(np.ceil(y1 + (i + 1) * rh), 0, 8))
+                        ws = int(np.clip(np.floor(x1 + j * rw), 0, 8))
+                        we = int(np.clip(np.ceil(x1 + (j + 1) * rw), 0, 8))
+                        ch = c * ph * pw + i * pw + j
+                        region = x[0, ch, hs:he, ws:we]
+                        area = max((he - hs) * (we - ws), 1)
+                        want[r, c, i, j] = region.sum() / area
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_gradients_flow(self):
+        x = Tensor(np.random.RandomState(1).rand(1, 4, 4, 4)
+                   .astype(np.float32), stop_gradient=False)
+        boxes = Tensor(np.array([[0.0, 0.0, 3.0, 3.0]], np.float32))
+        out = paddle.vision.ops.psroi_pool(
+            x, boxes, Tensor(np.array([1], np.int32)), output_size=2)
+        out.sum().backward()
+        assert x.grad is not None
+        assert np.abs(x.grad.numpy()).sum() > 0
+
+
+class TestGenerateProposals:
+    def test_shapes_and_ordering(self):
+        rng = np.random.RandomState(2)
+        N, A, H, W = 1, 3, 4, 4
+        scores = rng.rand(N, A, H, W).astype(np.float32)
+        deltas = (rng.rand(N, 4 * A, H, W).astype(np.float32) - 0.5) * 0.2
+        # simple dense anchors
+        ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+        anchors = np.stack([xs * 4, ys * 4, xs * 4 + 8, ys * 4 + 8],
+                           axis=-1).astype(np.float32)
+        anchors = np.repeat(anchors[:, :, None, :], A, axis=2)
+        variances = np.ones_like(anchors)
+        rois, s, num = paddle.vision.ops.generate_proposals(
+            Tensor(scores), Tensor(deltas),
+            Tensor(np.array([[16.0, 16.0]], np.float32)),
+            Tensor(anchors), Tensor(variances),
+            pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.7,
+            min_size=1.0, return_rois_num=True)
+        r = np.asarray(rois.numpy())
+        sv = np.asarray(s.numpy())
+        n0 = int(np.asarray(num.numpy())[0])
+        assert r.shape == (1, 5, 4) and sv.shape == (1, 5)
+        assert 1 <= n0 <= 5
+        kept = sv[0, :n0]
+        assert np.all(np.diff(kept) <= 1e-6)  # score-descending
+        # boxes clipped to the image
+        assert r.min() >= 0 and r.max() <= 15.0
+
+
+class TestSelectedRows:
+    def test_merge_add_and_to_dense(self):
+        sr = SelectedRows(np.array([1, 3, 1]),
+                          np.array([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]],
+                                   np.float32), height=5)
+        merged = sr.merge_add()
+        dense = np.asarray(merged.to_dense())
+        want = np.zeros((5, 2), np.float32)
+        want[1] = [4.0, 4.0]
+        want[3] = [2.0, 2.0]
+        np.testing.assert_allclose(dense, want)
+
+    def test_sparse_embedding_grad_is_selected_rows(self):
+        w = Tensor(np.random.RandomState(0).rand(10, 4).astype(np.float32),
+                   stop_gradient=False)
+        ids = Tensor(np.array([1, 3, 1], np.int64))
+        out = F.embedding(ids, w, sparse=True)
+        out.sum().backward()
+        assert isinstance(w._grad, SelectedRows)
+        dense = np.asarray(w._grad.to_dense())
+        want = np.zeros((10, 4), np.float32)
+        want[1] = 2.0  # id 1 looked up twice
+        want[3] = 1.0
+        np.testing.assert_allclose(dense, want)
+
+    def test_sparse_matches_dense_gradient(self):
+        rng = np.random.RandomState(3)
+        wv = rng.rand(8, 4).astype(np.float32)
+        ids = np.array([0, 2, 2, 5], np.int64)
+        for sparse in (False, True):
+            w = Tensor(wv.copy(), stop_gradient=False)
+            out = F.embedding(Tensor(ids), w, sparse=sparse)
+            (out * out).sum().backward()
+            g = w._grad.to_dense() if sparse else w._grad
+            if sparse:
+                got_sparse = np.asarray(g)
+            else:
+                got_dense = np.asarray(g)
+        np.testing.assert_allclose(got_sparse, got_dense, rtol=1e-5)
+
+    def test_lazy_adam_touches_only_looked_up_rows(self):
+        """reference: adam_op.h lazy_mode — untouched rows (and moments)
+        must not move."""
+        rng = np.random.RandomState(4)
+        wv = rng.rand(6, 3).astype(np.float32)
+        w = Tensor(wv.copy(), stop_gradient=False)
+        w.persistable = True
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+        out = F.embedding(Tensor(np.array([1, 4], np.int64)), w, sparse=True)
+        out.sum().backward()
+        opt.step()
+        got = np.asarray(w._value)
+        changed = np.abs(got - wv).sum(axis=1) > 0
+        np.testing.assert_array_equal(changed,
+                                      [False, True, False, False, True,
+                                       False])
+        # moment accumulators: only rows 1 and 4 move
+        m1 = np.asarray(opt._get_accumulator("moment1", w)._value)
+        assert np.abs(m1[[0, 2, 3, 5]]).sum() == 0
+        assert np.abs(m1[[1, 4]]).sum() > 0
+
+    def test_sparse_sgd_matches_dense_sgd(self):
+        rng = np.random.RandomState(5)
+        wv = rng.rand(6, 3).astype(np.float32)
+        ids = np.array([1, 4, 1], np.int64)
+
+        results = {}
+        for sparse in (False, True):
+            w = Tensor(wv.copy(), stop_gradient=False)
+            opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+            out = F.embedding(Tensor(ids), w, sparse=sparse)
+            (out * 2.0).sum().backward()
+            opt.step()
+            results[sparse] = np.asarray(w._value)
+        np.testing.assert_allclose(results[True], results[False], rtol=1e-6)
+
+
+class TestAucOp:
+    def test_perfect_and_streaming(self):
+        preds = np.array([0.9, 0.8, 0.2, 0.1], np.float32)
+        labels = np.array([1, 1, 0, 0], np.int64)
+        val, sp, sn = paddle.metric.auc(preds, labels)
+        assert abs(float(val.numpy()) - 1.0) < 1e-6
+        # streaming: feed stats back with the inverse batch → AUC 0.5
+        val2, _, _ = paddle.metric.auc(preds, 1 - labels,
+                                       stat_pos=sp, stat_neg=sn)
+        assert abs(float(val2.numpy()) - 0.5) < 1e-6
+
+    def test_matches_metric_class(self):
+        rng = np.random.RandomState(6)
+        preds = rng.rand(200).astype(np.float32)
+        labels = (rng.rand(200) > 0.5).astype(np.int64)
+        m = paddle.metric.Auc()
+        m.update(preds, labels)
+        val, _, _ = paddle.metric.auc(preds, labels,
+                                      num_thresholds=m.num_thresholds)
+        np.testing.assert_allclose(float(val.numpy()), m.accumulate(),
+                                   rtol=1e-6)

@@ -1,0 +1,376 @@
+"""Parametrized OpTest sweep — every public op through check_output (+ a
+numeric-vs-analytic check_grad for the differentiable ones), the TPU analog
+of the reference's per-op test files under
+`python/paddle/fluid/tests/unittests/test_*_op.py` driven by OpTest:270.
+
+Each OPS entry: (name, op_fn, np_fn, inputs, kwargs, grad) — `grad=True`
+runs central-difference vs tape gradients on the first input; inputs stay
+tiny so the O(numel) numeric sweep is cheap. bf16 output parity runs for a
+dtype-robust subset (BF16_OPS) with widened tolerances, mirroring the
+reference's op_accuracy_white_list.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_output, check_grad
+
+rng = np.random.RandomState(42)
+
+A23 = rng.rand(2, 3).astype("float32") + 0.1
+B23 = rng.rand(2, 3).astype("float32") + 0.1
+A23n = (rng.rand(2, 3) - 0.5).astype("float32")
+A34 = rng.rand(3, 4).astype("float32")
+M23 = rng.rand(2, 3).astype("float32")
+M34 = rng.rand(3, 4).astype("float32")
+V3 = rng.rand(3).astype("float32") + 0.1
+V3b = rng.rand(3).astype("float32") + 0.1
+SQ = rng.rand(3, 3).astype("float32")
+SEP = (np.arange(6, dtype="float32").reshape(2, 3) * 0.37 + 0.05)[::-1].copy()
+POS = rng.rand(2, 3).astype("float32") * 0.8 + 0.1  # in (0.1, 0.9)
+B223 = rng.rand(2, 2, 3).astype("float32")
+B234 = rng.rand(2, 3, 4).astype("float32")
+B243 = rng.rand(2, 4, 3).astype("float32")
+IMG = rng.rand(1, 2, 6, 6).astype("float32")
+IDX = np.array([0, 2], dtype="int64")
+LBL = np.array([1, 0], dtype="int64")
+
+
+def _softmax_np(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _erf_np(x):
+    # Abramowitz-Stegun 7.1.26 (enough for 1e-5 with float64 inputs)
+    import math
+    v = np.vectorize(math.erf)
+    return v(x.astype("float64")).astype(x.dtype)
+
+
+# (name, op_fn, np_fn, inputs, kwargs, grad)
+OPS = [
+    # ---- unary math --------------------------------------------------
+    ("exp", paddle.exp, np.exp, [A23n], {}, True),
+    ("log", paddle.log, np.log, [POS], {}, True),
+    ("log2", paddle.log2, np.log2, [POS], {}, True),
+    ("log10", paddle.log10, np.log10, [POS], {}, True),
+    ("log1p", paddle.log1p, np.log1p, [POS], {}, True),
+    ("expm1", paddle.expm1, np.expm1, [A23n], {}, True),
+    ("sqrt", paddle.sqrt, np.sqrt, [POS], {}, True),
+    ("rsqrt", paddle.rsqrt, lambda x: 1 / np.sqrt(x), [POS], {}, True),
+    ("square", paddle.square, np.square, [A23n], {}, True),
+    ("abs", paddle.abs, np.abs, [A23], {}, True),
+    ("sign", paddle.sign, np.sign, [A23n], {}, False),
+    ("neg", paddle.neg, np.negative, [A23n], {}, True),
+    ("reciprocal", paddle.reciprocal, np.reciprocal, [POS], {}, True),
+    ("floor", paddle.floor, np.floor, [A23n * 3], {}, False),
+    ("ceil", paddle.ceil, np.ceil, [A23n * 3], {}, False),
+    ("round", paddle.round, np.round, [A23n * 3], {}, False),
+    ("sin", paddle.sin, np.sin, [A23n], {}, True),
+    ("cos", paddle.cos, np.cos, [A23n], {}, True),
+    ("tan", paddle.tan, np.tan, [A23n], {}, True),
+    ("asin", paddle.asin, np.arcsin, [POS - 0.5], {}, True),
+    ("acos", paddle.acos, np.arccos, [POS - 0.5], {}, True),
+    ("atan", paddle.atan, np.arctan, [A23n], {}, True),
+    ("sinh", paddle.sinh, np.sinh, [A23n], {}, True),
+    ("cosh", paddle.cosh, np.cosh, [A23n], {}, True),
+    ("tanh", paddle.tanh, np.tanh, [A23n], {}, True),
+    ("erf", paddle.erf, _erf_np, [A23n], {}, True),
+    ("logit", paddle.logit, lambda x: np.log(x / (1 - x)), [POS], {}, True),
+    ("isnan", paddle.isnan, np.isnan, [A23n], {}, False),
+    ("isinf", paddle.isinf, np.isinf, [A23n], {}, False),
+    ("isfinite", paddle.isfinite, np.isfinite, [A23n], {}, False),
+    ("clip", paddle.clip, lambda x, min, max: np.clip(x, min, max),
+     [A23n], {"min": -0.2, "max": 0.2}, True),
+    ("cast", lambda x: paddle.cast(x, "float64"),
+     lambda x: x.astype("float64"), [A23], {}, False),
+    ("scale", paddle.scale, lambda x, scale, bias: x * scale + bias,
+     [A23], {"scale": 2.0, "bias": 1.0}, True),
+    # ---- binary ------------------------------------------------------
+    ("add", paddle.add, np.add, [A23, B23], {}, True),
+    ("subtract", paddle.subtract, np.subtract, [A23, B23], {}, True),
+    ("multiply", paddle.multiply, np.multiply, [A23, B23], {}, True),
+    ("divide", paddle.divide, np.divide, [A23, POS], {}, True),
+    ("floor_divide", paddle.floor_divide, np.floor_divide,
+     [A23 * 5, POS], {}, False),
+    ("mod", paddle.mod, np.mod, [A23 * 5, POS], {}, False),
+    ("pow", paddle.pow, np.power, [POS, B23], {}, True),
+    ("maximum", paddle.maximum, np.maximum, [A23, B23], {}, True),
+    ("minimum", paddle.minimum, np.minimum, [A23, B23], {}, True),
+    ("atan2", paddle.atan2, np.arctan2, [A23, B23], {}, True),
+    ("broadcast_add", paddle.add, np.add, [A23, V3], {}, True),
+    # ---- comparison / logical ---------------------------------------
+    ("equal", paddle.equal, np.equal, [A23, A23], {}, False),
+    ("not_equal", paddle.not_equal, np.not_equal, [A23, B23], {}, False),
+    ("greater_than", paddle.greater_than, np.greater, [A23, B23], {}, False),
+    ("greater_equal", paddle.greater_equal, np.greater_equal,
+     [A23, B23], {}, False),
+    ("less_than", paddle.less_than, np.less, [A23, B23], {}, False),
+    ("less_equal", paddle.less_equal, np.less_equal, [A23, B23], {}, False),
+    ("logical_and", paddle.logical_and, np.logical_and,
+     [A23 > 0.5, B23 > 0.5], {}, False),
+    ("logical_or", paddle.logical_or, np.logical_or,
+     [A23 > 0.5, B23 > 0.5], {}, False),
+    ("logical_not", paddle.logical_not, np.logical_not, [A23 > 0.5], {},
+     False),
+    ("logical_xor", paddle.logical_xor, np.logical_xor,
+     [A23 > 0.5, B23 > 0.5], {}, False),
+    ("where", paddle.where, np.where, [A23 > 0.5, A23, B23], {}, False),
+    # ---- reductions --------------------------------------------------
+    ("sum", paddle.sum, np.sum, [A23], {}, True),
+    ("sum_axis", lambda x: paddle.sum(x, axis=1),
+     lambda x: np.sum(x, axis=1), [A23], {}, True),
+    ("mean", paddle.mean, np.mean, [A23], {}, True),
+    ("max", paddle.max, np.max, [SEP], {}, True),
+    ("min", paddle.min, np.min, [SEP], {}, True),
+    ("prod", paddle.prod, np.prod, [POS], {}, True),
+    ("std", paddle.std, lambda x: np.std(x, ddof=1), [A23], {}, True),
+    ("var", paddle.var, lambda x: np.var(x, ddof=1), [A23], {}, True),
+    ("logsumexp", paddle.logsumexp,
+     lambda x: np.log(np.sum(np.exp(x))), [A23n], {}, True),
+    ("all", paddle.all, np.all, [A23 > 0.05], {}, False),
+    ("any", paddle.any, np.any, [A23 > 0.9], {}, False),
+    ("argmax", paddle.argmax, np.argmax, [A23], {}, False),
+    ("argmin", paddle.argmin, np.argmin, [A23], {}, False),
+    ("cumsum", paddle.cumsum, lambda x: np.cumsum(x), [A23], {}, True),
+    ("cumsum_axis", lambda x: paddle.cumsum(x, axis=1),
+     lambda x: np.cumsum(x, axis=1), [A23], {}, True),
+    ("cumprod", lambda x: paddle.cumprod(x, dim=1),
+     lambda x: np.cumprod(x, axis=1), [POS], {}, True),
+    ("amax_axis", lambda x: paddle.max(x, axis=0),
+     lambda x: np.max(x, axis=0), [A23], {}, True),
+    # ---- linalg ------------------------------------------------------
+    ("matmul", paddle.matmul, np.matmul, [M23, M34], {}, True),
+    ("bmm", paddle.bmm, np.matmul, [B234, B243], {}, True),
+    ("mm", paddle.mm, np.matmul, [M23, M34], {}, True),
+    ("dot", paddle.dot, np.dot, [V3, V3b], {}, True),
+    ("t", paddle.t, np.transpose, [M23], {}, True),
+    ("norm_fro", paddle.norm, lambda x: np.linalg.norm(x), [A23], {}, True),
+    ("addmm", paddle.addmm,
+     lambda inp, x, y: inp + x @ y, [rng.rand(2, 4).astype("float32"),
+                                     M23, M34], {}, True),
+    ("einsum_ij", lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+     lambda x, y: np.einsum("ij,jk->ik", x, y), [M23, M34], {}, True),
+    # ---- manipulation ------------------------------------------------
+    ("reshape", lambda x: paddle.reshape(x, [3, 2]),
+     lambda x: x.reshape(3, 2), [A23], {}, True),
+    ("flatten", paddle.flatten, lambda x: x.reshape(-1), [B223], {}, True),
+    ("flatten_axes", lambda x: paddle.flatten(x, start_axis=1),
+     lambda x: x.reshape(2, -1), [B223], {}, True),
+    ("transpose", lambda x: paddle.transpose(x, [1, 0]),
+     lambda x: x.transpose(1, 0), [A23], {}, True),
+    ("moveaxis", lambda x: paddle.moveaxis(x, 0, 1),
+     lambda x: np.moveaxis(x, 0, 1), [A23], {}, True),
+    ("swapaxes", lambda x: paddle.swapaxes(x, 0, 1),
+     lambda x: np.swapaxes(x, 0, 1), [A23], {}, True),
+    ("squeeze", paddle.squeeze, np.squeeze,
+     [rng.rand(2, 1, 3).astype("float32")], {}, True),
+    ("unsqueeze", lambda x: paddle.unsqueeze(x, 1),
+     lambda x: np.expand_dims(x, 1), [A23], {}, True),
+    ("concat", lambda x, y: paddle.concat([x, y], axis=0),
+     lambda x, y: np.concatenate([x, y], 0), [A23, B23], {}, True),
+    ("stack", lambda x, y: paddle.stack([x, y], axis=0),
+     lambda x, y: np.stack([x, y], 0), [A23, B23], {}, True),
+    ("split", lambda x: paddle.split(x, 3, axis=1)[1],
+     lambda x: np.split(x, 3, 1)[1], [A23], {}, True),
+    ("chunk", lambda x: paddle.chunk(x, 3, axis=1)[2],
+     lambda x: np.array_split(x, 3, 1)[2], [A23], {}, True),
+    ("tile", lambda x: paddle.tile(x, [2, 1]),
+     lambda x: np.tile(x, (2, 1)), [A23], {}, True),
+    ("expand", lambda x: paddle.expand(x, [2, 3]),
+     lambda x: np.broadcast_to(x, (2, 3)), [V3], {}, True),
+    ("broadcast_to", lambda x: paddle.broadcast_to(x, [2, 3]),
+     lambda x: np.broadcast_to(x, (2, 3)), [V3], {}, True),
+    ("flip", lambda x: paddle.flip(x, axis=[0]),
+     lambda x: np.flip(x, 0), [A23], {}, True),
+    ("roll", lambda x: paddle.roll(x, 1, axis=0),
+     lambda x: np.roll(x, 1, 0), [A23], {}, True),
+    ("pad", lambda x: paddle.nn.functional.pad(x, [1, 1], value=0.0),
+     lambda x: np.pad(x, [(0, 0), (1, 1)]), [A23], {}, True),
+    ("gather", lambda x: paddle.gather(x, paddle.to_tensor(IDX), axis=1),
+     lambda x: x[:, IDX], [A23], {}, True),
+    ("index_select",
+     lambda x: paddle.index_select(x, paddle.to_tensor(IDX), axis=1),
+     lambda x: x[:, IDX], [A23], {}, True),
+    ("gather_nd",
+     lambda x: paddle.gather_nd(x, paddle.to_tensor(
+         np.array([[0, 1], [1, 2]], "int64"))),
+     lambda x: x[[0, 1], [1, 2]], [A23], {}, True),
+    ("take_along_axis",
+     lambda x: paddle.take_along_axis(
+         x, paddle.to_tensor(np.array([[0], [1]], "int64")), 1),
+     lambda x: np.take_along_axis(x, np.array([[0], [1]]), 1), [A23], {},
+     True),
+    ("masked_select",
+     lambda x: paddle.masked_select(x, paddle.to_tensor(A23 > 0.5)),
+     lambda x: x[A23 > 0.5], [A23], {}, False),
+    ("masked_fill",
+     lambda x: paddle.masked_fill(x, paddle.to_tensor(A23 > 0.5), 0.0),
+     lambda x: np.where(A23 > 0.5, 0.0, x), [A23], {}, True),
+    ("unstack", lambda x: paddle.unstack(x, axis=0)[0],
+     lambda x: x[0], [A23], {}, True),
+    ("one_hot", lambda: paddle.nn.functional.one_hot(
+        paddle.to_tensor(LBL), 3),
+     lambda: np.eye(3, dtype="float32")[LBL], [], {}, False),
+    ("unique", lambda: paddle.unique(paddle.to_tensor(
+        np.array([1, 3, 1, 2], "int64"))),
+     lambda: np.unique(np.array([1, 3, 1, 2], "int64")), [], {}, False),
+    ("repeat_interleave", lambda x: paddle.repeat_interleave(x, 2, axis=0),
+     lambda x: np.repeat(x, 2, 0), [A23], {}, True),
+    ("slice_basic", lambda x: x[0:1, 1:3], lambda x: x[0:1, 1:3],
+     [A23], {}, True),
+    ("index_sample",
+     lambda x: paddle.index_sample(x, paddle.to_tensor(
+         np.array([[0, 1], [2, 0]], "int64"))),
+     lambda x: np.take_along_axis(x, np.array([[0, 1], [2, 0]]), 1),
+     [A23], {}, True),
+    # ---- creation ----------------------------------------------------
+    ("zeros", lambda: paddle.zeros([2, 3]),
+     lambda: np.zeros((2, 3), "float32"), [], {}, False),
+    ("ones", lambda: paddle.ones([2, 3]),
+     lambda: np.ones((2, 3), "float32"), [], {}, False),
+    ("full", lambda: paddle.full([2, 2], 7.0),
+     lambda: np.full((2, 2), 7.0, "float32"), [], {}, False),
+    ("arange", lambda: paddle.arange(0, 10, 2),
+     lambda: np.arange(0, 10, 2), [], {}, False),
+    ("linspace", lambda: paddle.linspace(0, 1, 5),
+     lambda: np.linspace(0, 1, 5, dtype="float32"), [], {}, False),
+    ("eye", lambda: paddle.eye(3), lambda: np.eye(3, dtype="float32"),
+     [], {}, False),
+    ("tril", paddle.tril, np.tril, [SQ], {}, True),
+    ("triu", paddle.triu, np.triu, [SQ], {}, True),
+    ("diag", paddle.diag, np.diag, [V3], {}, False),
+    ("zeros_like", paddle.zeros_like, np.zeros_like, [A23], {}, False),
+    ("ones_like", paddle.ones_like, np.ones_like, [A23], {}, False),
+    ("full_like", lambda x: paddle.full_like(x, 3.0),
+     lambda x: np.full_like(x, 3.0), [A23], {}, False),
+    ("meshgrid", lambda x, y: paddle.meshgrid(x, y)[0],
+     lambda x, y: np.meshgrid(x, y, indexing="ij")[0], [V3, V3b], {}, False),
+    # ---- sort family ---------------------------------------------------
+    ("sort", lambda x: paddle.sort(x, axis=1),
+     lambda x: np.sort(x, axis=1), [A23], {}, True),
+    ("argsort", lambda x: paddle.argsort(x, axis=1),
+     lambda x: np.argsort(x, axis=1, kind="stable"), [A23], {}, False),
+    ("topk", lambda x: paddle.topk(x, 2, axis=1)[0],
+     lambda x: np.sort(x, axis=1)[:, ::-1][:, :2], [SEP], {}, True),
+    # ---- activations (nn.functional) ---------------------------------
+    ("relu", F.relu, lambda x: np.maximum(x, 0), [A23n], {}, True),
+    ("relu6", F.relu6, lambda x: np.clip(x, 0, 6), [A23n * 8], {}, True),
+    ("sigmoid", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [A23n], {}, True),
+    ("softmax", F.softmax, _softmax_np, [A23n], {}, True),
+    ("log_softmax", F.log_softmax,
+     lambda x: np.log(_softmax_np(x)), [A23n], {}, True),
+    ("gelu", F.gelu,
+     lambda x: x * 0.5 * (1 + _erf_np(x / np.sqrt(2.0))), [A23n],
+     {}, True),
+    ("leaky_relu", F.leaky_relu,
+     lambda x: np.where(x > 0, x, 0.01 * x), [A23n], {}, True),
+    ("elu", F.elu, lambda x: np.where(x > 0, x, np.expm1(x)), [A23n], {},
+     True),
+    ("selu", F.selu,
+     lambda x: 1.0507009873554805 * np.where(
+         x > 0, x, 1.6732632423543772 * np.expm1(x)), [A23n], {}, True),
+    ("softplus", F.softplus, lambda x: np.log1p(np.exp(x)), [A23n], {}, True),
+    ("softsign", F.softsign, lambda x: x / (1 + np.abs(x)), [A23n], {}, True),
+    ("hardtanh", F.hardtanh, lambda x: np.clip(x, -1, 1), [A23n * 4], {},
+     True),
+    ("hardsigmoid", F.hardsigmoid,
+     lambda x: np.clip(x / 6 + 0.5, 0, 1), [A23n * 8], {}, True),
+    ("hardswish", F.hardswish,
+     lambda x: x * np.clip(x + 3, 0, 6) / 6, [A23n * 4], {}, True),
+    ("silu", F.silu, lambda x: x / (1 + np.exp(-x)), [A23n], {}, True),
+    ("mish", F.mish,
+     lambda x: x * np.tanh(np.log1p(np.exp(x))), [A23n], {}, True),
+    ("swish", F.swish, lambda x: x / (1 + np.exp(-x)), [A23n], {}, True),
+    ("tanhshrink", F.tanhshrink, lambda x: x - np.tanh(x), [A23n], {}, True),
+    ("softshrink", lambda x: F.softshrink(x, 0.1),
+     lambda x: np.where(x > 0.1, x - 0.1, np.where(x < -0.1, x + 0.1, 0.0)),
+     [A23n], {}, True),
+    ("hardshrink", lambda x: F.hardshrink(x, 0.1),
+     lambda x: np.where(np.abs(x) > 0.1, x, 0.0), [A23n], {}, True),
+    ("prelu", lambda x: F.prelu(x, paddle.to_tensor(
+        np.array([0.25], "float32"))),
+     lambda x: np.where(x > 0, x, 0.25 * x), [A23n], {}, True),
+    # ---- losses --------------------------------------------------------
+    ("mse_loss", F.mse_loss, lambda x, y: np.mean((x - y) ** 2),
+     [A23, B23], {}, True),
+    ("l1_loss", F.l1_loss, lambda x, y: np.mean(np.abs(x - y)),
+     [A23, B23], {}, True),
+    ("smooth_l1", lambda x, y: F.smooth_l1_loss(x, y),
+     lambda x, y: np.mean(np.where(np.abs(x - y) < 1.0,
+                                   0.5 * (x - y) ** 2,
+                                   np.abs(x - y) - 0.5)),
+     [A23 * 3, B23], {}, True),
+    ("bce_loss", F.binary_cross_entropy,
+     lambda x, y: np.mean(-(y * np.log(x) + (1 - y) * np.log(1 - x))),
+     [POS, (B23 > 0.5).astype("float32")], {}, True),
+    ("bce_with_logits", F.binary_cross_entropy_with_logits,
+     lambda x, y: np.mean(
+         np.maximum(x, 0) - x * y + np.log1p(np.exp(-np.abs(x)))),
+     [A23n, (B23 > 0.5).astype("float32")], {}, True),
+    ("kl_div", lambda x, y: F.kl_div(paddle.log(x), y, reduction="mean"),
+     lambda x, y: np.mean(y * (np.log(y) - np.log(x))),
+     [_softmax_np(A23n), _softmax_np(B23)], {}, True),
+    ("cross_entropy",
+     lambda x: F.cross_entropy(x, paddle.to_tensor(LBL)),
+     lambda x: -np.mean(np.log(_softmax_np(x)[np.arange(2), LBL])),
+     [A23n], {}, True),
+    ("nll_loss",
+     lambda x: F.nll_loss(paddle.log(x), paddle.to_tensor(LBL)),
+     lambda x: -np.mean(np.log(x)[np.arange(2), LBL]),
+     [_softmax_np(A23n)], {}, True),
+    # ---- nn structure ops ----------------------------------------------
+    ("linear", lambda x: F.linear(x, paddle.to_tensor(M34),
+                                  paddle.to_tensor(V3b[:4].copy()
+                                                   if len(V3b) >= 4 else
+                                                   np.zeros(4, "float32"))),
+     lambda x: x @ M34 + (V3b[:4] if len(V3b) >= 4
+                          else np.zeros(4, "float32")),
+     [M23], {}, True),
+    ("avg_pool2d", lambda x: F.avg_pool2d(x, 2),
+     lambda x: x.reshape(1, 2, 3, 2, 3, 2).mean(axis=(3, 5)), [IMG], {},
+     True),
+    ("max_pool2d", lambda x: F.max_pool2d(x, 2),
+     lambda x: x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5)), [IMG], {},
+     True),
+    ("embedding",
+     lambda: F.embedding(paddle.to_tensor(IDX), paddle.to_tensor(M34)),
+     lambda: M34[IDX], [], {}, False),
+    # ---- sequence ops (LoD analog, reference sequence_ops/) -----------
+    ("sequence_mask",
+     lambda: paddle.ops.sequence.sequence_mask(
+         paddle.to_tensor(np.array([2, 3], "int64")), maxlen=4),
+     lambda: (np.arange(4)[None, :] < np.array([[2], [3]])),
+     [], {}, False),
+]
+
+
+GRAD_OPS = [(n, op, ins, kw) for n, op, _, ins, kw, g in OPS if g]
+
+
+@pytest.mark.parametrize("name,op_fn,np_fn,inputs,kwargs",
+                         [(n, o, r, i, k) for n, o, r, i, k, _ in OPS],
+                         ids=[o[0] for o in OPS])
+def test_output(name, op_fn, np_fn, inputs, kwargs):
+    check_output(op_fn, np_fn, inputs, kwargs=kwargs)
+
+
+@pytest.mark.parametrize("name,op_fn,inputs,kwargs", GRAD_OPS,
+                         ids=[o[0] for o in GRAD_OPS])
+def test_grad(name, op_fn, inputs, kwargs):
+    check_grad(op_fn, inputs, kwargs=kwargs)
+
+
+# bf16 parity subset (tolerances per the reference threshold white list)
+BF16_OPS = ["exp", "sqrt", "square", "abs", "tanh", "add", "subtract",
+            "multiply", "maximum", "minimum", "sum", "mean", "matmul",
+            "relu", "sigmoid", "softmax", "gelu"]
+
+
+@pytest.mark.parametrize("name", BF16_OPS)
+def test_bf16_output(name):
+    entry = next(o for o in OPS if o[0] == name)
+    _, op_fn, np_fn, inputs, kwargs, _ = entry
+    check_output(op_fn, np_fn, inputs, dtype="bfloat16", kwargs=kwargs)
