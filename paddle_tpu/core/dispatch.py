@@ -143,6 +143,27 @@ def _amp_cast(op_name, values):
     return amp_cast_inputs(op_name, values)
 
 
+def _amp_wrap_fn(fn, op_name, args):
+    """fp32-compute ops in a bf16 stream cast their outputs back down
+    (amp.downcast_out_list); the cast lives inside the traced fn so jax.vjp
+    upcasts cotangents symmetrically."""
+    from ..amp.auto_cast import _state, amp_output_downcast
+    if not _state.enabled:
+        return fn
+    dt = amp_output_downcast(op_name, [unwrap(a) for a in args])
+    if dt is None:
+        return fn
+
+    def wrapped(*a, **k):
+        out = fn(*a, **k)
+        if isinstance(out, tuple):
+            return tuple(o.astype(dt) if hasattr(o, "astype") else o
+                         for o in out)
+        return out.astype(dt) if hasattr(out, "astype") else out
+
+    return wrapped
+
+
 def _substitute(args, kwargs, positions, values, op_name=None):
     """Rebuild (args, kwargs) with Tensors replaced by raw values; the tensors
     at `positions` (path keys) get `values`, the rest are closed-over consts."""
@@ -208,6 +229,7 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
         _note_capture_inputs(args, kwargs)
 
     name = op_name or getattr(fn, "__name__", "op")
+    fn = _amp_wrap_fn(fn, name, args)
 
     def g(*diff_vals):
         a, k = _substitute(args, kwargs, diff_positions, diff_vals, op_name=name)
@@ -259,8 +281,9 @@ def _call_op_nograd_impl(fn, *args, op_name=None, **kwargs):
     capturing = bool(_CAPTURE.stack)
     if capturing:
         _note_capture_inputs(args, kwargs)
-    a = _amp_cast(op_name or getattr(fn, "__name__", "op"),
-                  [unwrap(x) for x in args])
+    name = op_name or getattr(fn, "__name__", "op")
+    fn = _amp_wrap_fn(fn, name, args)
+    a = _amp_cast(name, [unwrap(x) for x in args])
     k = {key: unwrap(v) for key, v in kwargs.items()}
     out = fn(*a, **k)
     if isinstance(out, tuple):

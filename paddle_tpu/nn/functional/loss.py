@@ -26,10 +26,41 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
 
     def _ce(logits, *rest):
         w = rest[0] if weight is not None else None
+        if use_softmax and not soft_label and label_smoothing == 0.0:
+            # fused hard-label path: loss = logsumexp - picked, with fp32
+            # accumulation fused INTO the reductions — no fp32 [N, vocab]
+            # log-softmax is materialized (reference:
+            # softmax_with_cross_entropy_op.cu computes per-row on the fly;
+            # here XLA fuses the upcast into the reduce). This is the hot
+            # path for bf16 MLM/LM heads.
+            idx = lbl
+            if idx.ndim == logits.ndim:
+                idx = jnp.squeeze(idx, axis=axis)
+            idx = idx.astype(jnp.int32)
+            valid = idx != ignore_index
+            safe_idx = jnp.where(valid, idx, 0)
+            # manual stable LSE: exp stays in the logits dtype (fused into
+            # the reduce as a producer — a logits.astype(f32) here would
+            # materialize a full fp32 [N, vocab] copy); only the reduce
+            # ACCUMULATES in fp32
+            m = jnp.max(logits, axis=axis, keepdims=True)
+            se = jnp.sum(jnp.exp(logits - m), axis=axis, dtype=jnp.float32)
+            lse = jnp.squeeze(m, axis).astype(jnp.float32) + jnp.log(se)
+            picked = jnp.squeeze(jnp.take_along_axis(
+                logits, jnp.expand_dims(safe_idx, axis), axis=axis), axis)
+            loss = jnp.where(valid, lse - picked.astype(jnp.float32), 0.0)
+            if w is not None:
+                loss = loss * jnp.take(w, safe_idx) * valid
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.take(w, safe_idx) * valid)
+                    return jnp.sum(loss) / jnp.maximum(denom, 1)
+            elif reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+            return _reduce(loss, reduction)
         if use_softmax:
-            logp = jax.nn.log_softmax(logits, axis=axis)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
         else:
-            logp = jnp.log(jnp.maximum(logits, 1e-30))
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
         if soft_label:
             tgt = lbl.astype(logp.dtype)
             if label_smoothing > 0.0:
