@@ -1,0 +1,221 @@
+"""BASELINE.md config ladder — measured, not aspirational.
+
+Configs (BASELINE.md / SURVEY.md §6):
+  1. LeNet/MNIST dygraph smoke        — covered by tests/test_training_e2e.py
+  2. ResNet-50 @to_static             — img/s/chip               (here)
+  3. BERT-base pretraining            — bench.py (the headline; driver-run)
+  4. GPT-1.3B sharding + pipeline     — hybrid dryrun step time  (here)
+  5. detection variable-shape path    — covered by tests/test_detection_sequence.py
+
+Run: `python benchmarks/run_all.py [--configs resnet,gpt,allreduce]`
+Prints one JSON line per config. On a host without TPU the numbers are
+CPU-smoke only (marked "backend": "cpu").
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PEAK_BF16_FLOPS = 197e12  # v5e
+
+
+def _sync(x):
+    return float(np.asarray(x if not hasattr(x, "numpy") else x.numpy()).sum())
+
+
+def bench_resnet50():
+    """Config 2: ResNet-50 training step, @to_static, bf16 AMP."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet50
+
+    backend = jax.default_backend()
+    on_tpu = backend != "cpu"
+    bs, iters, warmup = (64, 10, 3) if on_tpu else (2, 2, 1)
+    size = 224 if on_tpu else 32
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000 if on_tpu else 10)
+    opt = paddle.optimizer.Momentum(parameters=model.parameters(),
+                                    learning_rate=0.1, momentum=0.9)
+
+    def train_step(x, y):
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+            logits = model(x)
+            loss = nn.functional.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(bs, 3, size, size).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 10, (bs,)).astype("int64"))
+    for _ in range(warmup):
+        loss = step(x, y)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    img_s = bs * iters / dt
+    return {"metric": "resnet50_train_img_per_s_per_chip",
+            "value": round(img_s, 1), "unit": "img/s",
+            "backend": backend, "batch": bs}
+
+
+def bench_gpt_sharding_pp(n_virtual=8):
+    """Config 4: GPT-1.3B-config hybrid dp x sharding(ZeRO) + 1F1B pipeline.
+
+    Schedule correctness + step time on an n-device mesh (virtual CPU mesh
+    when no multi-chip TPU is attached, the driver's dryrun strategy). Model
+    dims are scaled down; the partitioning logic (1.3B's layer/stage/shard
+    structure) is what executes.
+    """
+    import jax
+    if jax.default_backend() == "cpu" and jax.device_count() < n_virtual:
+        return {"metric": "gpt13b_hybrid_dryrun_step_ms", "value": -1.0,
+                "unit": "ms", "backend": "cpu",
+                "note": f"needs {n_virtual} devices: set "
+                        f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                        f"{n_virtual}"}
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.parallel import spmd_pipeline_1f1b
+
+    devs = jax.devices()[:n_virtual]
+    pp, dp = 4, 2
+    mesh = dist.make_mesh({"dp": dp, "pp": pp}, devices=devs)
+
+    # GPT-1.3B structure (gpt3_1p3b: 24 layers, h=2048, 16 heads), scaled
+    # dims for the dryrun; 6 layers/stage over pp=4 as 1 stacked stage-block
+    S_layers, h, ffn = 4, 64, 256  # stage does S_layers fused sublayers
+    M, mb, T = 8, 2, 16
+    rng = np.random.RandomState(0)
+    w1 = (rng.randn(pp, S_layers, h, ffn) * 0.05).astype(np.float32)
+    w2 = (rng.randn(pp, S_layers, ffn, h) * 0.05).astype(np.float32)
+    emb = (rng.randn(512, h) * 0.05).astype(np.float32)
+    head = (rng.randn(h, 512) * 0.05).astype(np.float32)
+    ids = rng.randint(0, 512, (M, mb, T)).astype(np.int32)
+    labels = rng.randint(0, 512, (M, mb, T)).astype(np.int32)
+
+    def stage_fn(params, x):
+        sw1, sw2 = params
+        def body(h_, ws):
+            a, b = ws
+            return jnp.tanh(h_ @ a) @ b + h_, None
+        out, _ = jax.lax.scan(body, x, (sw1, sw2))
+        return out
+
+    def first_fn(e, token_ids):
+        return e[token_ids]
+
+    def last_fn(hw, x, y):
+        logits = x @ hw
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[..., None], axis=-1))
+
+    def hybrid_step(sp1, sp2, e, hw, micro, lab):
+        # dp: batch sharded over 'dp'; pp: stage params + 1F1B over 'pp';
+        # ZeRO-style: stage grads come back sharded over pp (their owner)
+        def inner(a, b, e_, hw_, x_, y_):
+            loss, gP, gE, gH = spmd_pipeline_1f1b(
+                stage_fn, last_fn, (a, b), hw_, x_, y_,
+                first_fn=first_fn, first_params=e_, axis_name="pp")
+            loss = jax.lax.pmean(loss, "dp")
+            gP = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, "dp"), gP)
+            return loss, gP
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P("pp"), P("pp"), P(), P(), P(None, "dp"),
+                      P(None, "dp")),
+            out_specs=(P(), (P("pp"), P("pp"))))(sp1, sp2, e, hw, micro, lab)
+
+    jit_step = jax.jit(hybrid_step)
+    loss, grads = jit_step(w1, w2, emb, head, ids, labels)
+    assert np.isfinite(float(loss))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        loss, grads = jit_step(w1, w2, emb, head, ids, labels)
+    _ = float(np.asarray(loss))
+    dt = (time.perf_counter() - t0) / 3
+    return {"metric": "gpt13b_hybrid_dryrun_step_ms",
+            "value": round(dt * 1000, 2), "unit": "ms",
+            "backend": jax.default_backend(),
+            "mesh": {"dp": dp, "pp": pp}, "microbatches": M,
+            "loss": round(float(loss), 4)}
+
+
+def bench_allreduce():
+    """Fleet allreduce bus bandwidth (BASELINE.md metric 3) across the
+    attached devices (1 device → memcpy-bound upper bound, reported as
+    such)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.device_count()
+    nbytes = 64 * 1024 * 1024
+    x = jnp.ones((nbytes // 4,), jnp.float32)
+    if n == 1:
+        # one compiled scan of K copies: measures HBM r/w, not dispatch
+        K = 50
+
+        def body(v, _):
+            return v + 1.0, None
+
+        f = jax.jit(lambda v: jax.lax.scan(body, v, None, length=K)[0])
+        float(f(x)[0])
+        t0 = time.perf_counter()
+        float(f(x)[0])
+        dt = (time.perf_counter() - t0) / K
+        bw = 2 * nbytes / dt / 1e9
+        return {"metric": "allreduce_bus_bw_GBps", "value": round(bw, 1),
+                "unit": "GB/s", "backend": jax.default_backend(),
+                "devices": 1, "note": "single device: HBM r/w bound"}
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu.distributed as dist
+    mesh = dist.make_mesh({"dp": n})
+    f = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
+                              in_specs=P("dp"), out_specs=P("dp")))
+    y = f(x)
+    jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        y = f(x)
+    jax.block_until_ready(y)
+    dt = (time.perf_counter() - t0) / 10
+    # ring allreduce bus bytes: 2 * (n-1)/n * payload
+    bus = 2 * (n - 1) / n * nbytes / dt / 1e9
+    return {"metric": "allreduce_bus_bw_GBps", "value": round(bus, 1),
+            "unit": "GB/s", "backend": jax.default_backend(), "devices": n}
+
+
+BENCHES = {"resnet": bench_resnet50, "gpt": bench_gpt_sharding_pp,
+           "allreduce": bench_allreduce}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="resnet,gpt,allreduce")
+    args = ap.parse_args()
+    for name in args.configs.split(","):
+        try:
+            print(json.dumps(BENCHES[name.strip()]()), flush=True)
+        except Exception as e:
+            print(json.dumps({"metric": name, "error": str(e)[:300]}),
+                  flush=True)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

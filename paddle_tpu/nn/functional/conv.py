@@ -45,11 +45,14 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, data_format
     dn = _dim_numbers(nd, data_format)
 
     def _conv(v, w, *rest):
+        # NB: no preferred_element_type=f32 — the TPU MXU accumulates bf16
+        # convs in f32 regardless, and the flag breaks the conv TRANSPOSE
+        # under AMP (jax feeds the f32 cotangent to a conv whose other
+        # operand is bf16: "requires arguments to have the same dtypes")
         out = jax.lax.conv_general_dilated(
             v, w, window_strides=strides, padding=pad,
             rhs_dilation=dil, feature_group_count=groups,
-            dimension_numbers=dn,
-            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None)
+            dimension_numbers=dn)
         if out.dtype != v.dtype:
             out = out.astype(v.dtype)
         if rest:

@@ -123,15 +123,26 @@ def spmd_pipeline_1f1b(stage_fn, last_fn, stacked_params, last_params,
 
     hidden_struct = jax.eval_shape(_hidden_of, microbatches[0])
     # device-varying cast: cond branches must agree on varying-ness even when
-    # one side is built only from replicated inputs (idempotent)
+    # one side is built only from replicated inputs. The pipeline may run
+    # inside a larger mesh (dp x pp hybrid), so the target set is every
+    # manual axis the inputs vary over, plus the pipeline axis.
+    _in_vma = {axis_name}
+    for leaf in jax.tree_util.tree_leaves(
+            (stacked_params, last_params, microbatches, labels)):
+        try:
+            _in_vma |= set(jax.typeof(leaf).vma)
+        except Exception:
+            pass
+
     def _v(z):
         try:
-            vma = jax.typeof(z).vma
+            vma = set(jax.typeof(z).vma)
         except Exception:
-            vma = frozenset()
-        if axis_name in vma:
+            vma = set()
+        missing = tuple(sorted(_in_vma - vma))
+        if not missing:
             return z
-        return lax.pcast(z, (axis_name,), to="varying")
+        return lax.pcast(z, missing, to="varying")
 
     # first/last params become device-varying copies: otherwise jax.grad
     # would insert a psum for these replicated inputs INSIDE a varying-pred
@@ -206,15 +217,14 @@ def spmd_pipeline_1f1b(stage_fn, last_fn, stacked_params, last_params,
         bwd_recv = lax.ppermute(dx, axis_name, bwd_perm)
         return (fwd_recv, bwd_recv, act_buf, loss_buf, gP, gF, gL), None
 
-    _vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
-    zeros_h = lambda: _vary(jnp.zeros(hidden_struct.shape,
-                                      hidden_struct.dtype))
+    zeros_h = lambda: _v(jnp.zeros(hidden_struct.shape,
+                                   hidden_struct.dtype))
     zeros_like_tree = lambda tree: jax.tree_util.tree_map(
-        lambda x: _vary(jnp.zeros(jnp.shape(x), jnp.result_type(x))), tree)
+        lambda x: _v(jnp.zeros(jnp.shape(x), jnp.result_type(x))), tree)
     carry0 = (zeros_h(), zeros_h(),
-              _vary(jnp.zeros((B,) + tuple(hidden_struct.shape),
-                              hidden_struct.dtype)),
-              _vary(jnp.zeros((M,), jnp.float32)),
+              _v(jnp.zeros((B,) + tuple(hidden_struct.shape),
+                           hidden_struct.dtype)),
+              _v(jnp.zeros((M,), jnp.float32)),
               zeros_like_tree(params),
               zeros_like_tree(first_params),
               zeros_like_tree(last_params))

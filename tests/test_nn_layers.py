@@ -277,3 +277,19 @@ def test_grad_clip():
     # after clipping the applied update is bounded by lr * clip_norm
     delta = np.linalg.norm(w.numpy() - np.ones(4))
     assert delta <= 0.1 * 0.5 * 1.01
+
+
+def test_conv_amp_backward():
+    """AMP'd conv must be differentiable (the preferred_element_type=f32
+    transpose broke with mixed bf16/f32 operands; caught by the ResNet
+    bench)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3)
+    x = paddle.to_tensor(np.random.rand(2, 3, 8, 8).astype("float32"))
+    with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+        loss = conv(x).sum()
+    loss.backward()
+    g = conv.weight._grad
+    assert g is not None and np.isfinite(np.asarray(g)).all()
