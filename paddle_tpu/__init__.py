@@ -52,6 +52,8 @@ from . import dataset  # noqa: F401
 from . import quantization  # noqa: F401
 from . import sparsity  # noqa: F401
 from .core.flags import set_flags, get_flags  # noqa: F401
+from .core import enforce  # noqa: F401
+from .core import op_version  # noqa: F401
 
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .serialization import save, load  # noqa: F401

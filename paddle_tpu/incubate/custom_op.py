@@ -1,0 +1,103 @@
+"""Custom C++ op ABI — load user-compiled kernels into the op stream.
+
+Reference: `paddle/fluid/framework/custom_operator.cc:511`
+(RegisterOperatorWithMetaInfo) + `paddle/fluid/extension/` — users compile a
+shared library against a C ABI and the framework dlopens it, registering the
+op with forward/backward kernels.
+
+TPU redesign: device kernels are XLA/pallas; the custom-op seam that remains
+native is HOST compute — a dlopen'd C function invoked per call through
+`jax.pure_callback` (so it composes with jit/to_static: XLA calls back to
+the host, exactly where the reference ran custom CPU kernels). Gradients
+come from an optional `<name>_backward` symbol via jax.custom_vjp.
+
+C ABI (v1 — elementwise, f32, shape-preserving):
+
+    // y[i] = f(x[i]); n = element count
+    void <name>_forward(const float* x, float* y, int64_t n);
+    // optional: grad_x[i] = df(x[i]) * grad_y[i]
+    void <name>_backward(const float* x, const float* gy, float* gx,
+                         int64_t n);
+
+Build example (pure C symbols, no framework headers needed):
+    g++ -O2 -fPIC -shared my_op.cc -o my_op.so
+Load:
+    op = paddle.incubate.load_custom_op("./my_op.so", "my_relu")
+    y = op(x)   # differentiable if my_relu_backward is exported
+"""
+import ctypes
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import call_op
+from ..core.enforce import NotFoundError, enforce_not_none
+
+__all__ = ["load_custom_op"]
+
+
+def _bind(lib, sym):
+    try:
+        fn = getattr(lib, sym)
+    except AttributeError:
+        return None
+    fn.restype = None
+    return fn
+
+
+def load_custom_op(so_path, name):
+    """dlopen `so_path`, bind `<name>_forward` (+ optional `_backward`), and
+    return a differentiable python op usable eagerly and under to_static."""
+    lib = ctypes.CDLL(so_path)
+    fwd = enforce_not_none(
+        _bind(lib, f"{name}_forward"),
+        f"custom op library {so_path!r} does not export "
+        f"'{name}_forward(const float*, float*, int64_t)'",
+        NotFoundError)
+    bwd = _bind(lib, f"{name}_backward")
+
+    FP = ctypes.POINTER(ctypes.c_float)
+
+    def _host_fwd(x):
+        x = np.ascontiguousarray(np.asarray(x, np.float32))
+        y = np.empty_like(x)
+        fwd(x.ctypes.data_as(FP), y.ctypes.data_as(FP),
+            ctypes.c_int64(x.size))
+        return y
+
+    def _host_bwd(x, gy):
+        x = np.ascontiguousarray(np.asarray(x, np.float32))
+        gy = np.ascontiguousarray(np.asarray(gy, np.float32))
+        gx = np.empty_like(x)
+        bwd(x.ctypes.data_as(FP), gy.ctypes.data_as(FP),
+            gx.ctypes.data_as(FP), ctypes.c_int64(x.size))
+        return gx
+
+    @jax.custom_vjp
+    def _op(v):
+        return jax.pure_callback(
+            _host_fwd, jax.ShapeDtypeStruct(v.shape, jnp.float32),
+            v.astype(jnp.float32))
+
+    def _op_fwd(v):
+        return _op(v), v
+
+    def _op_bwd(res, g):
+        v = res
+        if bwd is None:
+            raise NotImplementedError(
+                f"custom op {name!r}: no '{name}_backward' symbol exported")
+        gx = jax.pure_callback(
+            _host_bwd, jax.ShapeDtypeStruct(v.shape, jnp.float32),
+            v.astype(jnp.float32), g.astype(jnp.float32))
+        return (gx,)
+
+    _op.defvjp(_op_fwd, _op_bwd)
+
+    def custom(x):
+        return call_op(_op, x, op_name=f"custom_{name}")
+
+    custom.__name__ = f"custom_{name}"
+    custom.has_backward = bwd is not None
+    return custom

@@ -102,8 +102,10 @@ def export_callable(fn, state_items, input_specs, output_names=None):
     n_out = out_info.get("n", 1)
     if output_names is None:
         output_names = [f"output_{i}" for i in range(n_out)]
+    from ..core import op_version
     meta = {
         "format_version": _FORMAT_VERSION,
+        "op_versions": op_version.snapshot(),
         "param_names": names,
         "input_names": input_names,
         "input_specs": [
@@ -155,6 +157,8 @@ class ServedProgram:
         with zipfile.ZipFile(path_prefix + _SUFFIX_MODEL) as z:
             blob = z.read("program.bin")
             self.meta = json.loads(z.read("meta.json"))
+        from ..core import op_version
+        op_version.check_compatible(self.meta.get("op_versions"))
         params_file = params_path or (path_prefix + _SUFFIX_PARAMS)
         if not os.path.exists(params_file):
             raise FileNotFoundError(

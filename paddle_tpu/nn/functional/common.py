@@ -88,6 +88,12 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
             return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype))
         return jnp.where(keep, v, jnp.zeros((), v.dtype))
 
+    # clone(for_test): upscale_in_train dropout is identity at eval;
+    # downscale mode keeps the (1-p) expectation factor
+    if mode == "upscale_in_train":
+        _dropout._eval_fn = lambda v: v
+    else:
+        _dropout._eval_fn = lambda v: v * (1.0 - p)
     return call_op(_dropout, x, op_name="dropout")
 
 

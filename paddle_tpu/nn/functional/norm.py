@@ -17,11 +17,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         use_global_stats = not training
     channel_axis = 1 if data_format.startswith("NC") else -1
 
+    from ...core.dispatch import _STATIC_HOOK
+
     v = unwrap(x)
     reduce_axes = tuple(i for i in range(v.ndim) if i != (channel_axis % v.ndim))
 
-    if not use_global_stats:
-        # batch statistics; update running buffers in-place (traced state)
+    if not use_global_stats and _STATIC_HOOK[0] is None:
+        # batch statistics; update running buffers in-place (traced state).
+        # Skipped under program recording: build-time placeholder values
+        # must not corrupt the running buffers.
         batch_mean = jnp.mean(v, axis=reduce_axes)
         batch_var = jnp.var(v, axis=reduce_axes)
         if running_mean is not None:
@@ -29,22 +33,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                                    + (1.0 - momentum) * batch_mean)
             running_var._value = (momentum * unwrap(running_var)
                                   + (1.0 - momentum) * batch_var)
-        mean_c, var_c = None, None  # recomputed differentiably below
-    else:
-        mean_c, var_c = unwrap(running_mean), unwrap(running_var)
 
     bshape = [1] * v.ndim
     bshape[channel_axis % v.ndim] = v.shape[channel_axis % v.ndim]
+    has_stats = running_mean is not None
 
-    def _bn(val, *params):
-        it = iter(params)
-        w = next(it) if weight is not None else None
-        b = next(it) if bias is not None else None
-        if use_global_stats:
-            m, var = mean_c, var_c
-        else:
-            m = jnp.mean(val, axis=reduce_axes)
-            var = jnp.var(val, axis=reduce_axes)
+    def _normalize(val, m, var, w, b):
         inv = jnp.asarray(1.0, val.dtype) / jnp.sqrt(var + epsilon)
         out = (val - m.reshape(bshape)) * inv.reshape(bshape)
         if w is not None:
@@ -53,7 +47,33 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             out = out + b.reshape(bshape)
         return out
 
-    params = tuple(p for p in (weight, bias) if p is not None)
+    def _split(params):
+        it = iter(params)
+        rm = next(it) if has_stats else None
+        rv = next(it) if has_stats else None
+        w = next(it) if weight is not None else None
+        b = next(it) if bias is not None else None
+        return rm, rv, w, b
+
+    def _bn(val, *params):
+        rm, rv, w, b = _split(params)
+        if use_global_stats:
+            m, var = rm, rv
+        else:
+            m = jnp.mean(val, axis=reduce_axes)
+            var = jnp.var(val, axis=reduce_axes)
+        return _normalize(val, m, var, w, b)
+
+    if has_stats:
+        def _bn_eval(val, *params):
+            # clone(for_test): always normalize with the running stats
+            rm, rv, w, b = _split(params)
+            return _normalize(val, rm, rv, w, b)
+
+        _bn._eval_fn = _bn_eval
+
+    params = tuple(p for p in (running_mean, running_var) if has_stats) + \
+        tuple(p for p in (weight, bias) if p is not None)
     return call_op(_bn, x, *params, op_name="batch_norm")
 
 

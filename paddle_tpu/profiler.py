@@ -74,14 +74,32 @@ class RecordEvent:
         self.__exit__()
 
 
+_device_annotate = [False]
+
+
 class _OpProfObserver:
-    """Installed into core.dispatch while profiling: one X event per op."""
+    """Host-side op timing; with device tracing active each op also enters a
+    jax.profiler.TraceAnnotation so the XPlane timeline carries framework op
+    names (the analog of the reference's CUPTI correlation-id links,
+    device_tracer.cc:57). Installed into core.dispatch while profiling:
+    one X event per op."""
 
     def begin(self, name):
-        return _now_ns()
+        ann = None
+        if _device_annotate[0]:
+            try:
+                import jax
+                ann = jax.profiler.TraceAnnotation(name)
+                ann.__enter__()
+            except Exception:
+                ann = None
+        return (_now_ns(), ann)
 
     def end(self, token, name, outputs):
-        _record(name, "op", token, _now_ns())
+        start, ann = token
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        _record(name, "op", start, _now_ns())
 
 
 def start_profiler(state="All", tracer_option="Default"):
@@ -186,12 +204,14 @@ class Profiler:
                 import jax
                 jax.profiler.start_trace(self.trace_dir)
                 self._jax_trace = True
+                _device_annotate[0] = True
             except Exception:
                 self._jax_trace = False
 
     def stop(self):
         if self._jax_trace:
             import jax
+            _device_annotate[0] = False
             jax.profiler.stop_trace()
             self._jax_trace = False
         stop_profiler()

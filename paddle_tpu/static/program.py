@@ -23,14 +23,20 @@ from ..core.tensor import Parameter, Tensor
 
 
 class _OpRecord:
-    __slots__ = ("fn", "arg_slots", "kwarg_slots", "out_slots", "name")
+    __slots__ = ("fn", "arg_slots", "kwarg_slots", "out_slots", "name",
+                 "eval_fn")
 
-    def __init__(self, fn, arg_slots, kwarg_slots, out_slots, name):
+    def __init__(self, fn, arg_slots, kwarg_slots, out_slots, name,
+                 eval_fn=None):
         self.fn = fn
         self.arg_slots = arg_slots
         self.kwarg_slots = kwarg_slots
         self.out_slots = out_slots
         self.name = name
+        # mode-dependent ops (dropout, batch_norm) attach fn._eval_fn; a
+        # clone(for_test=True) swaps to it (the reference flips op attrs
+        # like is_test on the cloned desc, framework.py Program.clone)
+        self.eval_fn = eval_fn
 
 
 class _Slot:
@@ -122,7 +128,8 @@ class Program:
             out_slots.append(self._slot_of(t))
             out_tensors.append(t)
         self._produced.update(out_slots)
-        self.ops.append(_OpRecord(fn, arg_slots, kw_slots, out_slots, op_name))
+        self.ops.append(_OpRecord(fn, arg_slots, kw_slots, out_slots, op_name,
+                                  eval_fn=getattr(fn, "_eval_fn", None)))
         if len(out_tensors) == 1:
             return out_tensors[0]
         return tuple(out_tensors)
@@ -169,7 +176,23 @@ class Program:
         return self
 
     def clone(self, for_test=False):
-        return self
+        """reference: framework.py Program.clone:4017-area — for_test=True
+        flips mode-dependent ops (dropout→identity, batch_norm→running
+        stats) and drops the optimizer; shares slots/params with self."""
+        if not for_test:
+            return self
+        p = Program()
+        p.ops = [_OpRecord(op.eval_fn or op.fn, op.arg_slots, op.kwarg_slots,
+                           op.out_slots, op.name)
+                 for op in self.ops]
+        p._tensor_slot = self._tensor_slot
+        p._slot_count = self._slot_count
+        p._keepalive = self._keepalive
+        p.feed_vars = self.feed_vars
+        p.params = self.params
+        p._produced = self._produced
+        p.random_seed = self.random_seed
+        return p
 
     # vars exposed for program-inspection tests (meta-optimizer test analog)
     def op_names(self):
@@ -279,6 +302,35 @@ class Executor:
                                     for v in fetched):
             return [np.asarray(v) for v in fetched]
         return [Tensor(v) for v in fetched]
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Drive the program over a fleet Dataset's batches (reference:
+        `executor.py:1802` train_from_dataset → trainer/DeviceWorker
+        threads pulling from DataFeed channels; here the compiled program
+        consumes host-parsed batches directly)."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        prog = program or default_main_program()
+        last = None
+        for i, feed in enumerate(dataset.batches()):
+            out = self.run(prog, feed=feed, fetch_list=fetch_list or [])
+            if fetch_list:
+                last = out
+                if debug and i % print_period == 0:
+                    names = fetch_info or [f"fetch_{j}"
+                                           for j in range(len(out))]
+                    print(" ".join(f"{n}={np.asarray(v).mean():.6f}"
+                                   for n, v in zip(names, out)))
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, **kwargs):
+        """reference: executor.py infer_from_dataset — same loop, eval
+        clone."""
+        prog = (program or default_main_program()).clone(for_test=True)
+        return self.train_from_dataset(program=prog, dataset=dataset,
+                                       **kwargs)
 
     @staticmethod
     def _opt_tensors(opt):

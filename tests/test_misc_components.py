@@ -1,0 +1,224 @@
+"""Round-2 gap components: Program.clone(for_test), fleet Dataset ingestion
++ train_from_dataset, enforce errors, op version registry, custom C++ op ABI.
+
+References: framework.py Program.clone, data_set.h:43/executor.py:1802,
+platform/enforce.h, framework/op_version_registry.cc,
+framework/custom_operator.cc:511.
+"""
+import os
+import subprocess
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+class TestCloneForTest:
+    def test_dropout_switches_off(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            out = nn.functional.dropout(x, p=0.5, training=True)
+        exe = static.Executor()
+        feed = np.ones((4, 8), np.float32)
+        (train_out,) = exe.run(prog, feed={"x": feed}, fetch_list=[out])
+        assert (np.asarray(train_out) == 0).any()  # some dropped
+
+        eval_prog = prog.clone(for_test=True)
+        (eval_out,) = exe.run(eval_prog, feed={"x": feed}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(eval_out), feed)  # identity
+
+    def test_batch_norm_uses_running_stats(self):
+        paddle.seed(0)
+        bn = nn.BatchNorm1D(4)
+        # give running stats distinctive values
+        bn._mean.set_value(np.full(4, 2.0, np.float32))
+        bn._variance.set_value(np.full(4, 4.0, np.float32))
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [8, 4], "float32")
+            out = bn(x)
+        exe = static.Executor()
+        feed = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+
+        eval_prog = prog.clone(for_test=True)
+        (eval_out,) = exe.run(eval_prog, feed={"x": feed}, fetch_list=[out])
+        want = (feed - 2.0) / np.sqrt(4.0 + bn._epsilon)
+        want = want * bn.weight.numpy() + bn.bias.numpy()
+        np.testing.assert_allclose(np.asarray(eval_out), want, rtol=1e-4,
+                                   atol=1e-5)
+        # train-mode program instead normalizes by batch stats
+        (train_out,) = exe.run(prog, feed={"x": feed}, fetch_list=[out])
+        assert not np.allclose(np.asarray(train_out), want, atol=1e-3)
+
+
+class TestFleetDataset:
+    def _write_files(self, tmp_path, n_files=2, rows=6):
+        paths = []
+        rng = np.random.RandomState(0)
+        for i in range(n_files):
+            p = tmp_path / f"part-{i}.txt"
+            lines = []
+            for r in range(rows):
+                feat = " ".join(f"{v:.4f}" for v in rng.rand(4))
+                label = f"{rng.randint(0, 2)}"
+                lines.append(f"{feat}\t{label}")
+            p.write_text("\n".join(lines) + "\n")
+            paths.append(str(p))
+        return paths
+
+    def test_load_shuffle_batches(self, tmp_path):
+        from paddle_tpu.distributed.fleet import InMemoryDataset
+        ds = InMemoryDataset()
+        ds.init(batch_size=4, use_var=["feat", "label"])
+        ds.set_filelist(self._write_files(tmp_path))
+        n = ds.load_into_memory()
+        assert n == 12 == ds.get_memory_data_size()
+        before = [s[0].tolist() for s in ds._samples]
+        ds.local_shuffle(seed=3)
+        after = [s[0].tolist() for s in ds._samples]
+        assert sorted(map(tuple, before)) == sorted(map(tuple, after))
+        assert before != after
+        batches = list(ds.batches())
+        assert len(batches) == 3
+        assert batches[0]["feat"].shape == (4, 4)
+        ds.global_shuffle()  # single-process: local shuffle path
+        assert ds.get_shuffle_data_size() == 12
+
+    def test_train_from_dataset(self, tmp_path):
+        from paddle_tpu.distributed.fleet import InMemoryDataset
+        ds = InMemoryDataset()
+        ds.init(batch_size=3, use_var=["feat", "label"])
+        ds.set_filelist(self._write_files(tmp_path, n_files=1, rows=9))
+        ds.load_into_memory()
+
+        paddle.seed(1)
+        prog = static.Program()
+        with static.program_guard(prog):
+            feat = static.data("feat", [None, 4], "float32")
+            label = static.data("label", [None, 1], "float32")
+            w = static.create_parameter([4, 1], "float32")
+            pred = paddle.matmul(feat, w)
+            loss = nn.functional.mse_loss(pred, label)
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        w0 = w.numpy().copy()
+        out = exe.run(prog, feed={
+            "feat": np.zeros((3, 4), np.float32),
+            "label": np.zeros((3, 1), np.float32)}, fetch_list=[loss])
+        exe.train_from_dataset(prog, ds, fetch_list=[loss])
+        assert not np.allclose(w.numpy(), w0)  # trained over the files
+
+    def test_queue_dataset_streams(self, tmp_path):
+        from paddle_tpu.distributed.fleet import QueueDataset
+        ds = QueueDataset()
+        ds.init(batch_size=4, use_var=["feat", "label"])
+        ds.set_filelist(self._write_files(tmp_path))
+        with pytest.raises(RuntimeError):
+            ds.load_into_memory()
+        assert len(list(ds.batches())) == 3
+
+
+class TestEnforce:
+    def test_categories_and_callsite(self):
+        from paddle_tpu.core import enforce as E
+        with pytest.raises(E.InvalidArgumentError, match="INVALID_ARGUMENT"):
+            E.enforce(False, "bad arg")
+        with pytest.raises(E.OutOfRangeError):
+            E.enforce_lt(5, 3, "index check", E.OutOfRangeError)
+        try:
+            E.enforce_eq(1, 2, "mismatch")
+        except E.InvalidArgumentError as e:
+            assert "lhs=1" in str(e) and "rhs=2" in str(e)
+            assert "test_misc_components.py" in str(e)
+        assert E.enforce_not_none(42) == 42
+        with pytest.raises(E.NotFoundError):
+            E.enforce_not_none(None, "missing thing")
+
+
+class TestOpVersion:
+    def test_registry_and_compat(self):
+        from paddle_tpu.core import op_version as V
+        assert V.get_op_version("cross_entropy") >= 1
+        snap = V.snapshot()
+        V.check_compatible(snap)  # self-compatible
+        with pytest.raises(V.OpVersionError, match="newer op definitions"):
+            V.check_compatible({"cross_entropy": 999})
+        with pytest.raises(V.OpVersionError):
+            V.register_op_version("cross_entropy", 0)
+
+    def test_saved_artifact_carries_versions(self, tmp_path):
+        from paddle_tpu.jit.io import save as jit_save
+        from paddle_tpu.jit.export import ServedProgram
+        from paddle_tpu.jit.to_static import InputSpec
+        m = nn.Sequential(nn.Linear(4, 2))
+        m.eval()
+        prefix = str(tmp_path / "m")
+        jit_save(m, prefix, input_spec=[InputSpec([None, 4], "float32")])
+        sp = ServedProgram(prefix)
+        assert sp.meta["op_versions"].get("cross_entropy", 0) >= 1
+
+
+CUSTOM_OP_SRC = r"""
+#include <cstdint>
+extern "C" {
+// y = x^2 + 1
+void sq1_forward(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i] + 1.0f;
+}
+void sq1_backward(const float* x, const float* gy, float* gx, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) gx[i] = 2.0f * x[i] * gy[i];
+}
+// no backward exported for this one
+void plain_forward(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = x[i] + 3.0f;
+}
+}
+"""
+
+
+class TestCustomOpABI:
+    @pytest.fixture(scope="class")
+    def so_path(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("customop")
+        src = d / "my_op.cc"
+        src.write_text(CUSTOM_OP_SRC)
+        so = d / "my_op.so"
+        subprocess.run(["g++", "-O2", "-fPIC", "-shared", str(src),
+                        "-o", str(so)], check=True)
+        return str(so)
+
+    def test_forward_and_grad(self, so_path):
+        op = paddle.incubate.load_custom_op(so_path, "sq1")
+        assert op.has_backward
+        x = paddle.to_tensor(np.array([1.0, 2.0, -3.0], np.float32))
+        x.stop_gradient = False
+        y = op(x)
+        np.testing.assert_allclose(y.numpy(), [2.0, 5.0, 10.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, -6.0])
+
+    def test_under_to_static(self, so_path):
+        op = paddle.incubate.load_custom_op(so_path, "sq1")
+
+        @paddle.jit.to_static
+        def f(v):
+            return op(v).sum()
+
+        out = f(paddle.to_tensor(np.array([2.0, 3.0], np.float32)))
+        np.testing.assert_allclose(float(out.numpy()), 5.0 + 10.0)
+
+    def test_missing_symbols(self, so_path):
+        from paddle_tpu.core.enforce import NotFoundError
+        op = paddle.incubate.load_custom_op(so_path, "plain")
+        assert not op.has_backward
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(op(x).numpy(), [4.0])
+        with pytest.raises(NotFoundError):
+            paddle.incubate.load_custom_op(so_path, "nonexistent")

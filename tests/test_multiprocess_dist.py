@@ -125,11 +125,16 @@ def _elastic_worker(root, endpoint, die):
     mgr.register()
     assert mgr.wait_ready(timeout=60)
     r = mgr.rank()
+    deadline = time.time() + 60
     if die:
+        # rendezvous: don't leave before the survivor has seen us, or the
+        # membership change races the survivor's wait_ready
+        while time.time() < deadline and mgr.store.get("survivor_saw") is None:
+            time.sleep(0.1)
         mgr.exit()  # leaves the membership; lease is gone
         return r
+    mgr.store.put("survivor_saw", "1")
     # survivor: wait for the peer to drop out, then re-rank
-    deadline = time.time() + 60
     while time.time() < deadline and len(mgr.live_nodes()) > 1:
         time.sleep(0.2)
     out = (r, mgr.rank(), len(mgr.live_nodes()))
