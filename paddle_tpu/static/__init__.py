@@ -12,6 +12,7 @@ NOT rebuilt — see SURVEY.md §7 design stance.)
 from .program import (  # noqa: F401
     Program, program_guard, default_main_program, default_startup_program,
     data, Executor, global_scope, name_scope,
+    append_backward, gradients, Block, Operator,
 )
 from ..jit.to_static import InputSpec  # noqa: F401
 from .. import nn as _nn  # re-export for paddle.static.nn style usage

@@ -173,7 +173,17 @@ class Program:
         return _ProgLayer()
 
     def global_block(self):
-        return self
+        """Single-block view (reference: Program.global_block → Block:2522;
+        control flow lowers to single-op lax constructs here, so there is
+        exactly one block)."""
+        return Block(self)
+
+    @property
+    def blocks(self):
+        return [Block(self)]
+
+    def num_blocks(self):
+        return 1
 
     def clone(self, for_test=False):
         """reference: framework.py Program.clone:4017-area — for_test=True
@@ -269,9 +279,23 @@ class Executor:
         feed_names = sorted(feed.keys())
         feed_slots = [prog.feed_vars[n][0] for n in feed_names]
         feed_vals = [_feed_val(feed[n]) for n in feed_names]
-        fetch_slots = [prog._slot_of(v, create=False) for v in fetch_list]
+        grad_fetches = [(i, v) for i, v in enumerate(fetch_list)
+                        if isinstance(v, _GradVar)]
+        norm_fetches = [(i, v) for i, v in enumerate(fetch_list)
+                        if not isinstance(v, _GradVar)]
+        fetch_slots = [prog._slot_of(v, create=False)
+                       for _, v in norm_fetches]
         param_slots = sorted(prog.params.keys())
         param_vals = [prog.params[s]._value for s in param_slots]
+
+        if grad_fetches:
+            outs = self._run_with_grads(prog, feed_slots, feed_vals,
+                                        param_slots, param_vals,
+                                        fetch_slots, grad_fetches,
+                                        norm_fetches, len(fetch_list))
+            if return_numpy:
+                return [np.asarray(v) for v in outs]
+            return [Tensor(v) for v in outs]
 
         opt = prog._optimizer
         key = ("train" if opt else "infer",
@@ -302,6 +326,66 @@ class Executor:
                                     for v in fetched):
             return [np.asarray(v) for v in fetched]
         return [Tensor(v) for v in fetched]
+
+    def _run_with_grads(self, prog, feed_slots, feed_vals, param_slots,
+                        param_vals, fetch_slots, grad_fetches, norm_fetches,
+                        n_total):
+        """Fetch-list contains X@GRAD handles: compile
+        value_and_grad(replay-to-target) wrt the sources (reference:
+        fetching append_backward/gradients vars from exe.run)."""
+        from ..core.enforce import (InvalidArgumentError,
+                                    UnimplementedError, enforce)
+        tslots = {prog._slot_of(g.target, create=False)
+                  for _, g in grad_fetches}
+        enforce(len(tslots) == 1 and None not in tslots,
+                "all fetched @GRAD vars in one run must share the same "
+                "target recorded in this program; got target slots "
+                f"{sorted(tslots, key=str)}", InvalidArgumentError)
+        tslot = next(iter(tslots))
+        src_slots = [prog._slot_of(g.source, create=False)
+                     for _, g in grad_fetches]
+        pos_in_feed = {s: i for i, s in enumerate(feed_slots)}
+        pos_in_param = {s: i for i, s in enumerate(param_slots)}
+        for s in src_slots:
+            enforce(s in pos_in_feed or s in pos_in_param,
+                    "gradients() sources must be feed placeholders or "
+                    "parameters (intermediate-activation grads are not "
+                    "recorded in the op-list IR)", UnimplementedError)
+
+        def pure(fvals, pvals):
+            src0 = [fvals[pos_in_feed[s]] if s in pos_in_feed
+                    else pvals[pos_in_param[s]] for s in src_slots]
+
+            def loss_fn(src_vals):
+                env = {}
+                for s, v in zip(feed_slots, fvals):
+                    env[s] = v
+                for s, v in zip(param_slots, pvals):
+                    env[s] = v
+                for s, v in zip(src_slots, src_vals):
+                    env[s] = v
+                prog._replay(env)
+                tgt = jnp.sum(env[tslot])  # scalarize (reference sums)
+                return tgt, [env[s] for s in fetch_slots]
+
+            (_, normals), gs = jax.value_and_grad(
+                loss_fn, has_aux=True)(src0)
+            return normals, gs
+
+        key = ("grads", tuple(feed_slots),
+               tuple(np.shape(v) for v in feed_vals),
+               tuple(fetch_slots), tuple(src_slots), tslot)
+        compiled = prog._compiled.get(key)
+        if compiled is None:
+            compiled = jax.jit(pure)
+            prog._compiled[key] = compiled
+        normals, gs = compiled(feed_vals, param_vals)
+        out = [None] * n_total
+        for (i, _), v in zip(norm_fetches, normals):
+            out[i] = v
+        for (i, _), g in zip(grad_fetches, gs):
+            out[i] = g
+        return out
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -393,8 +477,94 @@ class Executor:
         return jax.jit(step)
 
 
+class Operator:
+    """Introspection view over one recorded op (reference: framework.py
+    Operator:1921)."""
+
+    def __init__(self, prog, rec, idx):
+        self._prog = prog
+        self._rec = rec
+        self.idx = idx
+
+    @property
+    def type(self):
+        return self._rec.name or "unknown"
+
+    def input_arg_names(self):
+        return [f"slot_{a.idx}" for a in self._rec.arg_slots
+                if isinstance(a, _Slot)] + \
+               [f"slot_{v.idx}" for v in self._rec.kwarg_slots.values()
+                if isinstance(v, _Slot)]
+
+    def output_arg_names(self):
+        return [f"slot_{s}" for s in self._rec.out_slots]
+
+    def __repr__(self):
+        return (f"Operator(type={self.type}, "
+                f"in={self.input_arg_names()}, "
+                f"out={self.output_arg_names()})")
+
+
+class Block:
+    """Introspection view (reference: framework.py Block:2522)."""
+
+    def __init__(self, prog):
+        self.program = prog
+        self.idx = 0
+
+    @property
+    def ops(self):
+        return [Operator(self.program, rec, i)
+                for i, rec in enumerate(self.program.ops)]
+
+    def var(self, name):
+        slot_dtype = self.program.feed_vars.get(name)
+        if slot_dtype is not None:
+            return self.program._keepalive[slot_dtype[0]] \
+                if slot_dtype[0] < len(self.program._keepalive) else None
+        for t in self.program.params.values():
+            if t.name == name:
+                return t
+        raise ValueError(f"block has no var {name!r}")
+
+    def all_parameters(self):
+        return [t for t in self.program.params.values()
+                if isinstance(t, Parameter)]
+
+
+class _GradVar:
+    """Fetchable d(target)/d(source) handle — the X@GRAD var that
+    append_backward/gradients create in the reference (backward.py:1377,
+    :1972). Pass it in Executor.run fetch_list; slots resolve against the
+    program being run."""
+
+    def __init__(self, source, target):
+        self.source = source
+        self.target = target
+        self.name = f"{source.name}@GRAD"
+
+    def __repr__(self):
+        return f"_GradVar({self.name})"
+
+
 def append_backward(loss, parameter_list=None, no_grad_set=None):
-    """Mark loss for the executor's fused value_and_grad pass."""
+    """Mark loss for the executor's fused value_and_grad pass and return
+    (param, param@GRAD) pairs (reference: backward.py append_backward:1377
+    returns params_and_grads)."""
     prog = default_main_program()
     prog._loss_slot = prog._slot_of(loss, create=False)
-    return []
+    params = parameter_list if parameter_list is not None else [
+        t for t in prog.params.values()
+        if isinstance(t, Parameter) and not t.stop_gradient]
+    skip = set(id(t) for t in (no_grad_set or ()))
+    return [(p, _GradVar(p, loss))
+            for p in params if id(p) not in skip]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(targets)/d(inputs) as fetchable vars (reference:
+    backward.py gradients:1972). `targets` must reduce to one scalar slot;
+    inputs must be feed placeholders or parameters."""
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return [_GradVar(v, t) for v in ins]

@@ -1,0 +1,165 @@
+"""Static-graph autodiff handles (append_backward/gradients → fetchable
+@GRAD vars), Block/Operator introspection, HDFS client (fake-hadoop shim),
+gated ONNX export.
+
+References: backward.py:1377/:1972, framework.py Block:2522/Operator:1921,
+fleet/utils/fs.py HDFSClient, python/paddle/onnx.
+"""
+import os
+import stat
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.static as static
+
+
+class TestStaticGradients:
+    def _build(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            w = static.create_parameter([4, 3], "float32")
+            out = paddle.matmul(x, w)
+            loss = paddle.mean(out * out)
+        return prog, x, w, out, loss
+
+    def test_gradients_wrt_param_and_feed(self):
+        prog, x, w, out, loss = self._build()
+        gw, gx = static.gradients(loss, [w, x])
+        assert gw.name == w.name + "@GRAD"
+        exe = static.Executor()
+        feed = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        loss_v, gw_v, gx_v = exe.run(prog, feed={"x": feed},
+                                     fetch_list=[loss, gw, gx])
+        # analytic: d mean((xw)^2) / dw = 2 x^T (xw) / numel
+        xw = feed @ w.numpy()
+        np.testing.assert_allclose(
+            np.asarray(gw_v), 2 * feed.T @ xw / xw.size, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(gx_v), 2 * xw @ w.numpy().T / xw.size, rtol=1e-5)
+
+    def test_append_backward_pairs(self):
+        prog, x, w, out, loss = self._build()
+        with static.program_guard(prog):
+            pairs = static.append_backward(loss)
+        assert len(pairs) == 1
+        p, g = pairs[0]
+        assert p is w and g.name == w.name + "@GRAD"
+        exe = static.Executor()
+        feed = np.ones((2, 4), np.float32)
+        (gv,) = exe.run(prog, feed={"x": feed}, fetch_list=[g])
+        assert np.abs(np.asarray(gv)).sum() > 0
+
+    def test_mixed_targets_rejected(self):
+        prog, x, w, out, loss = self._build()
+        with static.program_guard(prog):
+            g1 = static.gradients(loss, [w])[0]
+            g2 = static.gradients(out, [w])[0]
+        exe = static.Executor()
+        from paddle_tpu.core.enforce import InvalidArgumentError
+        with pytest.raises(InvalidArgumentError, match="same target"):
+            exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[g1, g2])
+
+
+class TestBlockOperator:
+    def test_introspection(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            w = static.create_parameter([4, 3], "float32")
+            y = paddle.matmul(x, w)
+            z = paddle.tanh(y)
+        block = prog.global_block()
+        assert block.idx == 0 and prog.num_blocks() == 1
+        types = [op.type for op in block.ops]
+        assert "matmul" in types and "tanh" in types
+        mm = block.ops[types.index("matmul")]
+        assert len(mm.input_arg_names()) == 2
+        assert len(mm.output_arg_names()) == 1
+        assert block.var(w.name) is w
+        assert w in block.all_parameters()
+        with pytest.raises(ValueError):
+            block.var("nope")
+
+
+FAKE_HADOOP = textwrap.dedent("""\
+    #!/bin/bash
+    # fake `hadoop fs` shim over a local root (for hermetic HDFSClient tests)
+    ROOT="$FAKE_HDFS_ROOT"
+    shift  # drop 'fs'
+    cmd="$1"; shift
+    case "$cmd" in
+      -test)
+        flag="$1"; p="$ROOT/$2"
+        if [ "$flag" = "-e" ]; then [ -e "$p" ]; exit $?; fi
+        if [ "$flag" = "-d" ]; then [ -d "$p" ]; exit $?; fi
+        exit 1;;
+      -mkdir) [ "$1" = "-p" ] && shift; mkdir -p "$ROOT/$1";;
+      -put) [ "$1" = "-f" ] && shift; cp "$1" "$ROOT/$2";;
+      -get) cp "$ROOT/$1" "$2";;
+      -rm) while [[ "$1" == -* ]]; do shift; done; rm -rf "$ROOT/$1";;
+      -mv) mv "$ROOT/$1" "$ROOT/$2";;
+      -ls)
+        p="$ROOT/$1"
+        for f in "$p"/*; do
+          [ -e "$f" ] || continue
+          if [ -d "$f" ]; then perm="drwxr-xr-x"; else perm="-rw-r--r--"; fi
+          echo "$perm 1 u g 0 2026-01-01 00:00 $1/$(basename "$f")"
+        done;;
+      *) echo "unknown $cmd" >&2; exit 2;;
+    esac
+""")
+
+
+class TestHDFSClient:
+    @pytest.fixture()
+    def client(self, tmp_path, monkeypatch):
+        from paddle_tpu.distributed.fleet.utils.fs import HDFSClient
+        home = tmp_path / "hadoop_home"
+        (home / "bin").mkdir(parents=True)
+        shim = home / "bin" / "hadoop"
+        shim.write_text(FAKE_HADOOP)
+        shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+        root = tmp_path / "hdfs_root"
+        root.mkdir()
+        monkeypatch.setenv("FAKE_HDFS_ROOT", str(root))
+        return HDFSClient(hadoop_home=str(home)), tmp_path
+
+    def test_roundtrip(self, client):
+        fs, tmp = client
+        assert not fs.is_exist("data")
+        fs.mkdirs("data/sub")
+        assert fs.is_exist("data") and fs.is_dir("data")
+        local = tmp / "f.txt"
+        local.write_text("hello hdfs")
+        fs.upload(str(local), "data/f.txt")
+        assert fs.is_file("data/f.txt")
+        dirs, files = fs.ls_dir("data")
+        assert dirs == ["sub"] and files == ["f.txt"]
+        back = tmp / "back.txt"
+        fs.download("data/f.txt", str(back))
+        assert back.read_text() == "hello hdfs"
+        fs.mv("data/f.txt", "data/g.txt")
+        assert fs.is_file("data/g.txt") and not fs.is_exist("data/f.txt")
+        fs.delete("data")
+        assert not fs.is_exist("data")
+
+    def test_missing_binary_message(self):
+        from paddle_tpu.distributed.fleet.utils.fs import HDFSClient
+        fs = HDFSClient(hadoop_home="/nonexistent")
+        with pytest.raises(RuntimeError, match="hadoop binary not found"):
+            fs.is_exist("/x")
+
+
+class TestOnnxGate:
+    def test_gated_export_points_to_stablehlo(self):
+        import paddle_tpu.onnx as ponnx
+        from paddle_tpu.core.enforce import UnavailableError
+        m = nn.Sequential(nn.Linear(4, 2))
+        with pytest.raises(UnavailableError, match="jit.save"):
+            ponnx.export(m, "/tmp/x")
