@@ -207,14 +207,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="resnet,gpt,allreduce")
     args = ap.parse_args()
+    failed = False
     for name in args.configs.split(","):
         try:
             print(json.dumps(BENCHES[name.strip()]()), flush=True)
         except Exception as e:
+            # keep running the rest of the ladder; report per-config errors
             print(json.dumps({"metric": name, "error": str(e)[:300]}),
                   flush=True)
-            return 1
-    return 0
+            failed = True
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

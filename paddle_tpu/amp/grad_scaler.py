@@ -49,12 +49,21 @@ class GradScaler:
         return multiply(var, Tensor(self._scale._value))
 
     def unscale_(self, optimizer):
+        from ..core.selected_rows import SelectedRows
         if not self._enable:
             return
         inv = 1.0 / self._scale._value
         found = jnp.zeros((), jnp.bool_)
         for p in optimizer._parameters():
-            if p._grad is not None:
+            if p._grad is None:
+                continue
+            if isinstance(p._grad, SelectedRows):
+                sr = p._grad
+                v = sr.values * inv.astype(sr.values.dtype)
+                found = found | ~jnp.all(jnp.isfinite(
+                    v.astype(jnp.float32)))
+                p._grad = SelectedRows(sr.rows, v, sr.height)
+            else:
                 g = p._grad * inv.astype(p._grad.dtype)
                 found = found | ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
                 p._grad = g

@@ -89,21 +89,41 @@ class _Context:
         self._timeout = timeout
 
     def join(self):
+        import queue as _queue_mod
+
         if self.results is not None:
             return True
         out = {}
+        died = None
         try:
             for _ in self._procs:
-                rank, status, payload = self._queue.get(timeout=self._timeout)
+                try:
+                    rank, status, payload = self._queue.get(
+                        timeout=self._timeout)
+                except _queue_mod.Empty:
+                    # a child died without reporting (segfault/OOM-kill in
+                    # native code): collect exit codes instead of raising a
+                    # bare Empty that hides everything we did learn
+                    died = [(i, p.exitcode)
+                            for i, p in enumerate(self._procs)
+                            if p.exitcode not in (0, None)]
+                    break
                 out[rank] = (rank, status, payload)
         finally:
             for p in self._procs:
                 p.join(self._timeout)
                 if p.is_alive():
                     p.terminate()
-        for rank in sorted(out):
-            _, status, payload = out[rank]
-            if status == "error":
-                raise RuntimeError(f"spawned rank {rank} failed:\n{payload}")
+        errors = [f"rank {r} failed:\n{payload}"
+                  for r, (_, status, payload) in sorted(out.items())
+                  if status == "error"]
+        if died is not None:
+            missing = sorted(set(range(len(self._procs))) - set(out))
+            errors.append(
+                f"rank(s) {missing} exited without reporting "
+                f"(exit codes: {died or 'unknown'}) — likely a native "
+                "crash or OOM kill")
+        if errors:
+            raise RuntimeError("spawn failed:\n" + "\n".join(errors))
         self.results = [out[r] for r in sorted(out)]
         return True

@@ -20,7 +20,16 @@ class ClipGradByValue(ClipGradBase):
         self.min = float(min) if min is not None else -self.max
 
     def _clip(self, params_grads):
-        return [(p, None if g is None else jnp.clip(g, self.min, self.max))
+        from ..core.selected_rows import SelectedRows
+
+        def one(g):
+            if isinstance(g, SelectedRows):
+                return SelectedRows(g.rows,
+                                    jnp.clip(g.values, self.min, self.max),
+                                    g.height)
+            return jnp.clip(g, self.min, self.max)
+
+        return [(p, None if g is None else one(g))
                 for p, g in params_grads]
 
 
@@ -34,10 +43,26 @@ class ClipGradByNorm(ClipGradBase):
             if g is None:
                 out.append((p, g))
                 continue
-            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            vals = _grad_values(g)
+            norm = jnp.sqrt(jnp.sum(jnp.square(vals)))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
-            out.append((p, g * scale))
+            out.append((p, _grad_scale(g, scale)))
         return out
+
+
+def _grad_values(g):
+    """Dense array behind a grad — SelectedRows contributes its row values
+    (equal to the dense norm: absent rows are zero)."""
+    from ..core.selected_rows import SelectedRows
+    return g.values if isinstance(g, SelectedRows) else g
+
+
+def _grad_scale(g, scale):
+    from ..core.selected_rows import SelectedRows
+    if isinstance(g, SelectedRows):
+        v = (g.values.astype(jnp.float32) * scale).astype(g.values.dtype)
+        return SelectedRows(g.rows, v, g.height)
+    return (g.astype(jnp.float32) * scale).astype(g.dtype)
 
 
 class ClipGradByGlobalNorm(ClipGradBase):
@@ -45,11 +70,11 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def _clip(self, params_grads):
-        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+        sq = [jnp.sum(jnp.square(_grad_values(g).astype(jnp.float32)))
               for _, g in params_grads if g is not None]
         if not sq:
             return params_grads
         global_norm = jnp.sqrt(sum(sq))
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        return [(p, None if g is None else (g.astype(jnp.float32) * scale).astype(g.dtype))
+        return [(p, None if g is None else _grad_scale(g, scale))
                 for p, g in params_grads]

@@ -23,9 +23,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     reduce_axes = tuple(i for i in range(v.ndim) if i != (channel_axis % v.ndim))
 
     if not use_global_stats and _STATIC_HOOK[0] is None:
-        # batch statistics; update running buffers in-place (traced state).
-        # Skipped under program recording: build-time placeholder values
-        # must not corrupt the running buffers.
+        # batch statistics; update running buffers in-place (traced state)
         batch_mean = jnp.mean(v, axis=reduce_axes)
         batch_var = jnp.var(v, axis=reduce_axes)
         if running_mean is not None:
@@ -33,6 +31,27 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                                    + (1.0 - momentum) * batch_mean)
             running_var._value = (momentum * unwrap(running_var)
                                   + (1.0 - momentum) * batch_var)
+    elif not use_global_stats and running_mean is not None:
+        # program recording: the stat update becomes a recorded op whose
+        # outputs the Executor writes back to the buffers after every run
+        # (the reference's in-place moving-average outputs of batch_norm_op)
+        from ...core.dispatch import call_op_nograd
+
+        def _stat_update(val, rm, rv):
+            bm = jnp.mean(val, axis=reduce_axes)
+            bv = jnp.var(val, axis=reduce_axes)
+            return (momentum * rm + (1.0 - momentum) * bm,
+                    momentum * rv + (1.0 - momentum) * bv)
+
+        new_m, new_v = call_op_nograd(_stat_update, x, running_mean,
+                                      running_var,
+                                      op_name="batch_norm_stat_update")
+        from ...static.program import default_main_program
+        prog = default_main_program()
+        prog._buffer_updates[prog._slot_of(running_mean, create=False)] = \
+            prog._slot_of(new_m, create=False)
+        prog._buffer_updates[prog._slot_of(running_var, create=False)] = \
+            prog._slot_of(new_v, create=False)
 
     bshape = [1] * v.ndim
     bshape[channel_axis % v.ndim] = v.shape[channel_axis % v.ndim]

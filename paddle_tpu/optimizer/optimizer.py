@@ -129,12 +129,14 @@ class Optimizer:
         from ..core.selected_rows import SelectedRows
         params_grads = [(p, p._grad) for p in self._parameters()
                         if not p.stop_gradient and p._grad is not None]
+        if self._grad_clip is not None:
+            # sparse grads participate: they contribute their row values to
+            # the global norm and get scaled as SelectedRows
+            params_grads = self._grad_clip(params_grads)
         dense = [(p, g) for p, g in params_grads
                  if not isinstance(g, SelectedRows)]
         sparse = [(p, g) for p, g in params_grads
                   if isinstance(g, SelectedRows)]
-        if self._grad_clip is not None:
-            dense = self._grad_clip([(p, g) for p, g in dense])
         self._step_count._value = self._step_count._value + 1
         lr = self._lr.value()
         for p, g in dense:
@@ -156,7 +158,11 @@ class Optimizer:
         accumulators) are untouched, which is lazy_mode semantics."""
         rows, vals = sr.rows, sr.values.astype(jnp.float32)
         valid = rows < sr.height
-        safe_rows = jnp.where(valid, rows, 0)
+        safe_rows = jnp.where(valid, rows, 0)  # gather side: clamped reads
+        # scatter side: invalid (merge_add padding) entries must be DROPPED,
+        # not redirected — a clamped index would overwrite row 0's real
+        # update with the stale gathered value
+        scatter_rows = jnp.where(valid, rows, sr.height)
 
         class _RowView:
             """Stands in for the param/accumulator during _apply_one."""
@@ -179,16 +185,13 @@ class Optimizer:
             self._accumulators[(k[0], id(view))] = row_acc
         try:
             new_rows = self._apply_one(view, vals, lr)
-            new_rows = jnp.where(valid[:, None], new_rows, gathered)
-            p._value = full.at[safe_rows].set(
-                new_rows.astype(full.dtype))
+            p._value = full.at[scatter_rows].set(
+                new_rows.astype(full.dtype), mode="drop")
             for k in acc_keys:
                 row_acc = self._accumulators.pop((k[0], id(view)))
                 acc = self._accumulators[k]
-                upd = jnp.where(valid[:, None] if row_acc._value.ndim > 1
-                                else valid, row_acc._value,
-                                saved[k][safe_rows])
-                acc._value = saved[k].at[safe_rows].set(upd)
+                acc._value = saved[k].at[scatter_rows].set(
+                    row_acc._value.astype(saved[k].dtype), mode="drop")
         finally:
             for k in list(self._accumulators):
                 if k[1] == id(view):

@@ -49,7 +49,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     """Serialize the program pruned to feed→fetch as a StableHLO artifact
     (reference: `fluid/io.py:1246` — prune + ProgramDesc + persistables)."""
     from ..jit.export import save_exported
-    prog = program or default_main_program()
+    prog = (program or default_main_program()).clone(for_test=True)
     layer = prog.as_layer(feed_vars, fetch_vars)
     specs = []
     for v in feed_vars:

@@ -55,6 +55,7 @@ class Program:
         self.feed_vars = {}  # name -> (slot, shape, dtype)
         self.params = {}  # slot -> Parameter
         self._produced = set()  # slots written by a recorded op
+        self._buffer_updates = {}  # buffer slot -> producing out slot
         self._optimizer = None
         self._loss_slot = None
         self._compiled = {}
@@ -192,9 +193,10 @@ class Program:
         if not for_test:
             return self
         p = Program()
+        # stat-update ops are train-only side outputs; eval drops them
         p.ops = [_OpRecord(op.eval_fn or op.fn, op.arg_slots, op.kwarg_slots,
                            op.out_slots, op.name)
-                 for op in self.ops]
+                 for op in self.ops if op.name != "batch_norm_stat_update"]
         p._tensor_slot = self._tensor_slot
         p._slot_count = self._slot_count
         p._keepalive = self._keepalive
@@ -297,16 +299,22 @@ class Executor:
                 return [np.asarray(v) for v in outs]
             return [Tensor(v) for v in outs]
 
+        # buffer write-backs (BN running stats): replayed outputs assigned
+        # to their buffers after every run, train or infer
+        buf_upd = sorted(prog._buffer_updates.items())
+        extra_slots = [o for _, o in buf_upd]
+        all_fetch = fetch_slots + extra_slots
+
         opt = prog._optimizer
         key = ("train" if opt else "infer",
                tuple(feed_names), tuple(v.shape for v in feed_vals),
-               tuple(str(v.dtype) for v in feed_vals), tuple(fetch_slots))
+               tuple(str(v.dtype) for v in feed_vals), tuple(all_fetch))
         compiled = prog._compiled.get(key)
         if compiled is None:
-            pure = prog._pure(feed_slots, fetch_slots, param_slots)
+            pure = prog._pure(feed_slots, all_fetch, param_slots)
             if opt is not None:
                 compiled = self._build_train_step(prog, pure, param_slots,
-                                                  fetch_slots)
+                                                  all_fetch)
             else:
                 compiled = jax.jit(lambda f, p: pure(f, p))
             prog._compiled[key] = compiled
@@ -322,6 +330,11 @@ class Executor:
                 t._value = v
         else:
             fetched = compiled(feed_vals, param_vals)
+        if extra_slots:
+            for (buf_slot, _), v in zip(buf_upd,
+                                        fetched[len(fetch_slots):]):
+                prog.params[buf_slot]._value = v
+            fetched = fetched[:len(fetch_slots)]
         if return_numpy and not any(isinstance(v, jax.core.Tracer)
                                     for v in fetched):
             return [np.asarray(v) for v in fetched]

@@ -32,6 +32,25 @@ class TestCloneForTest:
         (eval_out,) = exe.run(eval_prog, feed={"x": feed}, fetch_list=[out])
         np.testing.assert_allclose(np.asarray(eval_out), feed)  # identity
 
+    def test_static_training_updates_running_stats(self):
+        """Executor runs must move BN running stats (recorded stat-update
+        op + buffer write-back; caught by review: stats were frozen at
+        init under static training)."""
+        paddle.seed(0)
+        bn = nn.BatchNorm1D(4)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [16, 4], "float32")
+            bn.train()
+            out = bn(x)
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        for _ in range(10):
+            exe.run(prog, feed={"x": (rng.rand(16, 4) * 2 + 10)
+                                .astype(np.float32)}, fetch_list=[out])
+        mean = np.asarray(bn._mean.numpy())
+        assert np.all(mean > 1.0), mean  # moved toward the ~11 input mean
+
     def test_batch_norm_uses_running_stats(self):
         paddle.seed(0)
         bn = nn.BatchNorm1D(4)

@@ -9,6 +9,7 @@ import numpy as np
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
+import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 from paddle_tpu.core.selected_rows import SelectedRows
 from paddle_tpu.core.tensor import Tensor
@@ -197,6 +198,47 @@ class TestSelectedRows:
         m1 = np.asarray(opt._get_accumulator("moment1", w)._value)
         assert np.abs(m1[[0, 2, 3, 5]]).sum() == 0
         assert np.abs(m1[[1, 4]]).sum() > 0
+
+    def test_row0_with_duplicates_not_clobbered(self):
+        """merge_add's padding rows map to index 0 on the gather side; the
+        scatter must DROP them or row 0's update gets overwritten with its
+        stale value (caught by review; ids [0, 4, 4])."""
+        rng = np.random.RandomState(11)
+        wv = rng.rand(6, 3).astype(np.float32)
+        ids = np.array([0, 4, 4], np.int64)
+        results = {}
+        for sparse in (False, True):
+            w = Tensor(wv.copy(), stop_gradient=False)
+            opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+            out = F.embedding(Tensor(ids), w, sparse=sparse)
+            out.sum().backward()
+            opt.step()
+            results[sparse] = np.asarray(w._value)
+        np.testing.assert_allclose(results[True], results[False], rtol=1e-6)
+
+    def test_sparse_grads_respect_global_norm_clip(self):
+        """ClipGradByGlobalNorm must bound sparse updates too."""
+        w = Tensor(np.zeros((6, 3), np.float32), stop_gradient=False)
+        clip = nn.ClipGradByGlobalNorm(0.001)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w],
+                                   grad_clip=clip)
+        out = F.embedding(Tensor(np.array([1], np.int64)), w, sparse=True)
+        (out * 100.0).sum().backward()
+        opt.step()
+        assert np.abs(np.asarray(w._value)).max() <= 0.002
+
+    def test_sparse_grads_with_grad_scaler(self):
+        """AMP GradScaler.unscale_ must handle SelectedRows grads."""
+        w = Tensor(np.random.RandomState(12).rand(6, 3).astype(np.float32),
+                   stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        out = F.embedding(Tensor(np.array([2, 5], np.int64)), w, sparse=True)
+        loss = out.sum()
+        w0 = np.asarray(w._value).copy()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        assert not np.allclose(np.asarray(w._value), w0)
 
     def test_sparse_sgd_matches_dense_sgd(self):
         rng = np.random.RandomState(5)
