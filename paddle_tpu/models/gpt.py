@@ -148,6 +148,107 @@ def build_pipeline_layer(cfg, num_stages, loss_fn=None):
     return PipelineLayer(descs, num_stages=num_stages, loss_fn=loss_fn)
 
 
+def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
+    """Fused dp x pp 1F1B training step over the REAL model's parameters
+    (BASELINE.md config 4 — the reference's PipelineOptimizer + sharding
+    hybrid, as one XLA program via parallel.spmd_pipeline_1f1b).
+
+    The per-stage computation reuses GPTBlock.forward itself: block
+    parameters stack [pp, layers_per_stage, ...] (sharded over 'pp'), and a
+    template block re-runs with its values bound to the traced slices, so
+    the pipelined math IS the model's math. Embedding (wte+wpe) runs on
+    stage 0, final-LN + tied LM head + shifted CE on the last stage.
+
+    Returns (step, params) where step(ids [M,mb,T], labels [M,mb,T]) ->
+    (loss, (stage_grads, first_grads, last_grads)) and params is the
+    matching (stacked, first, last) value pytree. Tied wte grads =
+    first_grads[0] + last_grads[2].
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..core import autograd as _ag
+    from ..core.dispatch import bind_values, unwrap
+    from ..core.tensor import Tensor
+    from ..parallel import spmd_pipeline_1f1b
+
+    cfg = model.config
+    pp = mesh.shape[axis_pp]
+    L = cfg.num_layers
+    if L % pp != 0:
+        raise ValueError(f"num_layers {L} must divide by pp {pp}")
+    per = L // pp
+    template = model.gpt.blocks[0]
+    leaf_names = sorted(template.state_dict().keys())
+    leaf_tensors = [template.state_dict()[k] for k in leaf_names]
+
+    def _block_leaves(blk):
+        sd = blk.state_dict()
+        return [unwrap(sd[k]) for k in leaf_names]
+
+    stacked = tuple(
+        jnp.stack([jnp.stack([_block_leaves(model.gpt.blocks[s * per + i])[j]
+                              for i in range(per)]) for s in range(pp)])
+        for j in range(len(leaf_names)))
+    first_params = (unwrap(model.gpt.wte.weight), unwrap(model.gpt.wpe.weight))
+    last_params = (unwrap(model.gpt.ln_f.weight), unwrap(model.gpt.ln_f.bias),
+                   unwrap(model.gpt.wte.weight))  # tied head
+
+    def stage_fn(params, x):
+        def body(h, leaves):
+            with bind_values(leaf_tensors, list(leaves)), _ag.no_grad():
+                out = template(Tensor(h))
+            return unwrap(out), None
+        h, _ = lax.scan(body, x, params)
+        return h
+
+    def first_fn(fp, ids):
+        wte, wpe = fp
+        return wte[ids] + wpe[jnp.arange(ids.shape[-1])]
+
+    def last_fn(lp, h, labels):
+        gw, gb, tied = lp
+        m = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.var(h, axis=-1, keepdims=True)
+        norm = (h - m) / jnp.sqrt(var + 1e-5) * gw + gb
+        logits = norm @ tied.T
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        picked = jnp.take_along_axis(logp, labels[:, 1:, None].astype(
+            jnp.int32), axis=-1)
+        return -jnp.mean(picked)
+
+    def inner(sp, fp, lp, ids, labels):
+        loss, gP, gF, gL = spmd_pipeline_1f1b(
+            stage_fn, last_fn, sp, lp, ids, labels,
+            first_fn=first_fn, first_params=fp, axis_name=axis_pp)
+        if axis_dp is not None:
+            loss = jax.lax.pmean(loss, axis_dp)
+            gP = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, axis_dp), gP)
+            gF = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, axis_dp), gF)
+            gL = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, axis_dp), gL)
+        return loss, (gP, gF, gL)
+
+    batch_spec = P(None, axis_dp) if axis_dp is not None else P(None)
+    pp_tree = jax.tree_util.tree_map(lambda _: P(axis_pp), stacked)
+    rep = jax.tree_util.tree_map(lambda _: P(), first_params)
+    rep_l = jax.tree_util.tree_map(lambda _: P(), last_params)
+    step = jax.jit(jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(pp_tree, rep, rep_l, batch_spec, batch_spec),
+        out_specs=(P(), (pp_tree, rep, rep_l))))
+
+    def run(ids_micro, labels_micro):
+        return step(stacked, first_params, last_params, ids_micro,
+                    labels_micro)
+
+    return run, (stacked, first_params, last_params, leaf_names)
+
+
 def synthetic_lm_batch(batch_size, seq_len, vocab_size=50304, seed=0):
     rng = np.random.RandomState(seed)
     ids = rng.randint(0, vocab_size, (batch_size, seq_len)).astype("int32")
