@@ -15,6 +15,7 @@ from .program import (  # noqa: F401
     append_backward, gradients, Block, Operator,
 )
 from ..jit.to_static import InputSpec  # noqa: F401
+from .passes import apply_pass, register_pass, list_passes, prune  # noqa: F401
 from .. import nn as _nn  # re-export for paddle.static.nn style usage
 
 _STATIC_MODE = [False]
@@ -49,7 +50,9 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     """Serialize the program pruned to feed→fetch as a StableHLO artifact
     (reference: `fluid/io.py:1246` — prune + ProgramDesc + persistables)."""
     from ..jit.export import save_exported
+    from .passes import prune as _prune
     prog = (program or default_main_program()).clone(for_test=True)
+    prog = _prune(prog, fetch_vars)  # reference: prune.cc feed/fetch slice
     layer = prog.as_layer(feed_vars, fetch_vars)
     specs = []
     for v in feed_vars:

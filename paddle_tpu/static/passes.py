@@ -1,0 +1,106 @@
+"""Program rewrite passes — the retained pass layer.
+
+Reference: `paddle/fluid/framework/ir/` (`Pass` pass.h:43, ApplyImpl:136,
+and ~80 pass files). The fusion half of that layer (conv+bn, fc fusion,
+memory reuse…) is delegated to XLA by design (SURVEY §7 stance); what a
+TPU-native build retains is the PROGRAM-level rewrite layer — passes that
+change what the program computes, not how it schedules. `Program.clone
+(for_test)` and the fleet meta-optimizer wrappers are fixed members of that
+family; this module is the open registry for the rest.
+
+Also here: feed/fetch-driven pruning (reference: `framework/prune.cc`) —
+the backward slice used by save_inference_model.
+"""
+
+__all__ = ["register_pass", "apply_pass", "list_passes", "prune"]
+
+from .program import Program, _OpRecord, _Slot
+
+_PASS_REGISTRY = {}
+
+
+def register_pass(name):
+    """Decorator: fn(program) -> program (a NEW program; inputs shared)."""
+    def deco(fn):
+        _PASS_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def list_passes():
+    return sorted(_PASS_REGISTRY)
+
+
+def apply_pass(program, names):
+    """reference: ir::Pass::Apply / paddle.static.apply_build_strategy."""
+    if isinstance(names, str):
+        names = [names]
+    for n in names:
+        if n not in _PASS_REGISTRY:
+            raise KeyError(f"unknown pass {n!r}; known: {list_passes()}")
+        program = _PASS_REGISTRY[n](program)
+    return program
+
+
+def _shallow_clone(prog, ops):
+    p = Program()
+    p.ops = ops
+    p._tensor_slot = prog._tensor_slot
+    p._slot_count = prog._slot_count
+    p._keepalive = prog._keepalive
+    p.feed_vars = prog.feed_vars
+    p.params = prog.params
+    p._produced = prog._produced
+    p._buffer_updates = dict(prog._buffer_updates)
+    p.random_seed = prog.random_seed
+    return p
+
+
+@register_pass("delete_dropout_op_pass")
+def delete_dropout_op_pass(prog):
+    """reference: ir/delete_dropout_op_pass.cc — dropout → identity (its
+    recorded eval variant)."""
+    ops = [(_OpRecord(op.eval_fn, op.arg_slots, op.kwarg_slots, op.out_slots,
+                      op.name)
+            if op.name == "dropout" and op.eval_fn is not None else op)
+           for op in prog.ops]
+    return _shallow_clone(prog, ops)
+
+
+@register_pass("remove_stat_update_pass")
+def remove_stat_update_pass(prog):
+    """Drop BN running-stat side outputs (train-only bookkeeping)."""
+    p = _shallow_clone(prog, [op for op in prog.ops
+                              if op.name != "batch_norm_stat_update"])
+    p._buffer_updates = {}
+    return p
+
+
+def prune(prog, targets):
+    """Backward slice to the ops that contribute to `targets` (reference:
+    framework/prune.cc — feed/fetch-driven pruning used by
+    save_inference_model). Returns a new Program."""
+    needed = set()
+    for t in (targets if isinstance(targets, (list, tuple)) else [targets]):
+        s = prog._slot_of(t, create=False)
+        if s is None:
+            raise ValueError(f"target {getattr(t, 'name', t)!r} is not "
+                             "recorded in this program")
+        needed.add(s)
+    kept = []
+    for op in reversed(prog.ops):
+        if any(s in needed for s in op.out_slots):
+            kept.append(op)
+            for a in op.arg_slots:
+                if isinstance(a, _Slot):
+                    needed.add(a.idx)
+            for v in op.kwarg_slots.values():
+                if isinstance(v, _Slot):
+                    needed.add(v.idx)
+    kept.reverse()
+    p = _shallow_clone(prog, kept)
+    # buffer updates whose producing op was pruned are dropped
+    out_slots = {s for op in kept for s in op.out_slots}
+    p._buffer_updates = {b: o for b, o in p._buffer_updates.items()
+                         if o in out_slots}
+    return p

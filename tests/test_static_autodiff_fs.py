@@ -87,6 +87,53 @@ class TestBlockOperator:
             block.var("nope")
 
 
+class TestPasses:
+    def test_delete_dropout_pass(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            out = nn.functional.dropout(x, p=0.5, training=True)
+        rewritten = static.apply_pass(prog, "delete_dropout_op_pass")
+        exe = static.Executor()
+        feed = np.ones((4, 8), np.float32)
+        (r,) = exe.run(rewritten, feed={"x": feed}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(r), feed)
+        # original program untouched (still drops)
+        (r0,) = exe.run(prog, feed={"x": feed}, fetch_list=[out])
+        assert (np.asarray(r0) == 0).any()
+
+    def test_unknown_pass_raises(self):
+        import pytest as _pytest
+        with _pytest.raises(KeyError, match="unknown pass"):
+            static.apply_pass(static.Program(), "nope_pass")
+        assert "delete_dropout_op_pass" in static.list_passes()
+
+    def test_prune_backward_slice(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            a = paddle.tanh(x)          # contributes to fetched `b`
+            b = paddle.mean(a)
+            c = paddle.exp(x)           # dead branch for this fetch
+            d = paddle.sum(c)
+        pruned = static.prune(prog, [b])
+        kept = [op.name for op in pruned.ops]
+        assert "tanh" in kept and "mean" in kept
+        assert "exp" not in kept and "sum" not in kept
+        exe = static.Executor()
+        feed = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        (want,) = exe.run(prog, feed={"x": feed}, fetch_list=[b])
+        (got,) = exe.run(pruned, feed={"x": feed}, fetch_list=[b])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    def test_prune_unknown_target(self):
+        import pytest as _pytest
+        prog = static.Program()
+        stray = paddle.to_tensor(np.ones(2, np.float32))
+        with _pytest.raises(ValueError, match="not.*recorded"):
+            static.prune(prog, [stray])
+
+
 FAKE_HADOOP = textwrap.dedent("""\
     #!/bin/bash
     # fake `hadoop fs` shim over a local root (for hermetic HDFSClient tests)
