@@ -81,10 +81,11 @@ def bench_gpt_sharding_pp(n_virtual=8):
     structure) is what executes.
     """
     import jax
-    if jax.default_backend() == "cpu" and jax.device_count() < n_virtual:
+    if jax.device_count() < n_virtual:
         return {"metric": "gpt13b_hybrid_dryrun_step_ms", "value": -1.0,
-                "unit": "ms", "backend": "cpu",
-                "note": f"needs {n_virtual} devices: set "
+                "unit": "ms", "backend": jax.default_backend(),
+                "note": f"needs {n_virtual} devices (have "
+                        f"{jax.device_count()}); on CPU set "
                         f"XLA_FLAGS=--xla_force_host_platform_device_count="
                         f"{n_virtual}"}
     import paddle_tpu as paddle
@@ -102,7 +103,7 @@ def bench_gpt_sharding_pp(n_virtual=8):
         cfg = GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
                         vocab_size=50304, max_seq_len=1024,
                         hidden_dropout=0.0, attention_dropout=0.0)  # 1.3B
-        M, mb, T = 8, 1, 1024
+        M, mb, T = 8, dp, 1024  # per-microbatch dim must shard over dp
     else:
         # 1.3B structure (24 layers, 6/stage over pp=4), scaled dims for
         # the host-simulated dryrun

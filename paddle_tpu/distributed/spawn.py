@@ -101,12 +101,13 @@ class _Context:
                     rank, status, payload = self._queue.get(
                         timeout=self._timeout)
                 except _queue_mod.Empty:
-                    # a child died without reporting (segfault/OOM-kill in
-                    # native code): collect exit codes instead of raising a
-                    # bare Empty that hides everything we did learn
-                    died = [(i, p.exitcode)
-                            for i, p in enumerate(self._procs)
-                            if p.exitcode not in (0, None)]
+                    # a child failed to report in time: distinguish crashed
+                    # (non-zero exit), still-running (hang/deadlock), and
+                    # clean-exit-without-result, instead of raising a bare
+                    # Empty that hides everything we did learn
+                    died = [(i, ("alive/hung" if p.is_alive()
+                                 else f"exit {p.exitcode}"))
+                            for i, p in enumerate(self._procs)]
                     break
                 out[rank] = (rank, status, payload)
         finally:
@@ -119,10 +120,14 @@ class _Context:
                   if status == "error"]
         if died is not None:
             missing = sorted(set(range(len(self._procs))) - set(out))
+            states = {i: s for i, s in died}
+            detail = ", ".join(f"rank {i}: {states.get(i, 'unknown')}"
+                               for i in missing)
             errors.append(
-                f"rank(s) {missing} exited without reporting "
-                f"(exit codes: {died or 'unknown'}) — likely a native "
-                "crash or OOM kill")
+                f"rank(s) {missing} did not report within {self._timeout}s "
+                f"({detail}) — 'alive/hung' means a deadlock/slow step "
+                "(process was terminated); a non-zero exit suggests a "
+                "native crash or OOM kill")
         if errors:
             raise RuntimeError("spawn failed:\n" + "\n".join(errors))
         self.results = [out[r] for r in sorted(out)]

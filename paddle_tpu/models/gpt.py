@@ -175,6 +175,13 @@ def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
     from ..parallel import spmd_pipeline_1f1b
 
     cfg = model.config
+    if model.training and (cfg.hidden_dropout > 0
+                           or cfg.attention_dropout > 0):
+        raise ValueError(
+            "build_gpt_1f1b_step needs model.eval() or zero dropout: the "
+            "1F1B backward recomputes the forward, and a train-mode dropout "
+            "would draw a different mask in the recompute (silently wrong "
+            "gradients)")
     pp = mesh.shape[axis_pp]
     L = cfg.num_layers
     if L % pp != 0:
@@ -188,13 +195,21 @@ def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
         sd = blk.state_dict()
         return [unwrap(sd[k]) for k in leaf_names]
 
-    stacked = tuple(
-        jnp.stack([jnp.stack([_block_leaves(model.gpt.blocks[s * per + i])[j]
-                              for i in range(per)]) for s in range(pp)])
-        for j in range(len(leaf_names)))
-    first_params = (unwrap(model.gpt.wte.weight), unwrap(model.gpt.wpe.weight))
-    last_params = (unwrap(model.gpt.ln_f.weight), unwrap(model.gpt.ln_f.bias),
-                   unwrap(model.gpt.wte.weight))  # tied head
+    def snapshot_params():
+        """Re-read the model's CURRENT parameter values (call after each
+        optimizer update and pass the result to step — jnp arrays are
+        immutable, so the build-time snapshot never tracks the model)."""
+        stacked = tuple(
+            jnp.stack([jnp.stack(
+                [_block_leaves(model.gpt.blocks[s * per + i])[j]
+                 for i in range(per)]) for s in range(pp)])
+            for j in range(len(leaf_names)))
+        first = (unwrap(model.gpt.wte.weight), unwrap(model.gpt.wpe.weight))
+        last = (unwrap(model.gpt.ln_f.weight), unwrap(model.gpt.ln_f.bias),
+                unwrap(model.gpt.wte.weight))  # tied head
+        return stacked, first, last
+
+    stacked, first_params, last_params = snapshot_params()
 
     def stage_fn(params, x):
         def body(h, leaves):
@@ -208,16 +223,20 @@ def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
         wte, wpe = fp
         return wte[ids] + wpe[jnp.arange(ids.shape[-1])]
 
+    # the head/loss re-runs the model's own code (ln_f + tied matmul +
+    # GPTForCausalLM.loss) with values bound, so the pipelined path cannot
+    # drift from the eager semantics (epsilon, label shift, ...)
+    head_tensors = [model.gpt.ln_f.weight, model.gpt.ln_f.bias,
+                    model.gpt.wte.weight]
+
     def last_fn(lp, h, labels):
-        gw, gb, tied = lp
-        m = jnp.mean(h, axis=-1, keepdims=True)
-        var = jnp.var(h, axis=-1, keepdims=True)
-        norm = (h - m) / jnp.sqrt(var + 1e-5) * gw + gb
-        logits = norm @ tied.T
-        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-        picked = jnp.take_along_axis(logp, labels[:, 1:, None].astype(
-            jnp.int32), axis=-1)
-        return -jnp.mean(picked)
+        with bind_values(head_tensors, list(lp)), _ag.no_grad():
+            norm = model.gpt.ln_f(Tensor(h))
+            from .. import ops as _ops
+            logits = _ops.matmul(norm, model.gpt.wte.weight,
+                                 transpose_y=True)
+            loss = model.loss(logits, Tensor(labels))
+            return unwrap(loss)
 
     def inner(sp, fp, lp, ids, labels):
         loss, gP, gF, gL = spmd_pipeline_1f1b(
@@ -242,10 +261,15 @@ def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
         in_specs=(pp_tree, rep, rep_l, batch_spec, batch_spec),
         out_specs=(P(), (pp_tree, rep, rep_l))))
 
-    def run(ids_micro, labels_micro):
-        return step(stacked, first_params, last_params, ids_micro,
-                    labels_micro)
+    def run(ids_micro, labels_micro, params=None):
+        """params: (stacked, first, last) from run.snapshot_params(); the
+        build-time snapshot is used when omitted (fine for a single step or
+        eval, NOT for a training loop — snapshot after each update)."""
+        sp, fp, lp = params if params is not None else (
+            stacked, first_params, last_params)
+        return step(sp, fp, lp, ids_micro, labels_micro)
 
+    run.snapshot_params = snapshot_params
     return run, (stacked, first_params, last_params, leaf_names)
 
 

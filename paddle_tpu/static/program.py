@@ -291,6 +291,15 @@ class Executor:
         param_vals = [prog.params[s]._value for s in param_slots]
 
         if grad_fetches:
+            if prog._optimizer is not None:
+                from ..core.enforce import UnimplementedError
+                raise UnimplementedError(
+                    "fetching @GRAD vars from a program with an attached "
+                    "optimizer is not supported: the grad-fetch path would "
+                    "silently skip the fused train step. Run the training "
+                    "program without @GRAD fetches, or compute grads from a "
+                    "program that has no optimizer (append_backward/"
+                    "gradients + exe.run)")
             outs = self._run_with_grads(prog, feed_slots, feed_vals,
                                         param_slots, param_vals,
                                         fetch_slots, grad_fetches,
@@ -578,6 +587,16 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """d(targets)/d(inputs) as fetchable vars (reference:
     backward.py gradients:1972). `targets` must reduce to one scalar slot;
     inputs must be feed placeholders or parameters."""
+    from ..core.enforce import UnimplementedError
+    if target_gradients is not None:
+        raise UnimplementedError(
+            "gradients(target_gradients=...) (custom output cotangents) is "
+            "not supported; the executor seeds with ones over the summed "
+            "target")
+    if no_grad_set:
+        raise UnimplementedError(
+            "gradients(no_grad_set=...) is not supported; grads are taken "
+            "only w.r.t. the explicit `inputs`")
     t = targets[0] if isinstance(targets, (list, tuple)) else targets
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     return [_GradVar(v, t) for v in ins]

@@ -64,6 +64,35 @@ class TestGPT1F1BFlagship:
                                    np.asarray(m.gpt.wte.weight._grad),
                                    rtol=2e-3, atol=1e-5)
 
+    def test_params_snapshot_tracks_updates(self):
+        """step must see updated weights when given a fresh snapshot (the
+        build-time snapshot is immutable by design)."""
+        m = _model()
+        mesh = dist.make_mesh({"pp": 4})
+        step, _ = build_gpt_1f1b_step(m, mesh)
+        ids = _batches(2, 2, 8, m.config.vocab_size)
+        l0 = float(np.asarray(step(ids, ids)[0]))
+        # perturb a block weight, re-snapshot
+        blk = m.gpt.blocks[1]
+        blk.qkv.weight.set_value(np.asarray(blk.qkv.weight.numpy()) * 2.0)
+        l_stale = float(np.asarray(step(ids, ids)[0]))
+        l_fresh = float(np.asarray(
+            step(ids, ids, params=step.snapshot_params())[0]))
+        assert l_stale == l0  # stale snapshot: unchanged (documented)
+        assert l_fresh != l0  # fresh snapshot sees the update
+
+    def test_train_mode_dropout_rejected(self):
+        import pytest
+        paddle.seed(5)
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                        num_heads=2, max_seq_len=16, hidden_dropout=0.1,
+                        attention_dropout=0.0)
+        m = GPTForCausalLM(cfg)  # train mode, dropout>0
+        mesh = dist.make_mesh({"pp": 4})
+        with pytest.raises(ValueError, match="eval"):
+            build_gpt_1f1b_step(m, mesh)
+
     def test_hybrid_dp_pp(self):
         m = _model()
         mesh = dist.make_mesh({"dp": 2, "pp": 4})

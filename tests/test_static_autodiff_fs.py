@@ -54,6 +54,27 @@ class TestStaticGradients:
         (gv,) = exe.run(prog, feed={"x": feed}, fetch_list=[g])
         assert np.abs(np.asarray(gv)).sum() > 0
 
+    def test_grad_fetch_with_optimizer_rejected(self):
+        """@GRAD fetch must not silently skip the fused train step."""
+        from paddle_tpu.core.enforce import UnimplementedError
+        prog, x, w, out, loss = self._build()
+        with static.program_guard(prog):
+            pairs = static.append_backward(loss)
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        with pytest.raises(UnimplementedError, match="train step"):
+            exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss, pairs[0][1]])
+
+    def test_unsupported_gradients_args_raise(self):
+        from paddle_tpu.core.enforce import UnimplementedError
+        prog, x, w, out, loss = self._build()
+        with pytest.raises(UnimplementedError, match="cotangent"):
+            static.gradients(loss, [w], target_gradients=[out])
+        with pytest.raises(UnimplementedError, match="no_grad_set"):
+            static.gradients(loss, [w], no_grad_set=[x])
+
     def test_mixed_targets_rejected(self):
         prog, x, w, out, loss = self._build()
         with static.program_guard(prog):
