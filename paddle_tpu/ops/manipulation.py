@@ -18,7 +18,14 @@ def _shape_list(shape):
         return [int(s) for s in shape.numpy()]
     if isinstance(shape, (int, np.integer)):
         return [int(shape)]
-    return [int(s) for s in shape]
+
+    def _dim(s):
+        try:
+            return int(s)
+        except Exception:
+            return s  # symbolic dim (jax.export shape polymorphism)
+
+    return [_dim(s) for s in shape]
 
 
 def reshape(x, shape):

@@ -1,0 +1,55 @@
+"""hapi Model.fit composed with fleet data parallelism (reference:
+`python/paddle/tests/dist_hapi_mnist_dynamic.py` — the high-level API must
+train distributed, with loss parity against single-device fit).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed.fleet as fleet
+from paddle_tpu.distributed import parallel_env
+from paddle_tpu.io import TensorDataset
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 8).astype("float32")
+    y = rng.randint(0, 4, (32, 1)).astype("int64")
+    return x, y
+
+
+def _mlp():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _fit(distributed):
+    parallel_env.set_mesh(None)
+    x, y = _data()
+    net = _mlp()
+    if distributed:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        net = fleet.distributed_model(net)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    model.fit(ds, batch_size=8, epochs=2, verbose=0, shuffle=False)
+    out = model.evaluate(ds, batch_size=8, verbose=0)
+    parallel_env.set_mesh(None)
+    return out
+
+
+def test_fit_under_fleet_dp_matches_single():
+    single = _fit(distributed=False)
+    dist4 = _fit(distributed=True)
+    s = single.get("loss", single)
+    d = dist4.get("loss", dist4)
+    np.testing.assert_allclose(np.ravel(np.asarray(s, dtype=np.float64)),
+                               np.ravel(np.asarray(d, dtype=np.float64)),
+                               rtol=1e-4, atol=1e-5)

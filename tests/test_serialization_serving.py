@@ -116,6 +116,36 @@ class TestStableHLOArtifact:
         assert "SERVED_OK" in r.stdout
 
 
+class TestFlagshipServing:
+    def test_bert_tiny_artifact_roundtrip(self, tmp_path):
+        """Transformer with int inputs + symbolic batch through the
+        class-free artifact (the BASELINE config-3 model family served the
+        reference way: save_inference_model → AnalysisPredictor)."""
+        from paddle_tpu.models import BertConfig, BertModel
+        paddle.seed(1)
+        cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_position_embeddings=32, hidden_dropout=0.0,
+                         attention_dropout=0.0)
+        model = BertModel(cfg)
+        model.eval()
+        ids = np.random.RandomState(0).randint(0, 128, (2, 16)).astype("int32")
+        seq, pooled = model(Tensor(ids))
+        prefix = str(tmp_path / "bert")
+        jit_save(model, prefix,
+                 input_spec=[InputSpec([None, 16], "int32", name="ids")])
+        served = jit_load(prefix)
+        s2, p2 = served(Tensor(ids))
+        np.testing.assert_allclose(s2.numpy(), seq.numpy(), rtol=2e-2,
+                                   atol=1e-3)
+        np.testing.assert_allclose(p2.numpy(), pooled.numpy(), rtol=2e-2,
+                                   atol=1e-3)
+        # symbolic batch: other batch sizes serve from the same artifact
+        ids5 = np.random.RandomState(1).randint(0, 128, (5, 16)).astype("int32")
+        s5, p5 = served(Tensor(ids5))
+        assert list(s5.shape) == [5, 16, 32] and list(p5.shape) == [5, 32]
+
+
 class TestStaticSaveInferenceModel:
     def test_static_roundtrip(self, tmp_path):
         import paddle_tpu.static as static
