@@ -1,0 +1,168 @@
+"""OpTest sweep part 2: conv / norm / pool / shape nn-functionals with
+numpy references and grad checks (complements tests/test_op_sweep.py's
+elementwise/reduction/manipulation coverage).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_output, check_grad
+
+rng = np.random.RandomState(7)
+
+IMG = rng.rand(1, 2, 6, 6).astype("float32")
+SEQ = rng.rand(1, 2, 8).astype("float32")
+W2D = rng.rand(3, 2, 3, 3).astype("float32") * 0.5
+W1D = rng.rand(3, 2, 3).astype("float32") * 0.5
+WT2D = rng.rand(2, 3, 3, 3).astype("float32") * 0.5
+X24 = rng.rand(2, 4).astype("float32")
+X243 = rng.rand(2, 4, 3).astype("float32")
+
+
+def _conv2d_np(x, w, stride=1, pad=0):
+    n, ci, h, wd = x.shape
+    co, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, co, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+    return out
+
+
+def _ln_np(x, axis=-1, eps=1e-5):
+    m = x.mean(axis=axis, keepdims=True)
+    v = x.var(axis=axis, keepdims=True)
+    return (x - m) / np.sqrt(v + eps)
+
+
+class TestConv:
+    def test_conv2d_output(self):
+        check_output(lambda x, w: F.conv2d(x, w),
+                     lambda x, w: _conv2d_np(x, w), [IMG, W2D])
+
+    def test_conv2d_stride_pad(self):
+        check_output(lambda x, w: F.conv2d(x, w, stride=2, padding=1),
+                     lambda x, w: _conv2d_np(x, w, stride=2, pad=1),
+                     [IMG, W2D])
+
+    def test_conv2d_grads(self):
+        check_grad(lambda x, w: F.conv2d(x, w), [IMG, W2D], grad_index=0)
+        check_grad(lambda x, w: F.conv2d(x, w), [IMG, W2D], grad_index=1)
+
+    def test_conv1d_output(self):
+        def ref(x, w):
+            return _conv2d_np(x[:, :, None, :], w[:, :, None, :])[:, :, 0]
+        check_output(lambda x, w: F.conv1d(x, w), ref, [SEQ, W1D])
+
+    def test_conv2d_transpose_shape_and_grad(self):
+        out = F.conv2d_transpose(paddle.to_tensor(IMG),
+                                 paddle.to_tensor(WT2D), stride=2)
+        assert list(out.shape)[:2] == [1, 3]
+        check_grad(lambda x: F.conv2d_transpose(
+            x, paddle.to_tensor(WT2D), stride=2), [IMG])
+
+    def test_depthwise_groups(self):
+        wg = rng.rand(2, 1, 3, 3).astype("float32")
+        out = F.conv2d(paddle.to_tensor(IMG), paddle.to_tensor(wg), groups=2)
+        want = np.stack([
+            _conv2d_np(IMG[:, i:i + 1], wg[i:i + 1])[:, 0]
+            for i in range(2)], axis=1)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestNorms:
+    def test_layer_norm(self):
+        check_output(lambda x: F.layer_norm(x, 3),
+                     lambda x: _ln_np(x), [X243])
+        check_grad(lambda x: F.layer_norm(x, 3), [X243])
+
+    def test_rms_norm(self):
+        def ref(x):
+            return x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5)
+        w = np.ones(3, np.float32)
+        check_output(lambda x: F.rms_norm(x, paddle.to_tensor(w)),
+                     lambda x: ref(x), [X243], atol=1e-4)
+
+    def test_batch_norm_eval(self):
+        m = np.array([0.2, 0.4], np.float32)
+        v = np.array([1.5, 2.0], np.float32)
+        def op(x):
+            return F.batch_norm(x, paddle.to_tensor(m.copy()),
+                                paddle.to_tensor(v.copy()), training=False)
+        def ref(x):
+            return (x - m[None, :, None, None]) / np.sqrt(
+                v[None, :, None, None] + 1e-5)
+        check_output(op, ref, [IMG])
+
+    def test_group_norm(self):
+        def ref(x):
+            g = x.reshape(1, 2, 1, 6, 6)  # 2 groups of 1 channel
+            return _ln_np(g.reshape(1, 2, -1)).reshape(x.shape)
+        check_output(lambda x: F.group_norm(x, num_groups=2),
+                     lambda x: ref(x), [IMG], atol=1e-4)
+
+    def test_instance_norm(self):
+        def ref(x):
+            return _ln_np(x.reshape(1, 2, -1)).reshape(x.shape)
+        check_output(F.instance_norm, ref, [IMG], atol=1e-4)
+
+    def test_normalize(self):
+        check_output(lambda x: F.normalize(x, axis=1),
+                     lambda x: x / np.maximum(
+                         np.linalg.norm(x, axis=1, keepdims=True), 1e-12),
+                     [X24])
+        check_grad(lambda x: F.normalize(x, axis=1), [X24])
+
+
+class TestPoolShape:
+    def test_adaptive_avg_pool2d(self):
+        check_output(lambda x: F.adaptive_avg_pool2d(x, 3),
+                     lambda x: x.reshape(1, 2, 3, 2, 3, 2).mean((3, 5)),
+                     [IMG])
+        check_grad(lambda x: F.adaptive_avg_pool2d(x, 3), [IMG])
+
+    def test_adaptive_max_pool2d(self):
+        check_output(lambda x: F.adaptive_max_pool2d(x, 3),
+                     lambda x: x.reshape(1, 2, 3, 2, 3, 2).max((3, 5)),
+                     [IMG])
+
+    def test_interpolate_nearest(self):
+        check_output(
+            lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+            lambda x: x.repeat(2, axis=2).repeat(2, axis=3), [IMG])
+
+    def test_pixel_shuffle(self):
+        x = rng.rand(1, 4, 3, 3).astype("float32")
+        out = F.pixel_shuffle(paddle.to_tensor(x), 2)
+        assert list(out.shape) == [1, 1, 6, 6]
+        # element check: output (0, 0, i*2+di, j*2+dj) = x[0, di*2+dj, i, j]
+        o = np.asarray(out.numpy())
+        for di in range(2):
+            for dj in range(2):
+                np.testing.assert_allclose(o[0, 0, di::2, dj::2],
+                                           x[0, di * 2 + dj])
+
+    def test_unfold(self):
+        x = rng.rand(1, 2, 4, 4).astype("float32")
+        out = F.unfold(paddle.to_tensor(x), kernel_sizes=2)
+        assert list(out.shape) == [1, 2 * 2 * 2, 9]
+
+    def test_cosine_similarity(self):
+        a = rng.rand(2, 4).astype("float32")
+        b = rng.rand(2, 4).astype("float32")
+        check_output(F.cosine_similarity,
+                     lambda x, y: (x * y).sum(-1)
+                     / (np.linalg.norm(x, axis=-1)
+                        * np.linalg.norm(y, axis=-1)), [a, b])
+
+    def test_label_smooth(self):
+        oh = np.eye(4, dtype="float32")[[0, 2]]
+        check_output(lambda x: F.label_smooth(x, epsilon=0.1),
+                     lambda x: x * 0.9 + 0.1 / 4, [oh])
