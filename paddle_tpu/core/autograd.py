@@ -66,15 +66,20 @@ class TapeNode:
     ``out_meta``: (shape, dtype) per output so missing cotangents can be zeros.
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "cotangents", "pending", "__weakref__")
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "cotangents",
+                 "pending", "pure_fn", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_meta, name=""):
+    def __init__(self, vjp_fn, inputs, out_meta, name="", pure_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs
         self.out_meta = out_meta
         self.name = name
         self.cotangents = None  # filled during backward
         self.pending = 0
+        # the pure forward closure (dispatch's `g`): create_graph re-derives
+        # the VJP from it as a differentiable function of the LIVE inputs
+        # (the recorded vjp_fn bakes primals in as constants)
+        self.pure_fn = pure_fn
 
     def seed(self, index, value):
         if self.cotangents is None:
@@ -163,13 +168,17 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     """`paddle.grad` analog (reference: imperative/partial_grad_engine.cc).
 
     Computes d(outputs)/d(inputs) without touching `.grad` on other leaves.
-    `create_graph` is not yet supported (tape closures are jax.vjp closures,
-    so a double-backward needs re-tracing; planned via jax.grad composition).
+    With `create_graph=True` the backward itself runs through the op
+    dispatch seam (each node's vjp closure is a pure function, so it is
+    itself an op), producing differentiable grads — double backward /
+    gradient-penalty training works (reference: partial_grad_engine's
+    create_graph path).
     """
     from .tensor import Tensor
 
     if create_graph:
-        raise NotImplementedError("create_graph=True not supported yet")
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  retain_graph, allow_unused)
     if retain_graph is None:
         retain_graph = True  # repeated paddle.grad calls over the same graph
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
@@ -243,6 +252,121 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
             results.append(None)
         else:
             results.append(Tensor(g, stop_gradient=True))
+    if isinstance(inputs, (list, tuple)):
+        return results
+    return results[0]
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, retain_graph,
+                       allow_unused):
+    """Differentiable backward: cotangents travel as Tensors, and every
+    node's vjp closure runs through call_op so the computed grads carry
+    their own tape (second and higher orders compose)."""
+    from .dispatch import call_op
+    from .tensor import Tensor
+
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outs)
+
+    # cotangent accumulation per (node, out_index) as Tensors
+    node_cots = {}  # id(node) -> [Tensor|None per output]
+    nodes = {}
+    roots = []
+    for o, g in zip(outs, grad_outputs):
+        n = o._tape_node
+        if n is None:
+            continue
+        seed = (Tensor(jnp.ones(o.shape, o.dtype), stop_gradient=True)
+                if g is None else
+                (g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))))
+        slot = node_cots.setdefault(id(n), [None] * len(n.out_meta))
+        nodes[id(n)] = n
+        cur = slot[o._tape_index]
+        slot[o._tape_index] = seed if cur is None else cur + seed
+        roots.append(n)
+
+    order = []
+    visited = set()
+    stack = [(r, False) for r in roots]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._tape_node is not None and id(t._tape_node) not in visited:
+                stack.append((t._tape_node, False))
+    order.reverse()
+
+    table = {id(t): None for t in ins}
+    wanted = {id(t): t for t in ins}
+
+    for n in order:
+        cots = node_cots.get(id(n))
+        if cots is None or all(c is None for c in cots):
+            continue
+        if n.vjp_fn is None:
+            raise RuntimeError(
+                "autograd graph has been freed; create_graph needs the "
+                "forward graph intact")
+        if n.pure_fn is None:
+            raise RuntimeError(
+                f"node {n.name!r} has no recorded forward closure; "
+                "create_graph needs nodes recorded by call_op")
+        # materialize missing output cotangents as zero Tensors
+        full = []
+        for c, (shape, dtype) in zip(cots, n.out_meta):
+            if c is None:
+                if jnp.issubdtype(dtype, jnp.inexact):
+                    c = Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+                else:
+                    c = np.zeros(shape, _jax_dtypes.float0)
+            full.append(c)
+        def regrad(*vals, _k=len(n.inputs), _fn=n.pure_fn):
+            # _k/_fn bound at definition: regrad is replayed by later
+            # grad levels, after the loop variables have moved on
+            import jax as _jax
+            primals, cs = vals[:_k], vals[_k:]
+            _, vjp_fn = _jax.vjp(_fn, *primals)
+            return vjp_fn(tuple(cs))
+
+        # differentiable wrt BOTH the original inputs and the cotangents:
+        # re-derive the VJP from the pure closure at the live input values
+        in_cots = call_op(regrad, *n.inputs, *full,
+                          op_name=f"grad_{n.name}")
+        in_cots = in_cots if isinstance(in_cots, tuple) else (in_cots,)
+        for t, cot in zip(n.inputs, in_cots):
+            if cot is None:
+                continue
+            if id(t) in wanted:
+                cur = table[id(t)]
+                table[id(t)] = cot if cur is None else cur + cot
+            child = t._tape_node
+            if child is not None:
+                slot = node_cots.setdefault(id(child),
+                                            [None] * len(child.out_meta))
+                cur = slot[t._tape_index]
+                slot[t._tape_index] = cot if cur is None else cur + cot
+        node_cots[id(n)] = None
+
+    results = []
+    for t in ins:
+        g = table[id(t)]
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; "
+                    "pass allow_unused=True to return None for it.")
+            results.append(None)
+        else:
+            g.stop_gradient = False  # differentiable output
+            results.append(g)
     if isinstance(inputs, (list, tuple)):
         return results
     return results[0]

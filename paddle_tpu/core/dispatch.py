@@ -240,7 +240,8 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
     outs, vjp_fn = jax.vjp(g, *diff_vals)
     out_meta = [(jnp.shape(o), o.dtype) for o in outs]
     node = autograd.TapeNode(vjp_fn, list(diff_tensors), out_meta,
-                             name=op_name or getattr(fn, "__name__", "op"))
+                             name=op_name or getattr(fn, "__name__", "op"),
+                             pure_fn=g)
 
     tensors = []
     for i, o in enumerate(outs):

@@ -5,6 +5,7 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import ops
+from paddle_tpu.core.tensor import Tensor
 
 rng = np.random.RandomState(3)
 
@@ -128,3 +129,48 @@ def test_recompute():
     np.testing.assert_allclose(out.numpy(), out_ref.numpy(), rtol=1e-6)
     out.sum().backward()
     np.testing.assert_allclose(w.grad.numpy(), g_ref, rtol=1e-5)
+
+
+class TestCreateGraph:
+    """paddle.grad(create_graph=True): differentiable backward (reference:
+    imperative/partial_grad_engine.cc create_graph path)."""
+
+    def test_second_order(self):
+        x = Tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+        y = (x * x * x).sum()
+        (g,) = paddle.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), 3 * np.array([4.0, 9.0]))
+        assert not g.stop_gradient
+        (g2,) = paddle.grad(g.sum(), [x])
+        np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, 3.0]))
+
+    def test_gradient_penalty_backward(self):
+        """d/dw of ||dy/dx||^2 flows through .backward() into w.grad."""
+        w = Tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+        x = Tensor(np.array([3.0, 4.0], np.float32), stop_gradient=False)
+        y = (w * x * x).sum()
+        (gx,) = paddle.grad(y, [x], create_graph=True)  # 2 w x
+        (gx * gx).sum().backward()  # sum 4 w^2 x^2 -> d/dw = 8 w x^2
+        np.testing.assert_allclose(
+            w.grad.numpy(), 8 * np.array([1.0, 2.0]) * np.array([9.0, 16.0]))
+
+    def test_third_order(self):
+        x = Tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = (x * x * x * x).sum()  # x^4
+        (g1,) = paddle.grad(y, [x], create_graph=True)   # 4x^3
+        (g2,) = paddle.grad(g1.sum(), [x], create_graph=True)  # 12x^2
+        (g3,) = paddle.grad(g2.sum(), [x])               # 24x
+        np.testing.assert_allclose(g3.numpy(), [48.0])
+
+    def test_create_graph_through_layers(self):
+        paddle.seed(0)
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(3, 1)
+        x = Tensor(np.random.RandomState(0).rand(2, 3).astype(np.float32),
+                   stop_gradient=False)
+        y = paddle.tanh(lin(x)).sum()
+        (gx,) = paddle.grad(y, [x], create_graph=True)
+        penalty = (gx * gx).sum()
+        penalty.backward()
+        assert lin.weight._grad is not None
+        assert np.isfinite(np.asarray(lin.weight._grad)).all()
