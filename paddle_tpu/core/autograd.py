@@ -67,9 +67,10 @@ class TapeNode:
     """
 
     __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "cotangents",
-                 "pending", "pure_fn", "__weakref__")
+                 "pending", "pure_fn", "in_dtypes", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_meta, name="", pure_fn=None):
+    def __init__(self, vjp_fn, inputs, out_meta, name="", pure_fn=None,
+                 in_dtypes=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs
         self.out_meta = out_meta
@@ -78,8 +79,11 @@ class TapeNode:
         self.pending = 0
         # the pure forward closure (dispatch's `g`): create_graph re-derives
         # the VJP from it as a differentiable function of the LIVE inputs
-        # (the recorded vjp_fn bakes primals in as constants)
+        # (the recorded vjp_fn bakes primals in as constants). in_dtypes are
+        # the dtypes the op was TRACED with (post-AMP cast) so the replay
+        # matches even outside the original auto_cast scope.
         self.pure_fn = pure_fn
+        self.in_dtypes = in_dtypes
 
     def seed(self, index, value):
         if self.cotangents is None:
@@ -105,10 +109,13 @@ class TapeNode:
         return tuple(out)
 
 
-def _topo_order(root_node):
-    """Reverse topological order over the tape graph reachable from root."""
+def _topo_order(roots):
+    """Reverse topological order over the tape graph reachable from the
+    root node(s)."""
+    if not isinstance(roots, (list, tuple)):
+        roots = [roots]
     order, visited = [], set()
-    stack = [(root_node, False)]
+    stack = [(r, False) for r in roots]
     while stack:
         node, processed = stack.pop()
         if processed:
@@ -158,6 +165,7 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
         if not retain_graph:
             n.vjp_fn = None
             n.inputs = ()
+            n.pure_fn = None  # its closure holds the op's args alive
 
     if not retain_graph:
         tensor._tape_node = None
@@ -203,23 +211,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     table = {id(t): None for t in ins}
     wanted = {id(t): t for t in ins}
 
-    visited, order = set(), []
-    stack = [(r, False) for r in roots]
-    while stack:
-        node, processed = stack.pop()
-        if processed:
-            order.append(node)
-            continue
-        if id(node) in visited:
-            continue
-        visited.add(id(node))
-        stack.append((node, True))
-        for t in node.inputs:
-            if t._tape_node is not None and id(t._tape_node) not in visited:
-                stack.append((t._tape_node, False))
-    order.reverse()
-
-    for n in order:
+    for n in _topo_order(roots):
         if n.cotangents is None or all(c is None for c in n.cotangents):
             continue
         if n.vjp_fn is None:
@@ -239,6 +231,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
         if not retain_graph:
             n.vjp_fn = None
             n.inputs = ()
+            n.pure_fn = None
 
     results = []
     for t in ins:
@@ -270,9 +263,11 @@ def _grad_create_graph(outputs, inputs, grad_outputs, retain_graph,
     if grad_outputs is None:
         grad_outputs = [None] * len(outs)
 
+    if retain_graph is None:
+        retain_graph = True  # paddle default: retain when create_graph
+
     # cotangent accumulation per (node, out_index) as Tensors
     node_cots = {}  # id(node) -> [Tensor|None per output]
-    nodes = {}
     roots = []
     for o, g in zip(outs, grad_outputs):
         n = o._tape_node
@@ -282,28 +277,11 @@ def _grad_create_graph(outputs, inputs, grad_outputs, retain_graph,
                 if g is None else
                 (g if isinstance(g, Tensor) else Tensor(jnp.asarray(g))))
         slot = node_cots.setdefault(id(n), [None] * len(n.out_meta))
-        nodes[id(n)] = n
         cur = slot[o._tape_index]
         slot[o._tape_index] = seed if cur is None else cur + seed
         roots.append(n)
 
-    order = []
-    visited = set()
-    stack = [(r, False) for r in roots]
-    while stack:
-        node, processed = stack.pop()
-        if processed:
-            order.append(node)
-            continue
-        if id(node) in visited:
-            continue
-        visited.add(id(node))
-        stack.append((node, True))
-        for t in node.inputs:
-            if t._tape_node is not None and id(t._tape_node) not in visited:
-                stack.append((t._tape_node, False))
-    order.reverse()
-
+    order = _topo_order(roots)
     table = {id(t): None for t in ins}
     wanted = {id(t): t for t in ins}
 
@@ -328,13 +306,26 @@ def _grad_create_graph(outputs, inputs, grad_outputs, retain_graph,
                 else:
                     c = np.zeros(shape, _jax_dtypes.float0)
             full.append(c)
-        def regrad(*vals, _k=len(n.inputs), _fn=n.pure_fn):
-            # _k/_fn bound at definition: regrad is replayed by later
-            # grad levels, after the loop variables have moved on
+        def regrad(*vals, _k=len(n.inputs), _fn=n.pure_fn,
+                   _in_dt=tuple(n.in_dtypes or ()),
+                   _out_dt=tuple(d for _, d in n.out_meta)):
+            # _k/_fn/... bound at definition: regrad is replayed by later
+            # grad levels, after the loop variables have moved on. Primals
+            # and cotangents are cast to the dtypes the op was TRACED with
+            # (post-AMP), so the replay matches outside the original
+            # auto_cast scope; grads cast back to the live input dtypes.
             import jax as _jax
-            primals, cs = vals[:_k], vals[_k:]
+            primals, cs = list(vals[:_k]), list(vals[_k:])
+            orig_dt = [p.dtype for p in primals]
+            if _in_dt:
+                primals = [p.astype(d) for p, d in zip(primals, _in_dt)]
+            cs = [c.astype(d) if hasattr(c, "astype")
+                  and jnp.issubdtype(d, jnp.inexact) else c
+                  for c, d in zip(cs, _out_dt)]
             _, vjp_fn = _jax.vjp(_fn, *primals)
-            return vjp_fn(tuple(cs))
+            gs = vjp_fn(tuple(cs))
+            return tuple(g.astype(d) if hasattr(g, "astype") else g
+                         for g, d in zip(gs, orig_dt))
 
         # differentiable wrt BOTH the original inputs and the cotangents:
         # re-derive the VJP from the pure closure at the live input values
@@ -354,6 +345,12 @@ def _grad_create_graph(outputs, inputs, grad_outputs, retain_graph,
                 cur = slot[t._tape_index]
                 slot[t._tape_index] = cot if cur is None else cur + cot
         node_cots[id(n)] = None
+
+    if not retain_graph:
+        for n in order:  # the NEW grad graph survives; the old one frees
+            n.vjp_fn = None
+            n.inputs = ()
+            n.pure_fn = None
 
     results = []
     for t in ins:

@@ -241,7 +241,8 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
     out_meta = [(jnp.shape(o), o.dtype) for o in outs]
     node = autograd.TapeNode(vjp_fn, list(diff_tensors), out_meta,
                              name=op_name or getattr(fn, "__name__", "op"),
-                             pure_fn=g)
+                             pure_fn=g,
+                             in_dtypes=[v.dtype for v in diff_vals])
 
     tensors = []
     for i, o in enumerate(outs):

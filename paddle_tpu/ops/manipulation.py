@@ -23,7 +23,11 @@ def _shape_list(shape):
         try:
             return int(s)
         except Exception:
-            return s  # symbolic dim (jax.export shape polymorphism)
+            from jax import export as _jax_export
+            if _jax_export.is_symbolic_dim(s):
+                return s  # jax.export shape polymorphism
+            raise TypeError(
+                f"invalid dimension {s!r} in reshape target shape")
 
     return [_dim(s) for s in shape]
 

@@ -174,3 +174,40 @@ class TestCreateGraph:
         penalty.backward()
         assert lin.weight._grad is not None
         assert np.isfinite(np.asarray(lin.weight._grad)).all()
+
+    def test_create_graph_with_amp(self):
+        """AMP-recorded ops must replay with their traced dtypes outside
+        the auto_cast scope (caught by review)."""
+        x = Tensor(np.random.RandomState(0).rand(2, 3).astype(np.float32),
+                   stop_gradient=False)
+        w = Tensor(np.random.RandomState(1).rand(3, 2).astype(np.float32),
+                   stop_gradient=False)
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+            y = paddle.matmul(x, w).sum()
+        (gx,) = paddle.grad(y, [x], create_graph=True)
+        assert str(gx.dtype) == "float32"
+        (gw,) = paddle.grad((gx * gx).sum(), [w])
+        assert np.isfinite(np.asarray(gw.numpy())).all()
+
+    def test_backward_frees_pure_fn(self):
+        """retain_graph=False must drop the forward closure too, or every
+        activation stays alive through it (caught by review)."""
+        x = Tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        n = y._tape_node
+        y.backward()
+        assert n.vjp_fn is None and n.inputs == () and n.pure_fn is None
+
+    def test_create_graph_retain_false_frees(self):
+        """Explicit retain_graph=False frees the forward graph (memory
+        contract); re-walking it for a second-order pass then fails loudly
+        — which is why the default keeps it (retain_graph=create_graph,
+        reference semantics)."""
+        x = Tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        n = y._tape_node
+        (g,) = paddle.grad(y, [x], create_graph=True, retain_graph=False)
+        np.testing.assert_allclose(g.numpy(), [2.0, 2.0, 2.0])
+        assert n.pure_fn is None and n.vjp_fn is None
+        with pytest.raises(RuntimeError, match="freed"):
+            paddle.grad(g.sum(), [x])
