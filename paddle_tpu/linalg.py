@@ -1,0 +1,132 @@
+"""paddle.linalg — dense linear algebra.
+
+Reference: `python/paddle/tensor/linalg.py` + `paddle/fluid/operators/`
+(cholesky_op, matrix_inverse via solve, determinant_op, svd_op, eig/eigh,
+matrix_power_op, qr, triangular_solve, lstsq...). TPU lowering: jnp.linalg —
+XLA's native decompositions (grads included where jax defines them).
+"""
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import call_op, call_op_nograd
+from . import ops as _ops
+
+__all__ = [
+    "cholesky", "inv", "det", "slogdet", "svd", "eig", "eigh",
+    "eigvals", "eigvalsh", "solve", "triangular_solve", "lstsq",
+    "matrix_power", "pinv", "qr", "matrix_rank", "norm", "cond",
+    "multi_dot", "cholesky_solve",
+]
+
+norm = _ops.norm  # reference re-exports tensor norm here
+
+
+def cholesky(x, upper=False):
+    def _c(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return call_op(_c, x, op_name="cholesky")
+
+
+def inv(x):
+    return call_op(jnp.linalg.inv, x, op_name="inverse")
+
+
+def det(x):
+    return call_op(jnp.linalg.det, x, op_name="determinant")
+
+
+def slogdet(x):
+    def _s(v):
+        sign, logabs = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logabs])
+    return call_op(_s, x, op_name="slogdeterminant")
+
+
+def svd(x, full_matrices=False):
+    def _svd(v):
+        return tuple(jnp.linalg.svd(v, full_matrices=full_matrices))
+    return call_op(_svd, x, op_name="svd")
+
+
+def eigh(x, UPLO="L"):
+    def _e(v):
+        w, q = jnp.linalg.eigh(v, UPLO=UPLO)
+        return w, q
+    return call_op(_e, x, op_name="eigh")
+
+
+def eigvalsh(x, UPLO="L"):
+    return call_op(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x,
+                   op_name="eigvalsh")
+
+
+def eig(x):
+    # general eig is complex-valued; no reverse rule in jax — value only
+    def _e(v):
+        w, q = jnp.linalg.eig(v)
+        return w, q
+    return call_op_nograd(_e, x, op_name="eig")
+
+
+def eigvals(x):
+    return call_op_nograd(jnp.linalg.eigvals, x, op_name="eigvals")
+
+
+def solve(x, y):
+    return call_op(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    def _t(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return call_op(_t, x, y, op_name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False):
+    def _cs(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return call_op(_cs, x, y, op_name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    def _l(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+    sol, res, rank, sv = call_op_nograd(_l, x, y, op_name="lstsq")
+    return sol, res, rank, sv
+
+
+def matrix_power(x, n):
+    return call_op(lambda v: jnp.linalg.matrix_power(v, n), x,
+                   op_name="matrix_power")
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return call_op(lambda v: jnp.linalg.pinv(v, rtol=rcond,
+                                             hermitian=hermitian), x,
+                   op_name="pinv")
+
+
+def qr(x, mode="reduced"):
+    def _qr(v):
+        return tuple(jnp.linalg.qr(v, mode=mode))
+    return call_op(_qr, x, op_name="qr")
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return call_op_nograd(
+        lambda v: jnp.linalg.matrix_rank(v, rtol=tol), x,
+        op_name="matrix_rank")
+
+
+def cond(x, p=None):
+    return call_op_nograd(lambda v: jnp.linalg.cond(v, p=p), x,
+                          op_name="cond")
+
+
+def multi_dot(xs):
+    return call_op(lambda *vs: jnp.linalg.multi_dot(vs), *xs,
+                   op_name="multi_dot")

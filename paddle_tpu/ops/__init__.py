@@ -8,6 +8,7 @@ route through the same autograd dispatch.
 from . import manipulation, math, random  # noqa: F401
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .random import rand, randn, randint, randperm, normal, uniform, bernoulli, multinomial  # noqa: F401
 from . import sequence  # noqa: F401
 

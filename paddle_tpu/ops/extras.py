@@ -1,0 +1,246 @@
+"""Tensor-method library breadth: statistics/manipulation tail.
+
+Reference surface: `python/paddle/tensor/` (math.py/stat.py/search.py/
+manipulation.py entries not already in ops/math|manipulation) backed by
+`paddle/fluid/operators/` kernels. All lowered through the dispatch seam.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import call_op, call_op_nograd, unwrap
+from ..core.tensor import Tensor
+
+__all__ = [
+    "median", "kthvalue", "mode", "quantile", "nanmedian",
+    "histogram", "bincount", "unique_consecutive", "diff",
+    "trace", "kron", "outer", "cross", "diagonal", "rot90",
+    "searchsorted", "bucketize", "take", "lerp", "trunc", "frac",
+    "nanmean", "nansum", "deg2rad", "rad2deg", "gcd", "lcm", "heaviside",
+]
+
+
+def median(x, axis=None, keepdim=False):
+    """reference: operators/median (tensor/stat.py median)."""
+    return call_op(lambda v: jnp.median(v, axis=axis, keepdims=keepdim),
+                   x, op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return call_op(lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim),
+                   x, op_name="nanmedian")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    """reference: operators/kthvalue_op.cc — (values, indices) of the k-th
+    smallest along axis (1-based k)."""
+
+    def _vals(v):
+        s = jnp.sort(v, axis=axis)
+        out = jnp.take(s, k - 1, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    def _idx(v):
+        s = jnp.argsort(v, axis=axis)
+        out = jnp.take(s, k - 1, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return (call_op(_vals, x, op_name="kthvalue"),
+            call_op_nograd(_idx, x, op_name="kthvalue_index"))
+
+
+def mode(x, axis=-1, keepdim=False):
+    """reference: operators/mode_op.cc — most frequent value (+index)."""
+
+    def _mode(v):
+        sv = jnp.sort(v, axis=axis)
+        n = sv.shape[axis]
+        same = jnp.concatenate(
+            [jnp.ones_like(jnp.take(sv, jnp.array([0]), axis=axis),
+                           dtype=jnp.int32),
+             (jnp.diff(sv, axis=axis) == 0).astype(jnp.int32)], axis=axis)
+        # run lengths via cumulative reset: count consecutive equals
+        def scan_fn(carry, s):
+            run = jnp.where(s == 1, carry + 1, 1)
+            return run, run
+        moved = jnp.moveaxis(same, axis, 0)
+        _, runs = jax.lax.scan(scan_fn,
+                               jnp.zeros(moved.shape[1:], jnp.int32), moved)
+        runs = jnp.moveaxis(runs, 0, axis)
+        best = jnp.argmax(runs, axis=axis)
+        vals = jnp.take_along_axis(sv, jnp.expand_dims(best, axis),
+                                   axis=axis)
+        return vals if keepdim else jnp.squeeze(vals, axis)
+
+    vals = call_op_nograd(_mode, x, op_name="mode")
+
+    def _idx(v):
+        tgt = unwrap(vals) if not keepdim else jnp.squeeze(
+            unwrap(vals), axis)
+        eq = v == jnp.expand_dims(tgt, axis)
+        idx = jnp.argmax(eq, axis=axis)
+        return jnp.expand_dims(idx, axis) if keepdim else idx
+
+    return vals, call_op_nograd(_idx, x, op_name="mode_index")
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return call_op(lambda v: jnp.quantile(
+        v, jnp.asarray(q), axis=axis, keepdims=keepdim),
+        x, op_name="quantile")
+
+
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    """reference: operators/histogram_op.cc (min==max==0 → data range)."""
+
+    def _h(v):
+        lo, hi = (jnp.min(v), jnp.max(v)) if min == 0 and max == 0 \
+            else (jnp.asarray(min, v.dtype), jnp.asarray(max, v.dtype))
+        return jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi))[0]
+
+    return call_op_nograd(_h, x, op_name="histogram")
+
+
+def bincount(x, weights=None, minlength=0):
+    """reference: operators/bincount_op.cc."""
+    n = int(np.asarray(unwrap(x)).max()) + 1 if np.asarray(
+        unwrap(x)).size else 0
+    length = builtins_max(n, int(minlength))
+
+    def _b(v, *rest):
+        w = rest[0] if weights is not None else None
+        return jnp.bincount(v.reshape(-1), weights=w, length=length)
+
+    args = (x,) + ((weights,) if weights is not None else ())
+    return call_op_nograd(_b, *args, op_name="bincount")
+
+
+builtins_max = max
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """reference: operators/unique_consecutive_op.cc. Host-side: output
+    length is data-dependent."""
+    v = np.asarray(unwrap(x))
+    if axis is None:
+        v = v.reshape(-1)
+    keep = np.concatenate([[True], v[1:] != v[:-1]]) if v.size else \
+        np.zeros(0, bool)
+    out = Tensor(jnp.asarray(v[keep]))
+    res = (out,)
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        res += (Tensor(jnp.asarray(inv.astype(np.int64))),)
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, v.size))
+        res += (Tensor(jnp.asarray(counts.astype(np.int64))),)
+    return res if len(res) > 1 else out
+
+
+def diff(x, n=1, axis=-1):
+    return call_op(lambda v: jnp.diff(v, n=n, axis=axis), x, op_name="diff")
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return call_op(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                       axis2=axis2), x, op_name="trace")
+
+
+def kron(x, y):
+    return call_op(jnp.kron, x, y, op_name="kron")
+
+
+def outer(x, y):
+    return call_op(lambda a, b: jnp.outer(a, b), x, y, op_name="outer")
+
+
+def cross(x, y, axis=None):
+    ax = axis if axis is not None else -1
+    return call_op(lambda a, b: jnp.cross(a, b, axis=ax), x, y,
+                   op_name="cross")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return call_op(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                          axis2=axis2), x, op_name="diagonal")
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return call_op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x,
+                   op_name="rot90")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    """reference: operators/searchsorted_op.cc."""
+
+    def _s(seq, v):
+        out = jnp.searchsorted(seq, v, side="right" if right else "left")
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return call_op_nograd(_s, sorted_sequence, values,
+                          op_name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def take(x, index, mode="raise"):
+    """reference: tensor/math.py take — flat-index gather with wrap/clip."""
+
+    def _t(v, idx):
+        flat = v.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx2 = jnp.mod(idx, n)
+        else:  # raise-mode bounds checking is not expressible in XLA; clip
+            idx2 = jnp.clip(idx, -n, n - 1)
+        return flat[idx2.reshape(-1)].reshape(idx.shape)
+
+    return call_op(_t, x, unwrap(index), op_name="take")
+
+
+def lerp(x, y, weight):
+    return call_op(lambda a, b, w: a + w * (b - a), x, y,
+                   weight if isinstance(weight, Tensor) else
+                   jnp.asarray(weight), op_name="lerp")
+
+
+def trunc(x):
+    return call_op(jnp.trunc, x, op_name="trunc")
+
+
+def frac(x):
+    return call_op(lambda v: v - jnp.trunc(v), x, op_name="frac")
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return call_op(lambda v: jnp.nanmean(v, axis=axis, keepdims=keepdim),
+                   x, op_name="nanmean")
+
+
+def nansum(x, axis=None, keepdim=False):
+    return call_op(lambda v: jnp.nansum(v, axis=axis, keepdims=keepdim),
+                   x, op_name="nansum")
+
+
+def deg2rad(x):
+    return call_op(jnp.deg2rad, x, op_name="deg2rad")
+
+
+def rad2deg(x):
+    return call_op(jnp.rad2deg, x, op_name="rad2deg")
+
+
+def gcd(x, y):
+    return call_op_nograd(jnp.gcd, x, y, op_name="gcd")
+
+
+def lcm(x, y):
+    return call_op_nograd(jnp.lcm, x, y, op_name="lcm")
+
+
+def heaviside(x, y):
+    return call_op(jnp.heaviside, x, y, op_name="heaviside")

@@ -1,0 +1,150 @@
+"""OpTest sweep part 3: statistics/manipulation tail + linalg.
+
+References: python/paddle/tensor/{stat,search,math,linalg}.py and the
+corresponding operators/ kernels.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import linalg
+from paddle_tpu.core.tensor import Tensor
+from op_test import check_output, check_grad
+
+rng = np.random.RandomState(9)
+
+A23 = rng.rand(2, 3).astype("float32") + 0.1
+A34 = rng.rand(3, 4).astype("float32")
+V6 = rng.rand(6).astype("float32")
+SQ = (rng.rand(3, 3).astype("float32") - 0.5)
+SPD = (lambda m: (m @ m.T + 3 * np.eye(3)).astype("float32"))(
+    rng.rand(3, 3).astype("float32"))
+V3 = rng.rand(3).astype("float32")
+V3b = rng.rand(3).astype("float32")
+
+OPS = [
+    ("median", paddle.median, np.median, [A23], {}, True),
+    ("quantile", lambda x: paddle.quantile(x, 0.5),
+     lambda x: np.quantile(x, 0.5).astype("float32"), [A23], {}, False),
+    ("nanmean", paddle.nanmean, np.nanmean, [A23], {}, True),
+    ("nansum", paddle.nansum, np.nansum, [A23], {}, True),
+    ("diff", paddle.diff, lambda x: np.diff(x), [A23], {}, True),
+    ("trace", paddle.trace, np.trace, [SQ], {}, True),
+    ("kron", paddle.kron, np.kron, [A23, A34[:2, :2]], {}, True),
+    ("outer", paddle.outer, np.outer, [V3, V3b], {}, True),
+    ("cross", paddle.cross, lambda x, y: np.cross(x, y), [V3, V3b], {},
+     True),
+    ("diagonal", paddle.diagonal, lambda x: np.diagonal(x), [SQ], {}, True),
+    ("rot90", paddle.rot90, lambda x: np.rot90(x), [SQ], {}, True),
+    ("lerp", lambda x, y: paddle.lerp(x, y, 0.3),
+     lambda x, y: x + 0.3 * (y - x), [V3, V3b], {}, True),
+    ("trunc", paddle.trunc, np.trunc, [SQ * 4], {}, False),
+    ("frac", paddle.frac, lambda x: x - np.trunc(x), [SQ * 4], {}, True),
+    ("deg2rad", paddle.deg2rad, np.deg2rad, [A23 * 90], {}, True),
+    ("rad2deg", paddle.rad2deg, np.rad2deg, [A23], {}, True),
+    ("heaviside", paddle.heaviside, np.heaviside, [SQ, A34[:3, :3]], {},
+     False),
+    # linalg
+    ("cholesky", linalg.cholesky, np.linalg.cholesky, [SPD], {}, True),
+    ("inv", linalg.inv, np.linalg.inv, [SPD], {}, True),
+    ("det", linalg.det, np.linalg.det, [SPD], {}, True),
+    ("solve", linalg.solve, np.linalg.solve, [SPD, V3], {}, True),
+    ("matrix_power", lambda x: linalg.matrix_power(x, 3),
+     lambda x: np.linalg.matrix_power(x, 3), [SQ * 0.5], {}, True),
+    ("pinv", linalg.pinv, np.linalg.pinv, [A23], {}, False),
+    ("multi_dot", lambda a, b: linalg.multi_dot([a, b]),
+     lambda a, b: a @ b, [A23, A34], {}, True),
+]
+
+
+@pytest.mark.parametrize("name,op,ref,inputs,kwargs",
+                         [(n, o, r, i, k) for n, o, r, i, k, _ in OPS],
+                         ids=[o[0] for o in OPS])
+def test_output(name, op, ref, inputs, kwargs):
+    check_output(op, ref, inputs, kwargs=kwargs, atol=1e-4, rtol=1e-4)
+
+
+GRADS = [(n, o, i, k) for n, o, r, i, k, g in OPS if g]
+
+
+@pytest.mark.parametrize("name,op,inputs,kwargs", GRADS,
+                         ids=[g[0] for g in GRADS])
+def test_grad(name, op, inputs, kwargs):
+    check_grad(op, inputs, kwargs=kwargs)
+
+
+class TestStructured:
+    def test_kthvalue(self):
+        v, idx = paddle.kthvalue(Tensor(V6), 2)
+        s = np.sort(V6)
+        np.testing.assert_allclose(np.asarray(v.numpy()), s[1])
+
+    def test_mode(self):
+        x = np.array([[1.0, 2.0, 2.0, 3.0], [4.0, 4.0, 4.0, 5.0]],
+                     np.float32)
+        v, idx = paddle.mode(Tensor(x))
+        np.testing.assert_allclose(np.asarray(v.numpy()), [2.0, 4.0])
+
+    def test_histogram_bincount(self):
+        x = np.array([0, 1, 1, 2, 2, 2], np.int64)
+        h = paddle.histogram(Tensor(x.astype(np.float32)), bins=3, min=0,
+                             max=3)
+        np.testing.assert_array_equal(np.asarray(h.numpy()), [1, 2, 3])
+        b = paddle.bincount(Tensor(x))
+        np.testing.assert_array_equal(np.asarray(b.numpy()), [1, 2, 3])
+
+    def test_unique_consecutive(self):
+        x = Tensor(np.array([1, 1, 2, 2, 2, 3, 1], np.int64))
+        out, inv, counts = paddle.unique_consecutive(
+            x, return_inverse=True, return_counts=True)
+        np.testing.assert_array_equal(np.asarray(out.numpy()), [1, 2, 3, 1])
+        np.testing.assert_array_equal(np.asarray(counts.numpy()),
+                                      [2, 3, 1, 1])
+        np.testing.assert_array_equal(np.asarray(inv.numpy()),
+                                      [0, 0, 1, 1, 1, 2, 3])
+
+    def test_searchsorted_take(self):
+        seq = Tensor(np.array([1.0, 3.0, 5.0, 7.0], np.float32))
+        vals = Tensor(np.array([2.0, 5.0], np.float32))
+        out = paddle.searchsorted(seq, vals)
+        np.testing.assert_array_equal(np.asarray(out.numpy()), [1, 2])
+        x = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        t = paddle.take(x, Tensor(np.array([0, 4], np.int64)))
+        np.testing.assert_allclose(np.asarray(t.numpy()), [0.0, 4.0])
+
+    def test_svd_qr_eigh(self):
+        u, s, vt = linalg.svd(Tensor(A34))
+        rec = np.asarray(u.numpy()) @ np.diag(np.asarray(s.numpy())) @ \
+            np.asarray(vt.numpy())
+        np.testing.assert_allclose(rec, A34, rtol=1e-4, atol=1e-5)
+        q, r = linalg.qr(Tensor(A34))
+        np.testing.assert_allclose(np.asarray(q.numpy()) @
+                                   np.asarray(r.numpy()), A34, rtol=1e-4,
+                                   atol=1e-5)
+        w, v = linalg.eigh(Tensor(SPD))
+        np.testing.assert_allclose(np.sort(np.asarray(w.numpy())),
+                                   np.sort(np.linalg.eigvalsh(SPD)),
+                                   rtol=1e-4)
+
+    def test_slogdet_rank_cond(self):
+        out = linalg.slogdet(Tensor(SPD))
+        sign, logabs = np.asarray(out.numpy())
+        s0, l0 = np.linalg.slogdet(SPD)
+        assert abs(sign - s0) < 1e-5 and abs(logabs - l0) < 1e-4
+        assert int(np.asarray(linalg.matrix_rank(Tensor(SPD)).numpy())) == 3
+
+    def test_triangular_and_cholesky_solve(self):
+        L = np.linalg.cholesky(SPD).astype(np.float32)
+        b = V3.reshape(3, 1)
+        out = linalg.triangular_solve(Tensor(L), Tensor(b), upper=False)
+        np.testing.assert_allclose(L @ np.asarray(out.numpy()), b,
+                                   rtol=1e-4, atol=1e-5)
+        out2 = linalg.cholesky_solve(Tensor(b), Tensor(L), upper=False)
+        np.testing.assert_allclose(SPD @ np.asarray(out2.numpy()), b,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_lstsq(self):
+        sol, _, rank, _ = linalg.lstsq(Tensor(A34[:, :2]), Tensor(V3))
+        want = np.linalg.lstsq(A34[:, :2], V3, rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(sol.numpy()), want, rtol=1e-3,
+                                   atol=1e-4)
