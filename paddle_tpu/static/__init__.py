@@ -29,6 +29,76 @@ def _static_mode():
     return _STATIC_MODE[0]
 
 
+def save(program, model_path, protocol=4):
+    """Persist a program's trainable state for TRAINING resume (reference:
+    `fluid/io.py save:1840` — persistables + optimizer accumulators; the
+    serving artifact is save_inference_model). Writes `{path}.pdparams`
+    and `{path}.pdopt` (npz with names)."""
+    import io as _io
+    import numpy as _np
+
+    # keyed by program SLOT: slot order is the program structure, stable
+    # across rebuilds (auto-generated tensor names are not)
+    params = {str(s): _np.asarray(t._value)
+              for s, t in sorted(program.params.items())}
+    buf = _io.BytesIO()
+    _np.savez(buf, **{f"p{i}": v for i, v in enumerate(params.values())})
+    with open(model_path + ".pdparams", "wb") as f:
+        f.write(buf.getvalue())
+    opt_state = {}
+    opt = program._optimizer
+    if opt is not None:
+        id_to_slot = {id(t): s for s, t in program.params.items()}
+        for (acc_name, pid), t in sorted(opt._accumulators.items(),
+                                         key=lambda kv: str(kv[0])):
+            ps = id_to_slot.get(pid)
+            if ps is not None:
+                opt_state[f"{ps}.{acc_name}"] = _np.asarray(t._value)
+        opt_state["@step"] = _np.asarray(opt._step_count._value)
+        opt_state["@lr"] = _np.asarray(opt._lr.value())
+    buf2 = _io.BytesIO()
+    _np.savez(buf2, **{f"o{i}": v for i, v in enumerate(opt_state.values())})
+    with open(model_path + ".pdopt", "wb") as f:
+        f.write(buf2.getvalue())
+    import json as _json
+    with open(model_path + ".pdmeta", "w") as f:
+        _json.dump({"params": list(params.keys()),
+                    "opt": list(opt_state.keys())}, f)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Restore state written by static.save (reference: fluid/io.py
+    load:1948)."""
+    import json as _json
+    import numpy as _np
+
+    with open(model_path + ".pdmeta") as f:
+        meta = _json.load(f)
+    data = _np.load(model_path + ".pdparams")
+    for i, slot in enumerate(meta["params"]):
+        t = program.params.get(int(slot))
+        if t is not None:
+            t.set_value(data[f"p{i}"])
+    opt = program._optimizer
+    if opt is not None and meta["opt"]:
+        odata = _np.load(model_path + ".pdopt")
+        slot_to_id = {s: id(t) for s, t in program.params.items()}
+        acc_by_key = {(acc_name, pid): t
+                      for (acc_name, pid), t in opt._accumulators.items()}
+        for i, key in enumerate(meta["opt"]):
+            v = odata[f"o{i}"]
+            if key == "@step":
+                opt._step_count.set_value(v)
+            elif key == "@lr":
+                opt._lr.set(v)
+            else:
+                ps, acc_name = key.split(".", 1)
+                pid = slot_to_id.get(int(ps))
+                acc = acc_by_key.get((acc_name, pid))
+                if acc is not None:
+                    acc.set_value(v)
+
+
 def create_parameter(shape, dtype="float32", name=None, attr=None,
                      is_bias=False, default_initializer=None):
     """Reference: `paddle.static.create_parameter`

@@ -144,6 +144,42 @@ class TestFleetDataset:
         assert len(list(ds.batches())) == 3
 
 
+class TestStaticSaveLoad:
+    def test_training_resume_roundtrip(self, tmp_path):
+        """static.save/load: persistables + optimizer accumulators resume
+        training exactly (reference fluid/io.py save:1840/load:1948)."""
+        def build():
+            paddle.seed(11)
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [4, 6], "float32")
+                w = static.create_parameter([6, 3], "float32")
+                loss = paddle.mean(paddle.matmul(x, w) ** 2)
+                opt = paddle.optimizer.Adam(learning_rate=0.05)
+                opt.minimize(loss)
+            return prog, loss
+
+        rng = np.random.RandomState(0)
+        feeds = [rng.rand(4, 6).astype(np.float32) for _ in range(6)]
+        exe = static.Executor()
+
+        prog, loss = build()
+        for f in feeds[:3]:
+            exe.run(prog, feed={"x": f}, fetch_list=[loss])
+        static.save(prog, str(tmp_path / "ckpt"))
+        cont = [np.asarray(exe.run(prog, feed={"x": f},
+                                   fetch_list=[loss])[0])
+                for f in feeds[3:]]
+
+        prog2, loss2 = build()
+        static.load(prog2, str(tmp_path / "ckpt"))
+        resumed = [np.asarray(exe.run(prog2, feed={"x": f},
+                                      fetch_list=[loss2])[0])
+                   for f in feeds[3:]]
+        np.testing.assert_allclose(np.ravel(cont), np.ravel(resumed),
+                                   rtol=1e-5)
+
+
 class TestEnforce:
     def test_categories_and_callsite(self):
         from paddle_tpu.core import enforce as E
