@@ -26,6 +26,7 @@ from .core.dtype import (  # noqa: F401
 from . import ops  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from . import linalg  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from .ops.math import (  # noqa: F401
     add, subtract, multiply, divide, matmul, mean, sum, max, min,
 )
