@@ -14,6 +14,7 @@ import numpy as np
 
 
 def main():
+    import os
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     import paddle_tpu.distributed as dist
@@ -23,15 +24,31 @@ def main():
 
     dist.init_parallel_env()
     world = jax.device_count()
+    mode = os.environ.get("DIST_FIXTURE_MODE", "dp")
 
     paddle.seed(42)
-    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
-    if world > 1:
+    if mode == "mp" and world > 1:
+        # megatron pair: column-parallel then row-parallel linear over 'mp'
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
         strategy = fleet.DistributedStrategy()
-        strategy.hybrid_configs = {"dp_degree": world, "mp_degree": 1,
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": world,
                                    "pp_degree": 1, "sharding_degree": 1}
         fleet.init(is_collective=True, strategy=strategy)
+        model[0].weight.pspec = P(None, "mp")
+        model[0].bias.pspec = P("mp")
+        model[2].weight.pspec = P("mp", None)
+        model[2].bias.pspec = P()
         model = fleet.distributed_model(model)
+    else:
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        if world > 1:
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {"dp_degree": world, "mp_degree": 1,
+                                       "pp_degree": 1, "sharding_degree": 1}
+            fleet.init(is_collective=True, strategy=strategy)
+            model = fleet.distributed_model(model)
     inner = model
     opt = paddle.optimizer.SGD(learning_rate=0.1,
                                parameters=model.parameters())
@@ -44,8 +61,8 @@ def main():
         return loss
 
     sfn = paddle.jit.to_static(step)
-    if world > 1:
-        sfn._arg_pspecs = [P("dp"), P("dp")]
+    if world > 1 and mode != "mp":
+        sfn._arg_pspecs = [P("dp"), P("dp")]  # mp: batch stays replicated
 
     rng = np.random.RandomState(7)
     for i in range(5):

@@ -45,11 +45,12 @@ def _run_single():
     return _losses(r.stdout)
 
 
-def _run_launcher(nproc, log_dir):
+def _run_launcher(nproc, log_dir, mode="dp", port="19850"):
     env = _clean_env()
+    env["DIST_FIXTURE_MODE"] = mode
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node", str(nproc), "--started_port", "19850",
+         "--nproc_per_node", str(nproc), "--started_port", port,
          "--host_devices", "1", "--log_dir", str(log_dir), FIXTURE],
         capture_output=True, text=True, env=env, timeout=600, cwd=REPO)
     assert r.returncode == 0, (r.stderr[-2000:] or "") + _tail_logs(log_dir)
@@ -77,6 +78,15 @@ class TestDistLossParity:
         dist2 = _run_launcher(2, str(tmp_path))
         assert len(single) == len(dist2) == 5
         np.testing.assert_allclose(single, dist2, rtol=1e-4, atol=1e-6)
+
+    def test_two_proc_tensor_parallel_matches_single(self, tmp_path):
+        """Megatron-sharded weights across two real processes: GSPMD
+        collectives cross the process boundary; losses must match the
+        unsharded single-process run."""
+        single = _run_single()
+        mp2 = _run_launcher(2, str(tmp_path), mode="mp", port="19890")
+        assert len(mp2) == 5
+        np.testing.assert_allclose(single, mp2, rtol=1e-4, atol=1e-6)
 
 
 def _spawn_worker(scale):
