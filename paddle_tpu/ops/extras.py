@@ -33,20 +33,21 @@ def nanmedian(x, axis=None, keepdim=False):
 
 def kthvalue(x, k, axis=-1, keepdim=False):
     """reference: operators/kthvalue_op.cc — (values, indices) of the k-th
-    smallest along axis (1-based k)."""
+    smallest along axis (1-based k); one argsort derives both outputs."""
+    idx_full = call_op_nograd(lambda v: jnp.argsort(v, axis=axis), x,
+                              op_name="kthvalue_argsort")
+    kth_idx = call_op_nograd(
+        lambda i: (jnp.expand_dims(jnp.take(i, k - 1, axis=axis), axis)
+                   if keepdim else jnp.take(i, k - 1, axis=axis)),
+        idx_full, op_name="kthvalue_index")
 
-    def _vals(v):
-        s = jnp.sort(v, axis=axis)
-        out = jnp.take(s, k - 1, axis=axis)
-        return jnp.expand_dims(out, axis) if keepdim else out
+    def _vals(v, i):
+        g = i if keepdim else jnp.expand_dims(i, axis)
+        out = jnp.take_along_axis(v, g, axis=axis)
+        return out if keepdim else jnp.squeeze(out, axis)
 
-    def _idx(v):
-        s = jnp.argsort(v, axis=axis)
-        out = jnp.take(s, k - 1, axis=axis)
-        return jnp.expand_dims(out, axis) if keepdim else out
-
-    return (call_op(_vals, x, op_name="kthvalue"),
-            call_op_nograd(_idx, x, op_name="kthvalue_index"))
+    vals = call_op(_vals, x, unwrap(kth_idx), op_name="kthvalue")
+    return vals, kth_idx
 
 
 def mode(x, axis=-1, keepdim=False):
@@ -96,7 +97,8 @@ def histogram(x, bins=100, min=0, max=0):  # noqa: A002
     def _h(v):
         lo, hi = (jnp.min(v), jnp.max(v)) if min == 0 and max == 0 \
             else (jnp.asarray(min, v.dtype), jnp.asarray(max, v.dtype))
-        return jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi))[0]
+        counts = jnp.histogram(v.reshape(-1), bins=bins, range=(lo, hi))[0]
+        return counts.astype(jnp.int64)  # reference returns int64 counts
 
     return call_op_nograd(_h, x, op_name="histogram")
 
@@ -105,7 +107,7 @@ def bincount(x, weights=None, minlength=0):
     """reference: operators/bincount_op.cc."""
     n = int(np.asarray(unwrap(x)).max()) + 1 if np.asarray(
         unwrap(x)).size else 0
-    length = builtins_max(n, int(minlength))
+    length = max(n, int(minlength))
 
     def _b(v, *rest):
         w = rest[0] if weights is not None else None
@@ -115,26 +117,36 @@ def bincount(x, weights=None, minlength=0):
     return call_op_nograd(_b, *args, op_name="bincount")
 
 
-builtins_max = max
-
-
 def unique_consecutive(x, return_inverse=False, return_counts=False,
                        axis=None):
     """reference: operators/unique_consecutive_op.cc. Host-side: output
-    length is data-dependent."""
+    length is data-dependent. With `axis`, consecutive SLICES along that
+    axis dedupe (reference semantics)."""
     v = np.asarray(unwrap(x))
+    moved = False
     if axis is None:
         v = v.reshape(-1)
-    keep = np.concatenate([[True], v[1:] != v[:-1]]) if v.size else \
-        np.zeros(0, bool)
-    out = Tensor(jnp.asarray(v[keep]))
+    else:
+        v = np.moveaxis(v, axis, 0)
+        moved = True
+    if v.size == 0:
+        keep = np.zeros(0, bool)
+    elif v.ndim == 1:
+        keep = np.concatenate([[True], v[1:] != v[:-1]])
+    else:
+        diff = np.any(v[1:] != v[:-1], axis=tuple(range(1, v.ndim)))
+        keep = np.concatenate([[True], diff])
+    kept = v[keep]
+    if moved:
+        kept = np.moveaxis(kept, 0, axis)
+    out = Tensor(jnp.asarray(kept))
     res = (out,)
     if return_inverse:
         inv = np.cumsum(keep) - 1
         res += (Tensor(jnp.asarray(inv.astype(np.int64))),)
     if return_counts:
         idx = np.flatnonzero(keep)
-        counts = np.diff(np.append(idx, v.size))
+        counts = np.diff(np.append(idx, len(keep)))
         res += (Tensor(jnp.asarray(counts.astype(np.int64))),)
     return res if len(res) > 1 else out
 
@@ -157,7 +169,18 @@ def outer(x, y):
 
 
 def cross(x, y, axis=None):
-    ax = axis if axis is not None else -1
+    """reference: operators/cross_op.cc — default axis is the FIRST axis
+    of length 3 (not the last)."""
+    if axis is None:
+        shape = list(np.shape(unwrap(x)))
+        try:
+            ax = shape.index(3)
+        except ValueError:
+            raise ValueError(
+                f"cross with axis=None needs a dimension of size 3; "
+                f"got shape {shape}")
+    else:
+        ax = axis
     return call_op(lambda a, b: jnp.cross(a, b, axis=ax), x, y,
                    op_name="cross")
 

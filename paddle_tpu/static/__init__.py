@@ -56,6 +56,13 @@ def save(program, model_path, protocol=4):
                 opt_state[f"{ps}.{acc_name}"] = _np.asarray(t._value)
         opt_state["@step"] = _np.asarray(opt._step_count._value)
         opt_state["@lr"] = _np.asarray(opt._lr.value())
+        sched = opt._lr.scheduler
+        if sched is not None:
+            sd = sched.state_dict()
+            opt_state["@sched.last_epoch"] = _np.asarray(
+                sd.get("last_epoch", -1))
+            opt_state["@sched.last_lr"] = _np.asarray(
+                sd.get("last_lr", opt.get_lr()))
     buf2 = _io.BytesIO()
     _np.savez(buf2, **{f"o{i}": v for i, v in enumerate(opt_state.values())})
     with open(model_path + ".pdopt", "wb") as f:
@@ -85,18 +92,24 @@ def load(program, model_path, executor=None, var_list=None):
         slot_to_id = {s: id(t) for s, t in program.params.items()}
         acc_by_key = {(acc_name, pid): t
                       for (acc_name, pid), t in opt._accumulators.items()}
+        sched_state = {}
         for i, key in enumerate(meta["opt"]):
             v = odata[f"o{i}"]
             if key == "@step":
                 opt._step_count.set_value(v)
             elif key == "@lr":
                 opt._lr.set(v)
+            elif key.startswith("@sched."):
+                sched_state[key[len("@sched."):]] = v.item()
             else:
                 ps, acc_name = key.split(".", 1)
                 pid = slot_to_id.get(int(ps))
                 acc = acc_by_key.get((acc_name, pid))
                 if acc is not None:
                     acc.set_value(v)
+        if sched_state and opt._lr.scheduler is not None:
+            # restore AFTER @lr so the scheduler's _push wins consistently
+            opt._lr.scheduler.set_state_dict(sched_state)
 
 
 def create_parameter(shape, dtype="float32", name=None, attr=None,

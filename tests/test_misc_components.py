@@ -277,3 +277,34 @@ class TestCustomOpABI:
         np.testing.assert_allclose(op(x).numpy(), [4.0])
         with pytest.raises(NotFoundError):
             paddle.incubate.load_custom_op(so_path, "nonexistent")
+
+
+def test_static_save_load_restores_scheduler(tmp_path):
+    """LR scheduler epoch state must survive save/load (review finding:
+    resumed schedules silently restarted at epoch 0)."""
+    def build():
+        paddle.seed(3)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            w = static.create_parameter([4, 2], "float32")
+            loss = paddle.mean(paddle.matmul(x, w) ** 2)
+            sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                                  step_size=2, gamma=0.5)
+            opt = paddle.optimizer.SGD(learning_rate=sched)
+            opt.minimize(loss)
+        return prog, loss, sched, opt
+
+    exe = static.Executor()
+    prog, loss, sched, opt = build()
+    feed = np.ones((2, 4), np.float32)
+    for _ in range(5):
+        exe.run(prog, feed={"x": feed}, fetch_list=[loss])
+        sched.step()
+    lr_before = opt.get_lr()
+    static.save(prog, str(tmp_path / "s"))
+
+    prog2, loss2, sched2, opt2 = build()
+    static.load(prog2, str(tmp_path / "s"))
+    assert abs(opt2.get_lr() - lr_before) < 1e-8
+    assert sched2.last_epoch == sched.last_epoch

@@ -148,3 +148,21 @@ class TestStructured:
         want = np.linalg.lstsq(A34[:, :2], V3, rcond=None)[0]
         np.testing.assert_allclose(np.asarray(sol.numpy()), want, rtol=1e-3,
                                    atol=1e-4)
+
+    def test_cross_default_first_axis_of_3(self):
+        a = rng.rand(3, 4).astype("float32")
+        b = rng.rand(3, 4).astype("float32")
+        out = paddle.cross(Tensor(a), Tensor(b))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.cross(a, b, axis=0), rtol=1e-5)
+
+    def test_unique_consecutive_axis(self):
+        x = np.array([[1, 1], [1, 1], [2, 2], [1, 1]], np.int64)
+        out = paddle.unique_consecutive(Tensor(x), axis=0)
+        np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                      [[1, 1], [2, 2], [1, 1]])
+
+    def test_histogram_dtype_int64(self):
+        h = paddle.histogram(Tensor(np.array([1.0, 2.0], np.float32)),
+                             bins=2, min=0, max=3)
+        assert "int" in str(h.dtype)
