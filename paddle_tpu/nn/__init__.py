@@ -39,3 +39,8 @@ from .control_flow import (  # noqa: F401
     while_loop, cond, case, switch_case,
     create_array, array_write, array_read, array_length,
 )
+from .layer.extras import (  # noqa: F401
+    RNN, BiRNN, SpectralNorm, Unfold, AlphaDropout,
+    UpsamplingBilinear2D, UpsamplingNearest2D, CTCLoss,
+    CosineEmbeddingLoss, TripletMarginLoss,
+)

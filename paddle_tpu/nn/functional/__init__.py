@@ -21,6 +21,6 @@ from .loss import (  # noqa: F401
     cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
     smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
     kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
-    triplet_margin_loss, square_error_cost, sigmoid_focal_loss,
+    triplet_margin_loss, square_error_cost, sigmoid_focal_loss, ctc_loss,
 )
 from .attention import scaled_dot_product_attention  # noqa: F401

@@ -245,3 +245,74 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
         return _reduce(loss, reduction)
     args = (logit, label) + ((normalizer,) if normalizer is not None else ())
     return call_op(_focal, *args, op_name="sigmoid_focal_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (reference: `operators/warpctc_op.cc` / paddle F.ctc_loss).
+
+    `log_probs`: [T, B, C] LOGITS (log-softmax applied internally, like the
+    reference's warpctc which consumes unnormalized activations);
+    `labels`: [B, S] int; lengths: [B]. Log-domain alpha recursion over an
+    extended blank-interleaved label sequence, lax.scan over time — fully
+    differentiable through the scan (the reference ships a hand-written
+    gradient kernel).
+    """
+    lbl = unwrap(labels)
+    in_len = unwrap(input_lengths)
+    lb_len = unwrap(label_lengths)
+
+    def _ctc(logits):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        T, B, C = logp.shape
+        S = lbl.shape[1]
+        Lp = 2 * S + 1
+        neg_inf = jnp.float32(-1e30)
+
+        # extended sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, Lp), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        pos = jnp.arange(Lp)
+        valid_s = pos[None, :] < (2 * lb_len[:, None] + 1)
+        # skip transition s-2 -> s allowed for non-blank, non-repeat
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), blank - 1, jnp.int32), ext[:, :-2]], axis=1)
+        can_skip = (pos[None, :] % 2 == 1) & (ext != prev2)
+
+        def emit(t_logp, s_ext):
+            # t_logp: [B, C]; gather per extended position: [B, Lp]
+            return jnp.take_along_axis(t_logp, s_ext, axis=1)
+
+        alpha0 = jnp.full((B, Lp), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(emit(logp[0], ext)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lb_len > 0, emit(logp[0], ext)[:, 1], neg_inf))
+
+        def step(alpha, t):
+            stay = alpha
+            prev1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            prev2_a = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            prev2_a = jnp.where(can_skip, prev2_a, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2_a)
+            new = merged + emit(logp[t], ext)
+            new = jnp.where(valid_s, new, neg_inf)
+            # freeze once past each sequence's input length
+            active = t < in_len[:, None]
+            return jnp.where(active, new, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        # final: logaddexp of positions 2*lb_len and 2*lb_len - 1
+        last = jnp.take_along_axis(alpha, (2 * lb_len)[:, None].astype(
+            jnp.int32), axis=1)[:, 0]
+        last2_idx = jnp.maximum(2 * lb_len - 1, 0)
+        last2 = jnp.take_along_axis(alpha, last2_idx[:, None].astype(
+            jnp.int32), axis=1)[:, 0]
+        last2 = jnp.where(lb_len > 0, last2, neg_inf)
+        nll = -jnp.logaddexp(last, last2)
+        if norm_by_times:
+            nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        return _reduce(nll, reduction)
+
+    return call_op(_ctc, log_probs, op_name="warpctc")

@@ -1,0 +1,203 @@
+"""Layer-class tail: generic RNN/BiRNN wrappers, SpectralNorm, and thin
+class fronts over existing functionals.
+
+Reference: `python/paddle/nn/layer/rnn.py` (RNN:? generic cell runner,
+BiRNN), `nn/layer/norm.py SpectralNorm`, `nn/layer/common.py`
+(Unfold/AlphaDropout/UpsamplingBilinear2D), `nn/layer/loss.py` (CTCLoss,
+CosineEmbeddingLoss, TripletMarginLoss).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ... import ops
+from ...core.dispatch import call_op_nograd, unwrap
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["RNN", "BiRNN", "SpectralNorm", "Unfold", "AlphaDropout",
+           "UpsamplingBilinear2D", "UpsamplingNearest2D", "CTCLoss",
+           "CosineEmbeddingLoss", "TripletMarginLoss"]
+
+
+class RNN(Layer):
+    """Run any cell over time (reference: paddle.nn.RNN — the generic cell
+    wrapper around RNNCellBase)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if not self.time_major:
+            x = ops.transpose(x, [1, 0, 2])
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        for t in steps:
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs.reverse()
+        y = ops.stack(outs, axis=0)
+        if not self.time_major:
+            y = ops.transpose(y, [1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    """reference: paddle.nn.BiRNN — forward + backward cells, concatenated
+    features."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        y_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        y_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        y = ops.concat([y_fw, y_bw], axis=-1)
+        return y, (st_fw, st_bw)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight (reference:
+    `operators/spectral_norm_op.cc` / nn.SpectralNorm): w / sigma_max(w),
+    sigma estimated by power iteration on persistent u/v buffers."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = int(weight_shape[dim])
+        w = int(np.prod([s for i, s in enumerate(weight_shape)
+                         if i != dim]))
+        rng = np.random.RandomState(0)
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=lambda s, d: jnp.asarray(
+                rng.randn(*s).astype("float32")))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=lambda s, d: jnp.asarray(
+                rng.randn(*s).astype("float32")))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        dim = self._dim
+        eps = self._eps
+        iters = self._power_iters
+        u0 = unwrap(self.weight_u)
+        v0 = unwrap(self.weight_v)
+
+        def _power(wv):
+            m = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+            u, v = u0, v0
+            for _ in range(iters):
+                v = m.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = m @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            return u, v
+
+        # power iteration updates the buffers out-of-band (no grad)
+        u_new, v_new = call_op_nograd(
+            lambda wv: _power(wv), weight, op_name="spectral_norm_power")
+        self.weight_u.set_value(unwrap(u_new))
+        self.weight_v.set_value(unwrap(v_new))
+        uu, vv = unwrap(u_new), unwrap(v_new)
+
+        def _norm(wv):
+            m = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+            sigma = uu @ (m @ vv)
+            return wv / sigma
+
+        from ...core.dispatch import call_op
+        return call_op(_norm, weight, op_name="spectral_norm")
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self._args
+        return F.unfold(x, kernel_sizes=k, strides=s, paddings=p,
+                        dilations=d)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, p=self.p, training=self.training)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="bilinear", align_corners=True)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor, mode="nearest")
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label,
+                                       margin=self.margin,
+                                       reduction=self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6,
+                 reduction="mean"):
+        super().__init__()
+        self._kw = dict(margin=margin, p=p, epsilon=epsilon,
+                        reduction=reduction)
+
+    def forward(self, input, positive, negative):  # noqa: A002
+        return F.triplet_margin_loss(input, positive, negative, **self._kw)

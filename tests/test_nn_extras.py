@@ -1,0 +1,133 @@
+"""Layer-class tail: RNN/BiRNN wrappers, SpectralNorm, CTC loss (vs brute
+force), and the thin class fronts.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+
+
+def _ctc_brute(logits, labels, blank=0):
+    """Enumerate all alignments for one sequence: logits [T, C],
+    labels [S]."""
+    T, C = logits.shape
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+
+    def collapse(path):
+        out = []
+        prev = None
+        for s in path:
+            if s != prev:
+                if s != blank:
+                    out.append(s)
+                prev = s
+        return tuple(out)
+
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(labels):
+            total += np.prod([p[t, s] for t, s in enumerate(path)])
+    return -np.log(total)
+
+
+class TestCTCLoss:
+    def test_matches_brute_force(self):
+        rng = np.random.RandomState(0)
+        T, B, C = 4, 2, 3
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = np.array([[1, 2], [2, 1]], np.int64)
+        out = F.ctc_loss(Tensor(logits), Tensor(labels),
+                         Tensor(np.array([T, T], np.int64)),
+                         Tensor(np.array([2, 2], np.int64)),
+                         blank=0, reduction="none")
+        got = np.asarray(out.numpy())
+        want = [_ctc_brute(logits[:, b], labels[b]) for b in range(B)]
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_ragged_lengths(self):
+        rng = np.random.RandomState(1)
+        T, B, C = 5, 2, 3
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = np.array([[1, 0], [2, 1]], np.int64)  # row 0: one label
+        out = F.ctc_loss(Tensor(logits), Tensor(labels),
+                         Tensor(np.array([3, 5], np.int64)),
+                         Tensor(np.array([1, 2], np.int64)),
+                         reduction="none")
+        got = np.asarray(out.numpy())
+        want0 = _ctc_brute(logits[:3, 0], [1])
+        want1 = _ctc_brute(logits[:5, 1], [2, 1])
+        np.testing.assert_allclose(got, [want0, want1], rtol=1e-4)
+
+    def test_differentiable_and_class(self):
+        rng = np.random.RandomState(2)
+        logits = Tensor(rng.randn(4, 2, 3).astype(np.float32),
+                        stop_gradient=False)
+        loss = nn.CTCLoss(blank=0)(
+            logits, Tensor(np.array([[1, 2], [2, 1]], np.int64)),
+            Tensor(np.array([4, 4], np.int64)),
+            Tensor(np.array([2, 2], np.int64)))
+        loss.backward()
+        g = np.asarray(logits.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+class TestRNNWrappers:
+    def test_rnn_cell_wrapper_matches_manual(self):
+        paddle.seed(0)
+        cell = nn.SimpleRNNCell(4, 5)
+        rnn = nn.RNN(cell)
+        x = Tensor(np.random.RandomState(0).rand(2, 3, 4).astype(np.float32))
+        y, st = rnn(x)
+        assert list(y.shape) == [2, 3, 5]
+        # manual unroll
+        h = None
+        for t in range(3):
+            o, h = cell(x[:, t], h)
+        np.testing.assert_allclose(np.asarray(y[:, -1].numpy()),
+                                   np.asarray(o.numpy()), rtol=1e-5)
+
+    def test_birnn_concats(self):
+        paddle.seed(0)
+        rnn = nn.BiRNN(nn.GRUCell(4, 5), nn.GRUCell(4, 5))
+        x = Tensor(np.random.RandomState(1).rand(2, 3, 4).astype(np.float32))
+        y, (sf, sb) = rnn(x)
+        assert list(y.shape) == [2, 3, 10]
+
+
+class TestSpectralNorm:
+    def test_normalizes_spectral_radius(self):
+        rng = np.random.RandomState(3)
+        w = rng.randn(6, 4).astype(np.float32) * 3.0
+        sn = nn.SpectralNorm(w.shape, power_iters=30)
+        out = sn(Tensor(w))
+        sigma = np.linalg.svd(np.asarray(out.numpy()), compute_uv=False)[0]
+        np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+class TestThinFronts:
+    def test_unfold_alpha_upsampling(self):
+        x = Tensor(np.random.RandomState(4).rand(1, 2, 4, 4)
+                   .astype(np.float32))
+        assert list(nn.Unfold(2)(x).shape) == [1, 8, 9]
+        up = nn.UpsamplingNearest2D(scale_factor=2)(x)
+        assert list(up.shape) == [1, 2, 8, 8]
+        ad = nn.AlphaDropout(p=0.3)
+        ad.eval()
+        np.testing.assert_allclose(np.asarray(ad(x).numpy()),
+                                   np.asarray(x.numpy()))
+
+    def test_embedding_losses(self):
+        a = Tensor(np.random.RandomState(5).rand(4, 8).astype(np.float32))
+        b = Tensor(np.random.RandomState(6).rand(4, 8).astype(np.float32))
+        y = Tensor(np.array([1, -1, 1, -1], np.int64))
+        out = nn.CosineEmbeddingLoss(margin=0.1)(a, b, y)
+        assert np.isfinite(float(out.numpy()))
+        n = Tensor(np.random.RandomState(7).rand(4, 8).astype(np.float32))
+        out2 = nn.TripletMarginLoss()(a, b, n)
+        assert np.isfinite(float(out2.numpy()))
