@@ -155,6 +155,35 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                   "bicubic": "cubic", "trilinear": "linear",
                   "linear": "linear", "area": "linear"}[mode]
 
+    if align_corners and mode in ("bilinear", "linear", "trilinear") \
+            and len(size) == 2 and data_format == "NCHW":
+        # jax.image.resize is half-pixel only; align_corners maps output
+        # grid ends onto input corners: src = i * (in-1)/(out-1)
+        def _interp_ac(val):
+            H, W = val.shape[2], val.shape[3]
+            oh, ow = size
+
+            def axis_coords(n_in, n_out):
+                if n_out == 1:
+                    return (jnp.zeros(1, jnp.float32),
+                            jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.int32))
+                c = jnp.arange(n_out, dtype=jnp.float32) * ((n_in - 1) /
+                                                            (n_out - 1))
+                lo = jnp.clip(jnp.floor(c).astype(jnp.int32), 0, n_in - 1)
+                hi = jnp.clip(lo + 1, 0, n_in - 1)
+                return c - lo, lo, hi
+
+            wy, y0, y1 = axis_coords(H, oh)
+            wx, x0, x1 = axis_coords(W, ow)
+            top = (val[:, :, y0][:, :, :, x0] * (1 - wx)[None, None, None]
+                   + val[:, :, y0][:, :, :, x1] * wx[None, None, None])
+            bot = (val[:, :, y1][:, :, :, x0] * (1 - wx)[None, None, None]
+                   + val[:, :, y1][:, :, :, x1] * wx[None, None, None])
+            return top * (1 - wy)[None, None, :, None] + \
+                bot * wy[None, None, :, None]
+
+        return call_op(_interp_ac, x, op_name="interpolate")
+
     def _interp(val):
         if data_format == "NCHW":
             out_shape = val.shape[:2] + tuple(size)

@@ -313,6 +313,11 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         nll = -jnp.logaddexp(last, last2)
         if norm_by_times:
             nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference semantics: each sample normalized by its label
+            # length BEFORE the batch mean (warpctc_op / F.ctc_loss)
+            return jnp.mean(nll / jnp.maximum(
+                lb_len.astype(jnp.float32), 1.0))
         return _reduce(nll, reduction)
 
     return call_op(_ctc, log_probs, op_name="warpctc")

@@ -30,15 +30,37 @@ class RNN(Layer):
         self.time_major = time_major
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax.tree_util as jtu
+
         x = inputs
         if not self.time_major:
             x = ops.transpose(x, [1, 0, 2])
         T = x.shape[0]
         steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        seq_len = None if sequence_length is None else \
+            ops.cast(sequence_length, "int32")
         states = initial_states
         outs = []
         for t in steps:
-            out, states = self.cell(x[t], states)
+            out, new_states = self.cell(x[t], states)
+            if seq_len is not None:
+                # ragged batches: freeze states and zero outputs past each
+                # sequence's length (reference rnn masking; in reverse order
+                # pad frames come first and stay frozen, so the valid region
+                # is processed exactly reversed)
+                valid = ops.unsqueeze(
+                    ops.less_than(ops.full([], t, "int32"), seq_len), -1)
+                vf = ops.cast(valid, out.dtype)
+                out = out * vf
+                is_leaf = lambda z: not isinstance(z, (tuple, list))
+                old_states = (states if states is not None else
+                              jtu.tree_map(lambda n: n * 0.0, new_states,
+                                           is_leaf=is_leaf))
+                new_states = jtu.tree_map(
+                    lambda n, o: n * ops.cast(valid, n.dtype)
+                    + o * (1.0 - ops.cast(valid, o.dtype)),
+                    new_states, old_states, is_leaf=is_leaf)
+            states = new_states
             outs.append(out)
         if self.is_reverse:
             outs.reverse()
@@ -61,8 +83,8 @@ class BiRNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         s_fw, s_bw = (initial_states if initial_states is not None
                       else (None, None))
-        y_fw, st_fw = self.rnn_fw(inputs, s_fw)
-        y_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        y_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        y_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length)
         y = ops.concat([y_fw, y_bw], axis=-1)
         return y, (st_fw, st_bw)
 
@@ -83,12 +105,12 @@ class SpectralNorm(Layer):
                          if i != dim]))
         rng = np.random.RandomState(0)
         self.weight_u = self.create_parameter(
-            [h], default_initializer=lambda s, d: jnp.asarray(
-                rng.randn(*s).astype("float32")))
+            [h], dtype=dtype, default_initializer=lambda s, d: jnp.asarray(
+                rng.randn(*s), dtype=d))
         self.weight_u.stop_gradient = True
         self.weight_v = self.create_parameter(
-            [w], default_initializer=lambda s, d: jnp.asarray(
-                rng.randn(*s).astype("float32")))
+            [w], dtype=dtype, default_initializer=lambda s, d: jnp.asarray(
+                rng.randn(*s), dtype=d))
         self.weight_v.stop_gradient = True
 
     def forward(self, weight):
@@ -108,12 +130,19 @@ class SpectralNorm(Layer):
                 u = u / (jnp.linalg.norm(u) + eps)
             return u, v
 
-        # power iteration updates the buffers out-of-band (no grad)
+        # power iteration updates the buffers out-of-band (no grad). Under
+        # tracing/program recording the values are tracers/placeholders —
+        # do not store them into the live buffers (the BN stat path routes
+        # through prog._buffer_updates for this; power iteration simply
+        # freezes under tracing, a standard spectral-norm behavior)
+        import jax as _jax
+        from ...core.dispatch import _STATIC_HOOK
         u_new, v_new = call_op_nograd(
             lambda wv: _power(wv), weight, op_name="spectral_norm_power")
-        self.weight_u.set_value(unwrap(u_new))
-        self.weight_v.set_value(unwrap(v_new))
         uu, vv = unwrap(u_new), unwrap(v_new)
+        if _STATIC_HOOK[0] is None and not isinstance(uu, _jax.core.Tracer):
+            self.weight_u.set_value(uu)
+            self.weight_v.set_value(vv)
 
         def _norm(wv):
             m = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)

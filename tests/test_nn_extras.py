@@ -131,3 +131,48 @@ class TestThinFronts:
         n = Tensor(np.random.RandomState(7).rand(4, 8).astype(np.float32))
         out2 = nn.TripletMarginLoss()(a, b, n)
         assert np.isfinite(float(out2.numpy()))
+
+
+class TestReviewFixes:
+    def test_ctc_mean_normalizes_by_label_length(self):
+        rng = np.random.RandomState(8)
+        T, B, C = 4, 2, 3
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = np.array([[1, 0], [2, 1]], np.int64)
+        lb = np.array([1, 2], np.int64)
+        per = np.asarray(F.ctc_loss(
+            Tensor(logits), Tensor(labels), Tensor(np.array([T, T])),
+            Tensor(lb), reduction="none").numpy())
+        mean = float(F.ctc_loss(
+            Tensor(logits), Tensor(labels), Tensor(np.array([T, T])),
+            Tensor(lb), reduction="mean").numpy())
+        np.testing.assert_allclose(mean, np.mean(per / lb), rtol=1e-5)
+
+    def test_bilinear_align_corners(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = nn.UpsamplingBilinear2D(size=[7, 7])(Tensor(x))
+        o = np.asarray(out.numpy())[0, 0]
+        # corners map exactly onto input corners
+        np.testing.assert_allclose([o[0, 0], o[0, -1], o[-1, 0], o[-1, -1]],
+                                   [0.0, 3.0, 12.0, 15.0], atol=1e-5)
+        # center of a linear ramp stays linear
+        np.testing.assert_allclose(o[0, 3], 1.5, atol=1e-5)
+
+    def test_reverse_rnn_respects_sequence_length(self):
+        paddle.seed(1)
+        cell = nn.SimpleRNNCell(3, 4)
+        rnn = nn.RNN(cell, is_reverse=True)
+        rng = np.random.RandomState(9)
+        x = rng.rand(2, 5, 3).astype(np.float32)
+        x_pad = x.copy()
+        x_pad[0, 3:] = 99.0  # garbage in the padding of sequence 0 (len 3)
+        y, st = rnn(Tensor(x_pad),
+                    sequence_length=Tensor(np.array([3, 5], np.int64)))
+        # reference: run reversed over ONLY the valid region
+        h = None
+        for t in range(2, -1, -1):
+            o, h = cell(Tensor(x[0:1, t]), h)
+        np.testing.assert_allclose(np.asarray(y[0, 0].numpy()),
+                                   np.asarray(o.numpy())[0], rtol=1e-5)
+        # outputs past the valid length are zeroed
+        assert np.abs(np.asarray(y[0, 3:].numpy())).sum() == 0
