@@ -41,16 +41,28 @@ def main():
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
                                  learning_rate=1e-4)
 
-    def train_step(ids, tok, labels, nsp_labels):
+    # Split compiled programs: fwd+bwd and the optimizer update. In one
+    # monolithic program XLA interleaves the AdamW fusions with the backward
+    # matmuls and their HBM throughput drops ~3x (measured on v5e); as a
+    # separate donated-buffer program the update runs at near-peak HBM BW.
+    def fwd_bwd(ids, tok, labels, nsp_labels):
         with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
             logits, nsp = model(ids, tok)
             loss = model.loss(logits, nsp, labels, nsp_labels)
         loss.backward()
-        opt.step()
-        opt.clear_grad()
         return loss
 
-    step = paddle.jit.to_static(train_step)
+    def opt_step():
+        opt.step()
+        opt.clear_grad()
+
+    s1 = paddle.jit.to_static(fwd_bwd)
+    s2 = paddle.jit.to_static(opt_step)
+
+    def step(*args):
+        loss = s1(*args)
+        s2()
+        return loss
 
     def run(bs):
         ids, tok, labels, nsp = synthetic_mlm_batch(bs, seq,

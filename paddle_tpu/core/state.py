@@ -42,11 +42,22 @@ def version():
     return _version
 
 
+_snap_cache = (None, None)  # (version, [(uid, weakref)]) — weak, so the
+# cache never blocks the GC-driven cleanup the registry depends on
+
+
 def snapshot():
-    """Sorted list of (uid, Tensor) for all live stateful tensors."""
+    """Sorted list of (uid, Tensor) for all live stateful tensors. The
+    sorted uid order is cached by registry version (hot path: to_static
+    dispatch calls this every step); tensors are re-dereferenced per call.
+    Callers must treat the returned list as immutable."""
+    global _snap_cache
+    if _snap_cache[0] != _version:
+        _snap_cache = (_version,
+                       [(uid, _registry[uid]) for uid in sorted(_registry)])
     out = []
-    for uid in sorted(_registry):
-        t = _registry[uid]()
+    for uid, ref in _snap_cache[1]:
+        t = ref()
         if t is not None:
             out.append((uid, t))
     return out

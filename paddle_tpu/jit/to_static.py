@@ -142,22 +142,19 @@ class StaticFunction:
             self._place_state(state_items, mesh)
             dyn_vals = self._place_args(dyn_vals, mesh)
 
-        grad_vals = [t._grad for _, t in state_items]
+        # registry version determines membership/order, so uids need not be
+        # part of the key; grad presence changes program structure
         key = (treedef, tuple(_leaf_key(l) for l in leaves),
-               tuple(uid for uid, _ in state_items), state_mod.version(),
-               tuple(g is not None for g in grad_vals), mesh is not None)
+               state_mod.version(),
+               tuple(t._grad is not None for _, t in state_items),
+               mesh is not None)
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build(treedef, leaves, dyn_idx, state_items)
             self._cache[key] = entry
         compiled, out_wrap = entry
 
-        state_vals = [t._value for _, t in state_items]
-        out_flat, new_state, new_grads = compiled(state_vals, dyn_vals,
-                                                  grad_vals)
-        for (_, t), v, g in zip(state_items, new_state, new_grads):
-            t._value = v
-            t._grad = g
+        out_flat = compiled(dyn_vals)
         return out_wrap(out_flat)
 
     def _place_args(self, dyn_vals, mesh):
@@ -175,8 +172,24 @@ class StaticFunction:
         return out
 
     def _build(self, treedef, template_leaves, dyn_idx, state_items):
+        """Two-phase build.
+
+        Phase A traces the user function once (abstractly) threading *all*
+        state, and records which state values / grads the program actually
+        writes (object identity of the tracer survives only if untouched)
+        and which inputs it reads (jaxpr var usage).
+
+        Phase B compiles the real program threading only what matters:
+        written entries are donated inputs + outputs (PJRT aliasing — the
+        in-place Variable update of the reference); read-only entries are
+        plain inputs (no donation, no passthrough output — XLA would
+        otherwise materialize a full copy of every parameter in grad-only
+        programs); untouched entries are not passed at all (keeps dispatch
+        overhead proportional to the program's real state footprint).
+        """
         fn = self._fn
         out_template = {}
+        info = {}
 
         def pure_fn(state_vals, dyn_vals, grad_vals):
             leaves = list(template_leaves)
@@ -191,12 +204,148 @@ class StaticFunction:
                             for l in out_leaves]
                 out_template["treedef"] = out_treedef
                 new_state, new_grads = swap.capture()
+            info["w_val"] = [nv is not ov
+                             for nv, ov in zip(new_state, state_vals)]
+            info["w_grad"] = [ng is not og
+                              for ng, og in zip(new_grads, grad_vals)]
+            info["n_out"] = len(jax.tree_util.tree_flatten(out_vals)[0])
+            info["grad_out_mask"] = [ng is not None for ng in new_grads]
             return out_vals, new_state, new_grads
 
-        # grads are dead after the call (overwritten from new_grads), so
-        # donate them alongside state to avoid doubling gradient HBM
-        donate = (0, 2) if self._donate else ()
-        compiled = jax.jit(pure_fn, donate_argnums=donate)
+        n = len(state_items)
+        state_vals = [t._value for _, t in state_items]
+        grad_vals = [t._grad for _, t in state_items]
+
+        # ---- phase A: analysis trace ----
+        dyn_template = [l._value if isinstance(l, Tensor) else l
+                        for l in (template_leaves[i] for i in dyn_idx)]
+        a_args = (state_vals, dyn_template, grad_vals)
+        a_leaves, a_tdef = jax.tree_util.tree_flatten(a_args)
+        closed = jax.make_jaxpr(
+            lambda *ls: pure_fn(*jax.tree_util.tree_unflatten(a_tdef, ls))
+        )(*a_leaves)
+        used_vars = set()
+        for eqn in closed.jaxpr.eqns:
+            # Literals (hasattr .val) may be unhashable; only Vars matter
+            used_vars.update(v for v in eqn.invars if not hasattr(v, "val"))
+        # an invar returned verbatim in the *user-visible* outputs (fn
+        # returns an unmodified param) must stay a runtime input, not be
+        # frozen as a constant. Only the first n_out outvars are the user
+        # outputs — an invar in its OWN slot of the new_state/new_grads
+        # passthrough tail must NOT mark it used (or nothing would ever be
+        # skippable), but landing in a DIFFERENT slot (EMA/target-network
+        # sync: a.set_value(b) creates no eqn) is a real use.
+        used_vars.update(v for v in closed.jaxpr.outvars[:info["n_out"]]
+                         if not hasattr(v, "val"))
+        invar_slot = {}
+        for i in range(n):
+            invar_slot[closed.jaxpr.invars[i]] = ("val", i)
+        pos_in = n + len(dyn_template)
+        for i, g in enumerate(grad_vals):
+            if g is not None:
+                invar_slot[closed.jaxpr.invars[pos_in]] = ("grad", i)
+                pos_in += 1
+        pos_out = info["n_out"]
+        for j in range(n):  # new_state tail
+            v = closed.jaxpr.outvars[pos_out]
+            if (not hasattr(v, "val")
+                    and invar_slot.get(v, ("val", j)) != ("val", j)):
+                used_vars.add(v)
+            pos_out += 1
+        for j, present in enumerate(info["grad_out_mask"]):  # new_grads tail
+            if present:
+                v = closed.jaxpr.outvars[pos_out]
+                if (not hasattr(v, "val")
+                        and invar_slot.get(v, ("grad", j)) != ("grad", j)):
+                    used_vars.add(v)
+                pos_out += 1
+        leaf_used = [v in used_vars for v in closed.jaxpr.invars]
+        # map flat leaves back to (state, dyn, grad) slots; None grads were
+        # dropped by tree_flatten, so enumerate in flatten order
+        val_used = leaf_used[:n]
+        grad_used = {}
+        pos = n + len(dyn_template)
+        for i, g in enumerate(grad_vals):
+            if g is not None:
+                grad_used[i] = leaf_used[pos]
+                pos += 1
+
+        w_val, w_grad = info["w_val"], info["w_grad"]
+        don_val_idx = [i for i in range(n) if w_val[i]]
+        ro_val_idx = [i for i in range(n)
+                      if not w_val[i] and val_used[i]]
+        # only *written* grads are donated (their buffers are replaced from
+        # the outputs); grads the program merely reads must stay un-donated
+        # or XLA may alias them to a same-shaped output and delete the
+        # buffer out from under the live Tensor._grad
+        don_grad_idx = [i for i in range(n)
+                        if grad_vals[i] is not None and w_grad[i]]
+        ro_grad_idx = [i for i in range(n)
+                       if grad_vals[i] is not None and not w_grad[i]
+                       and grad_used.get(i, False)]
+        out_grad_idx = [i for i in range(n) if w_grad[i]]
+        # skipped entries are only materialized at (re)trace time, read from
+        # the live tensors — capturing concrete arrays here would pin stale
+        # HBM buffers in the compile cache for the life of the entry
+        skip_val_idx = [i for i in range(n)
+                        if not w_val[i] and not val_used[i]]
+        skip_grad_idx = [i for i in range(n)
+                         if i not in don_grad_idx and i not in ro_grad_idx]
+
+        # ---- phase B: the real program ----
+        def pure_fn2(don_vals, don_grads, dyn_vals, ro_vals, ro_grads):
+            sv = [None] * n
+            gv = [None] * n
+            for i, v in zip(don_val_idx, don_vals):
+                sv[i] = v
+            for i, v in zip(ro_val_idx, ro_vals):
+                sv[i] = v
+            for i in skip_val_idx:  # trace-time read of the live value
+                sv[i] = state_items[i][1]._value
+            for i, g in zip(don_grad_idx, don_grads):
+                gv[i] = g
+            for i, g in zip(ro_grad_idx, ro_grads):
+                gv[i] = g
+            for i in skip_grad_idx:
+                gv[i] = state_items[i][1]._grad
+            out_vals, new_state, new_grads = pure_fn(sv, dyn_vals, gv)
+            return (out_vals,
+                    [new_state[i] for i in don_val_idx],
+                    [new_grads[i] for i in out_grad_idx])
+
+        donate = (0, 1) if self._donate else ()
+        jitted = jax.jit(pure_fn2, donate_argnums=donate)
+
+        # introspection (tests / debugging): which state uids ended up where
+        uids = [uid for uid, _ in state_items]
+        self._last_partition = {
+            "donated": [uids[i] for i in don_val_idx],
+            "readonly": [uids[i] for i in ro_val_idx],
+            "skipped": [uids[i] for i in skip_val_idx],
+            "donated_grads": [uids[i] for i in don_grad_idx],
+            "readonly_grads": [uids[i] for i in ro_grad_idx],
+        }
+
+        # direct Tensor references per partition: the per-call hot path
+        # touches only the state the program actually uses
+        don_ts = [state_items[i][1] for i in don_val_idx]
+        ro_ts = [state_items[i][1] for i in ro_val_idx]
+        dong_ts = [state_items[i][1] for i in don_grad_idx]
+        rog_ts = [state_items[i][1] for i in ro_grad_idx]
+        outg_ts = [state_items[i][1] for i in out_grad_idx]
+
+        def compiled(dyn_vals):
+            out_flat, new_w, new_g = jitted(
+                [t._value for t in don_ts],
+                [t._grad for t in dong_ts],
+                dyn_vals,
+                [t._value for t in ro_ts],
+                [t._grad for t in rog_ts])
+            for t, v in zip(don_ts, new_w):
+                t._value = v
+            for t, g in zip(outg_ts, new_g):
+                t._grad = g
+            return out_flat
 
         def out_wrap(out_flat):
             wrapped = [Tensor(v) if isinstance(v, jax.Array) else v

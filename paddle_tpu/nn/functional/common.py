@@ -142,9 +142,10 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, data_format="NCHW"):
     """Image resize (reference: `operators/interpolate_v2_op.*`)."""
     v = unwrap(x)
-    if data_format == "NCHW":
+    channels_first = len(data_format) > 1 and data_format[1] == "C"
+    if channels_first:  # NCW / NCHW / NCDHW
         spatial = v.shape[2:]
-    else:
+    else:  # NWC / NHWC / NDHWC
         spatial = v.shape[1:-1]
     if size is None:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
@@ -155,37 +156,36 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                   "bicubic": "cubic", "trilinear": "linear",
                   "linear": "linear", "area": "linear"}[mode]
 
-    if align_corners and mode in ("bilinear", "linear", "trilinear") \
-            and len(size) == 2 and data_format == "NCHW":
+    if align_corners and mode in ("bilinear", "linear", "trilinear"):
         # jax.image.resize is half-pixel only; align_corners maps output
-        # grid ends onto input corners: src = i * (in-1)/(out-1)
-        def _interp_ac(val):
-            H, W = val.shape[2], val.shape[3]
-            oh, ow = size
+        # grid ends onto input corners: src = i * (in-1)/(out-1).
+        # Separable per-axis lerp handles 1-D/2-D/3-D and both NC*/N*C.
+        first_sp = 2 if channels_first else 1
 
-            def axis_coords(n_in, n_out):
+        def _interp_ac(val):
+            out = val
+            for k, n_out in enumerate(size):
+                ax = first_sp + k
+                n_in = out.shape[ax]
                 if n_out == 1:
-                    return (jnp.zeros(1, jnp.float32),
-                            jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.int32))
-                c = jnp.arange(n_out, dtype=jnp.float32) * ((n_in - 1) /
-                                                            (n_out - 1))
+                    out = jnp.take(out, jnp.zeros(1, jnp.int32), axis=ax)
+                    continue
+                c = jnp.arange(n_out, dtype=jnp.float32) * (
+                    (n_in - 1) / (n_out - 1))
                 lo = jnp.clip(jnp.floor(c).astype(jnp.int32), 0, n_in - 1)
                 hi = jnp.clip(lo + 1, 0, n_in - 1)
-                return c - lo, lo, hi
-
-            wy, y0, y1 = axis_coords(H, oh)
-            wx, x0, x1 = axis_coords(W, ow)
-            top = (val[:, :, y0][:, :, :, x0] * (1 - wx)[None, None, None]
-                   + val[:, :, y0][:, :, :, x1] * wx[None, None, None])
-            bot = (val[:, :, y1][:, :, :, x0] * (1 - wx)[None, None, None]
-                   + val[:, :, y1][:, :, :, x1] * wx[None, None, None])
-            return top * (1 - wy)[None, None, :, None] + \
-                bot * wy[None, None, :, None]
+                w = (c - lo).astype(val.dtype)
+                wshape = [1] * out.ndim
+                wshape[ax] = n_out
+                w = w.reshape(wshape)
+                out = (jnp.take(out, lo, axis=ax) * (1 - w)
+                       + jnp.take(out, hi, axis=ax) * w)
+            return out
 
         return call_op(_interp_ac, x, op_name="interpolate")
 
     def _interp(val):
-        if data_format == "NCHW":
+        if channels_first:
             out_shape = val.shape[:2] + tuple(size)
         else:
             out_shape = (val.shape[0],) + tuple(size) + (val.shape[-1],)

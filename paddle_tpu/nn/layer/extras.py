@@ -130,17 +130,20 @@ class SpectralNorm(Layer):
                 u = u / (jnp.linalg.norm(u) + eps)
             return u, v
 
-        # power iteration updates the buffers out-of-band (no grad). Under
-        # tracing/program recording the values are tracers/placeholders —
-        # do not store them into the live buffers (the BN stat path routes
-        # through prog._buffer_updates for this; power iteration simply
-        # freezes under tracing, a standard spectral-norm behavior)
+        # power iteration updates the buffers out-of-band (no grad). Inside
+        # a to_static trace the buffers are swapped state — storing the
+        # tracer is exactly how BN running stats thread through, so power
+        # iteration stays live in compiled training. Only the static
+        # Program recorder (placeholder values, prog._buffer_updates path)
+        # and raw-jax tracers from user transforms must not be stored.
         import jax as _jax
         from ...core.dispatch import _STATIC_HOOK
+        from ...jit.to_static import in_tracing
         u_new, v_new = call_op_nograd(
             lambda wv: _power(wv), weight, op_name="spectral_norm_power")
         uu, vv = unwrap(u_new), unwrap(v_new)
-        if _STATIC_HOOK[0] is None and not isinstance(uu, _jax.core.Tracer):
+        if _STATIC_HOOK[0] is None and (
+                in_tracing() or not isinstance(uu, _jax.core.Tracer)):
             self.weight_u.set_value(uu)
             self.weight_v.set_value(vv)
 

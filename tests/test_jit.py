@@ -154,3 +154,87 @@ def test_lr_scheduler_no_retrace():
     assert abs(opt.get_lr() - 0.05) < 1e-7
     step(x)
     assert len(step._cache) == 1, "lr change must not retrace"
+
+
+def test_to_static_selective_state_threading():
+    """Grad-only programs must not donate/copy read-only params, must skip
+    untouched state entirely, and must never donate grads they only read."""
+    lin1 = nn.Linear(4, 4)
+    lin2 = nn.Linear(4, 4)
+    unused = nn.Linear(8, 8)  # registered state the program never touches
+
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss1 = lin1(x).sum()
+    loss1.backward()  # lin1 now has accumulated grads
+    g_before = lin1.weight.grad.numpy().copy()
+
+    @paddle.jit.to_static
+    def fn(inp):
+        # reads lin1's accumulated grad (grad-norm logging style) while
+        # training lin2 — lin1's grads are read-only, lin2's are written
+        gn = (lin1.weight.grad * lin1.weight.grad).sum()
+        out = (lin2(inp).sum() + 0.0 * gn)
+        out.backward()
+        return out
+
+    fn(x)
+    part = fn._last_partition
+    uid = {id(t): u for u, t in
+           __import__("paddle_tpu.core.state", fromlist=["x"]).snapshot()}
+    # lin1.weight's VALUE is never read (only its grad) -> skipped
+    assert uid[id(lin1.weight)] in part["skipped"]
+    # params read but not written -> readonly, not donated
+    assert uid[id(lin2.weight)] in part["readonly"]
+    assert not part["donated"] or uid[id(lin2.weight)] not in part["donated"]
+    # untouched layer skipped entirely
+    assert uid[id(unused.weight)] in part["skipped"]
+    assert uid[id(unused.bias)] in part["skipped"]
+    # lin1's read-only grad must not be donated...
+    assert uid[id(lin1.weight)] in part["readonly_grads"]
+    assert uid[id(lin1.weight)] not in part["donated_grads"]
+    # ...and its buffer survives, unchanged, after the call
+    np.testing.assert_allclose(lin1.weight.grad.numpy(), g_before)
+    # lin2 got real grads out of the compiled program
+    assert lin2.weight.grad is not None
+    # second call reuses the cache and still works
+    fn(x)
+    np.testing.assert_allclose(lin1.weight.grad.numpy(), g_before)
+
+
+def test_to_static_passthrough_sync_not_frozen():
+    """EMA/target-network sync: a.set_value(b) creates no jaxpr eqn; b must
+    still be a runtime input, not a build-time constant."""
+    a = nn.Linear(3, 3)
+    b = nn.Linear(3, 3)
+
+    @paddle.jit.to_static
+    def sync():
+        a.weight.set_value(b.weight)
+        a.bias.set_value(b.bias)
+
+    sync()
+    np.testing.assert_allclose(a.weight.numpy(), b.weight.numpy())
+    # update source eagerly; the cached program must see the new value
+    b.weight.set_value(np.full((3, 3), 7.0, np.float32))
+    sync()
+    assert len(sync._cache) == 1
+    np.testing.assert_allclose(a.weight.numpy(), np.full((3, 3), 7.0))
+
+
+def test_spectral_norm_power_iteration_live_under_to_static():
+    paddle.seed(0)
+    sn = nn.SpectralNorm([4, 5], dim=0, power_iters=1)
+    w = paddle.to_tensor(np.random.RandomState(0).randn(4, 5).astype("float32"))
+    u0 = sn.weight_u.numpy().copy()
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return sn(x)
+
+    fwd(w)
+    u1 = sn.weight_u.numpy().copy()
+    assert not np.allclose(u0, u1), "power iteration frozen under to_static"
+    fwd(w)
+    u2 = sn.weight_u.numpy().copy()
+    # converges towards the leading singular vector: keeps moving, bounded
+    assert np.isfinite(u2).all() and abs(np.linalg.norm(u2) - 1.0) < 1e-3

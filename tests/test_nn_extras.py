@@ -176,3 +176,42 @@ class TestReviewFixes:
                                    np.asarray(o.numpy())[0], rtol=1e-5)
         # outputs past the valid length are zeroed
         assert np.abs(np.asarray(y[0, 3:].numpy())).sum() == 0
+
+    def test_align_corners_linear_trilinear_nhwc(self):
+        # 1-D linear, NCW: endpoints of a ramp map onto input endpoints
+        ramp = np.arange(5, dtype=np.float32).reshape(1, 1, 5)
+        o = np.asarray(F.interpolate(Tensor(ramp), size=[9], mode="linear",
+                                     align_corners=True,
+                                     data_format="NCW").numpy())[0, 0]
+        np.testing.assert_allclose(o, np.linspace(0, 4, 9), atol=1e-5)
+        # 3-D trilinear, NCDHW
+        x = np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)
+        o3 = np.asarray(F.interpolate(Tensor(x), size=[3, 3, 3],
+                                      mode="trilinear", align_corners=True,
+                                      data_format="NCDHW").numpy())[0, 0]
+        np.testing.assert_allclose(
+            [o3[0, 0, 0], o3[-1, -1, -1], o3[1, 1, 1]],
+            [0.0, 7.0, 3.5], atol=1e-5)
+        # 2-D bilinear, NHWC layout
+        xh = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        oh = np.asarray(F.interpolate(Tensor(xh), size=[7, 7],
+                                      mode="bilinear", align_corners=True,
+                                      data_format="NHWC").numpy())[0, :, :, 0]
+        np.testing.assert_allclose(
+            [oh[0, 0], oh[0, -1], oh[-1, 0], oh[-1, -1]],
+            [0.0, 3.0, 12.0, 15.0], atol=1e-5)
+
+    def test_interpolate_scale_factor_channels_first_1d_3d(self):
+        # NCW with scale_factor: size must derive from W, not C
+        x = np.arange(10, dtype=np.float32).reshape(1, 2, 5)
+        o = np.asarray(F.interpolate(Tensor(x), scale_factor=2, mode="linear",
+                                     align_corners=True,
+                                     data_format="NCW").numpy())
+        assert o.shape == (1, 2, 10), o.shape
+        np.testing.assert_allclose(o[0, 0, [0, -1]], [0.0, 4.0], atol=1e-5)
+        # NCDHW nearest with scale_factor
+        x3 = np.ones((1, 3, 2, 4, 4), np.float32)
+        o3 = np.asarray(F.interpolate(Tensor(x3), scale_factor=2,
+                                      mode="nearest",
+                                      data_format="NCDHW").numpy())
+        assert o3.shape == (1, 3, 4, 8, 8), o3.shape
