@@ -60,6 +60,8 @@ from .core import op_version  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .serialization import save, load  # noqa: F401
 from .hapi.model import Model, summary  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .hapi import hub  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401
 
 from .core.tensor import Tensor as _T

@@ -110,3 +110,105 @@ class LRScheduler(Callback):
             sched = getattr(self.model._optimizer, "_lr", None)
             if sched is not None and sched.scheduler is not None:
                 sched.scheduler.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Shrink the lr when a monitored metric stops improving (reference:
+    hapi/callbacks.py ReduceLROnPlateau:956)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.mode = "min" if mode in ("auto", "min") else "max"
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return
+        try:
+            value = float(value[0] if hasattr(value, "__len__") else value)
+        except (TypeError, ValueError):
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        better = (self.best is None
+                  or (self.mode == "min"
+                      and value < self.best - self.min_delta)
+                  or (self.mode == "max"
+                      and value > self.best + self.min_delta))
+        if better:
+            self.best = value
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = self.model._optimizer
+                old = opt.get_lr()
+                new = max(old * self.factor, self.min_lr)
+                if old - new > 1e-12:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"Epoch {epoch}: reducing learning rate "
+                              f"from {old:.6g} to {new:.6g}.")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference: hapi/callbacks.py VisualDL:841).
+    The visualdl package is not in this environment, so scalars are written
+    as TSV lines (step, tag, value) under log_dir — the same data stream a
+    LogWriter would receive; point any scalar viewer at it."""
+
+    def __init__(self, log_dir):
+        import os
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._files = {}
+        self._steps = {}
+
+    def _write(self, mode, tag, value, step):
+        import os
+        f = self._files.get(mode)
+        if f is None:
+            f = open(os.path.join(self.log_dir, f"{mode}.tsv"), "a")
+            self._files[mode] = f
+        f.write(f"{step}\t{tag}\t{value}\n")
+        f.flush()
+
+    def _log(self, mode, logs, step):
+        for k, v in (logs or {}).items():
+            try:
+                val = float(v[0] if hasattr(v, "__len__") else v)
+            except (TypeError, ValueError):
+                continue
+            self._write(mode, f"{mode}/{k}", val, step)
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode == "train":
+            self._steps[mode] = self._steps.get(mode, 0) + 1
+            self._log(mode, logs, self._steps[mode])
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("train_epoch", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._steps["eval"] = self._steps.get("eval", 0) + 1
+        self._log("eval", logs, self._steps["eval"])
+
+    def __del__(self):
+        for f in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass

@@ -1,0 +1,61 @@
+"""torch.hub-style model loading (reference: python/paddle/hapi/hub.py).
+
+The reference fetches github/gitee archives; this environment has zero
+egress, so the 'github'/'gitee' sources raise a clear error and the
+'local' source — a directory containing `hubconf.py` — is fully supported
+(the reference's local path too, hub.py:170 list/help/load)."""
+import importlib.util
+import os
+import sys
+
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no {MODULE_HUBCONF} found in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    m = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(m)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(m, "dependencies", [])
+    missing = [d for d in deps if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f"hubconf dependencies missing: {missing}")
+    return m
+
+
+def _resolve(repo_dir, source):
+    if source != "local":
+        raise RuntimeError(
+            "only source='local' is supported in this zero-egress "
+            "environment (github/gitee archive fetch needs network)")
+    return repo_dir
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entry-point names exported by the repo's hubconf.py."""
+    m = _import_hubconf(_resolve(repo_dir, source))
+    return [k for k, v in vars(m).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    m = _import_hubconf(_resolve(repo_dir, source))
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no callable entry point {model!r} in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    m = _import_hubconf(_resolve(repo_dir, source))
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no callable entry point {model!r} in hubconf")
+    return fn(**kwargs)

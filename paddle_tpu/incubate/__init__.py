@@ -26,3 +26,52 @@ def softmax_mask_fuse_upper_triangle(x):
         return e / jnp.sum(e, axis=-1, keepdims=True)
 
     return call_op(_fused, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
+def _segment_op(data, segment_ids, kind):
+    import jax.numpy as jnp
+    from ..core.dispatch import call_op, unwrap
+
+    seg = unwrap(segment_ids).astype(jnp.int32)
+
+    def _seg(v):
+        n_seg = seg[-1] + 1 if seg.shape[0] else 0
+        # segment ids are sorted (reference contract); static upper bound =
+        # number of rows, sliced by the caller's expectation
+        n = v.shape[0]
+        if kind == "sum" or kind == "mean":
+            out = jnp.zeros((n,) + v.shape[1:], v.dtype).at[seg].add(v)
+            if kind == "mean":
+                cnt = jnp.zeros((n,), v.dtype).at[seg].add(1.0)
+                out = out / jnp.maximum(cnt, 1.0).reshape(
+                    (-1,) + (1,) * (v.ndim - 1))
+            return out
+        init = -jnp.inf if kind == "max" else jnp.inf
+        out = jnp.full((n,) + v.shape[1:], init, v.dtype)
+        if kind == "max":
+            out = out.at[seg].max(v)
+        else:
+            out = out.at[seg].min(v)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    full = call_op(_seg, data, op_name=f"segment_{kind}")
+    import numpy as _np
+    n_out = int(_np.asarray(seg)[-1]) + 1 if seg.shape[0] else 0
+    return full[:n_out]
+
+
+def segment_sum(data, segment_ids):
+    """reference: incubate segment_pool (operators/segment_pool_op.cc)."""
+    return _segment_op(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids):
+    return _segment_op(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids):
+    return _segment_op(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids):
+    return _segment_op(data, segment_ids, "min")

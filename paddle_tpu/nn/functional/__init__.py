@@ -11,6 +11,7 @@ from .conv import (  # noqa: F401
 )
 from .pooling import (  # noqa: F401
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    max_pool2d_with_index, max_unpool2d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_max_pool2d,
 )
 from .norm import (  # noqa: F401
@@ -22,5 +23,12 @@ from .loss import (  # noqa: F401
     smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
     kl_div, margin_ranking_loss, hinge_embedding_loss, cosine_embedding_loss,
     triplet_margin_loss, square_error_cost, sigmoid_focal_loss, ctc_loss,
+    rank_loss, margin_rank_loss, huber_loss, log_loss, bpr_loss, npair_loss,
+    center_loss, nce, sampled_softmax_with_cross_entropy,
 )
 from .attention import scaled_dot_product_attention  # noqa: F401
+from .vision import (  # noqa: F401
+    affine_grid, grid_sample, temporal_shift, channel_shuffle,
+    shuffle_channel, space_to_depth, affine_channel, local_response_norm,
+    lrn, deformable_conv,
+)

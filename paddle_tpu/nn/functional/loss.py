@@ -321,3 +321,187 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(nll, reduction)
 
     return call_op(_ctc, log_probs, op_name="warpctc")
+
+
+# ------------------------------------------------- fluid loss tail (round 2)
+
+def rank_loss(label, left, right):
+    """RankNet pairwise loss (reference: operators/rank_loss_op.cc):
+    C = -label * (left - right) + log(1 + exp(left - right))."""
+    def _rl(lab, l, r):
+        o = l - r
+        return -lab * o + jnp.log1p(jnp.exp(o))
+    return call_op(_rl, label, left, right, op_name="rank_loss")
+
+
+def margin_rank_loss(label, left, right, margin=0.1):
+    """reference: operators/margin_rank_loss_op.cc:
+    max(0, -label*(left-right) + margin)."""
+    def _mrl(lab, l, r):
+        return jnp.maximum(0.0, -lab * (l - r) + margin)
+    return call_op(_mrl, label, left, right, op_name="margin_rank_loss")
+
+
+def huber_loss(input, label, delta):  # noqa: A002
+    """reference: operators/huber_loss_op.h — elementwise huber residual:
+    0.5*d^2 for |d|<=delta else delta*|d| - 0.5*delta^2."""
+    def _h(x, y):
+        d = y - x
+        ad = jnp.abs(d)
+        return jnp.where(ad <= delta, 0.5 * d * d,
+                         delta * ad - 0.5 * delta * delta)
+    return call_op(_h, input, label, op_name="huber_loss")
+
+
+def log_loss(input, label, epsilon=1e-4):  # noqa: A002
+    """reference: operators/log_loss_op.cc — negative log likelihood of
+    probabilities: -y*log(p+eps) - (1-y)*log(1-p+eps)."""
+    def _ll(p, y):
+        return (-y * jnp.log(p + epsilon)
+                - (1.0 - y) * jnp.log(1.0 - p + epsilon))
+    return call_op(_ll, input, label, op_name="log_loss")
+
+
+def bpr_loss(input, label):  # noqa: A002
+    """Bayesian Personalized Ranking (reference: operators/bpr_loss_op.h):
+    Y[i] = -1/(N-1) * sum_{j != label_i} log(sigmoid(x[i,label_i]-x[i,j]))."""
+    lab = unwrap(label)
+
+    def _bpr(x):
+        n = x.shape[1]
+        idx = jnp.reshape(lab, (-1,)).astype(jnp.int32)
+        pos = jnp.take_along_axis(x, idx[:, None], axis=1)  # [N,1]
+        logsig = jax.nn.log_sigmoid(pos - x)  # [N,D]
+        mask = jax.nn.one_hot(idx, n, dtype=x.dtype)
+        s = jnp.sum(logsig * (1.0 - mask), axis=1, keepdims=True)
+        return -s / (n - 1)
+
+    return call_op(_bpr, input, op_name="bpr_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference: python/paddle/fluid/layers/loss.py:1665 — l2 on embeddings
+    + soft-label CE over the anchor/positive similarity matrix."""
+    lab = unwrap(labels)
+
+    def _np(a, p):
+        eq = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        soft = eq / jnp.sum(eq, axis=1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(a * a, axis=1))
+              + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25 * l2_reg
+        sim = a @ p.T
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce = jnp.mean(-jnp.sum(soft * logp, axis=1))
+        return l2 + ce
+
+    return call_op(_np, anchor, positive, op_name="npair_loss")
+
+
+def center_loss(input, label, num_classes, alpha, centers, update_center=True):  # noqa: A002
+    """reference: operators/center_loss_op.h. `centers` is the [num_classes,
+    D] state tensor (the reference creates it from param_attr); when
+    update_center it is updated in place:
+    c -= alpha * sum_per_class(c - x) / (1 + count)."""
+    lab = jnp.reshape(unwrap(label), (-1,)).astype(jnp.int32)
+
+    def _cl(x, c):
+        diff = x - c[lab]
+        return 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+
+    out = call_op(_cl, input, centers, op_name="center_loss")
+    if update_center:
+        from ...core.dispatch import call_op_nograd
+
+        def _upd(x, c):
+            diff = c[lab] - x  # [N, D]
+            sums = jnp.zeros_like(c).at[lab].add(diff)
+            counts = jnp.zeros((c.shape[0],), x.dtype).at[lab].add(1.0)
+            return c - alpha * sums / (1.0 + counts)[:, None]
+
+        new_c = call_op_nograd(_upd, input, centers, op_name="center_loss_update")
+        centers.set_value(unwrap(new_c))
+    return out
+
+
+def nce(input, label, weight, bias=None, num_total_classes=None,  # noqa: A002
+        num_neg_samples=10, sampler="uniform", custom_dist=None, seed=None):
+    """Noise-contrastive estimation loss (reference: operators/nce_op.h).
+    Functional form: class embeddings are explicit (`weight` [C, D],
+    `bias` [C]) instead of the fluid layer's internally-created params.
+    Returns [B, 1] per-sample loss."""
+    from ...core import random as core_random
+
+    num_total_classes = (num_total_classes if num_total_classes is not None
+                         else int(unwrap(weight).shape[0]))
+    lab = jnp.reshape(unwrap(label), (-1,)).astype(jnp.int32)
+    key = core_random.next_key() if seed is None else jax.random.PRNGKey(seed)
+
+    if custom_dist is not None:
+        probs = jnp.asarray(unwrap(custom_dist), jnp.float32)
+        probs = probs / jnp.sum(probs)
+        samples = jax.random.categorical(
+            key, jnp.log(probs + 1e-20), shape=(num_neg_samples,))
+        q = probs
+    elif sampler == "log_uniform":
+        # P(k) ∝ log((k+2)/(k+1)), the reference's LogUniformSampler
+        ks = jnp.arange(num_total_classes, dtype=jnp.float32)
+        probs = jnp.log((ks + 2.0) / (ks + 1.0))
+        probs = probs / jnp.sum(probs)
+        samples = jax.random.categorical(
+            key, jnp.log(probs), shape=(num_neg_samples,))
+        q = probs
+    else:
+        samples = jax.random.randint(key, (num_neg_samples,), 0,
+                                     num_total_classes)
+        q = jnp.full((num_total_classes,), 1.0 / num_total_classes)
+
+    def _nce(x, w, *rest):
+        b = rest[0] if bias is not None else None
+        k = float(num_neg_samples)
+        pos_w = w[lab]                       # [B, D]
+        s_pos = jnp.sum(x * pos_w, axis=1)   # [B]
+        if b is not None:
+            s_pos = s_pos + b[lab]
+        neg_w = w[samples]                   # [S, D]
+        s_neg = x @ neg_w.T                  # [B, S]
+        if b is not None:
+            s_neg = s_neg + b[samples]
+        # logit corrections: sigma(s - log(k*q))
+        pos_logit = s_pos - jnp.log(k * q[lab] + 1e-20)
+        neg_logit = s_neg - jnp.log(k * q[samples] + 1e-20)[None, :]
+        loss = (-jax.nn.log_sigmoid(pos_logit)
+                - jnp.sum(jax.nn.log_sigmoid(-neg_logit), axis=1))
+        return loss[:, None]
+
+    args = (input, weight) + ((bias,) if bias is not None else ())
+    return call_op(_nce, *args, op_name="nce")
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples, seed=None):
+    """Softmax CE over {true, sampled} class subset (reference:
+    python/paddle/fluid/layers/loss.py:1028 + operators/sample_logits_op).
+    Uniform candidate sampling with logQ correction; returns [N, 1]."""
+    from ...core import random as core_random
+
+    lab = unwrap(label)
+    if lab.ndim == 2:
+        lab = lab[:, 0]
+    lab = lab.astype(jnp.int32)
+    key = core_random.next_key() if seed is None else jax.random.PRNGKey(seed)
+
+    def _ssce(lg):
+        n, c = lg.shape
+        samples = jax.random.randint(key, (num_samples,), 0, c)
+        q = 1.0 / c
+        true_logit = jnp.take_along_axis(lg, lab[:, None], axis=1)  # [N,1]
+        samp_logit = lg[:, samples]                                  # [N,S]
+        # remove accidental hits: a sampled class equal to the true label
+        # would double-count — mask it to -inf
+        acc = samples[None, :] == lab[:, None]
+        samp_logit = jnp.where(acc, -jnp.inf, samp_logit)
+        corr = jnp.log(num_samples * q)
+        cat = jnp.concatenate([true_logit - corr, samp_logit - corr], axis=1)
+        logp = jax.nn.log_softmax(cat, axis=1)
+        return -logp[:, :1]
+
+    return call_op(_ssce, logits, op_name="sampled_softmax_with_cross_entropy")

@@ -3,7 +3,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...core.dispatch import call_op
+from ...core.dispatch import call_op, unwrap
 from .conv import _pair, _conv_padding
 
 
@@ -15,14 +15,29 @@ def _pool_nd(x, kernel_size, stride, padding, nd, reducer, init, data_format,
     channel_last = data_format.endswith("C") and data_format[1] != "C"
 
     def _window(v):
+        sp_pads = pad if isinstance(pad, list) else [(0, 0)] * nd
+        if ceil_mode and not isinstance(pad, str):
+            # extend the trailing pad so partial windows are kept:
+            # out = ceil((size + p0 + p1 - k)/s) + 1. reduce_window pads
+            # with the reduction's init value, so max/sum stay correct and
+            # the avg 'counts' window (ones reduced with the same pads)
+            # keeps excluding the extension.
+            sp_shape = (v.shape[1:1 + nd] if channel_last
+                        else v.shape[2:2 + nd])
+            ext = []
+            for size, (p0, p1), k, s in zip(sp_shape, sp_pads, ks, st):
+                num = size + p0 + p1 - k
+                out = -(-num // s) + 1
+                ext.append((p0, max(p1, (out - 1) * s + k - size - p0)))
+            sp_pads = ext
         if channel_last:
             dims = (1,) + ks + (1,)
             strides = (1,) + st + (1,)
-            pads = [(0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * nd) + [(0, 0)]
+            pads = [(0, 0)] + sp_pads + [(0, 0)]
         else:
             dims = (1, 1) + ks
             strides = (1, 1) + st
-            pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * nd)
+            pads = [(0, 0), (0, 0)] + sp_pads
         if isinstance(pad, str):
             pads = pad
         return dims, strides, pads
@@ -54,11 +69,106 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                data_format="NCHW", return_mask=False):
-    out = _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
-                   -jnp.inf, data_format, ceil_mode, name="max_pool2d")
     if return_mask:
-        raise NotImplementedError("return_mask not supported yet")
-    return out
+        return max_pool2d_with_index(x, kernel_size, stride, padding,
+                                     ceil_mode=ceil_mode,
+                                     data_format=data_format)
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
+                    -jnp.inf, data_format, ceil_mode, name="max_pool2d")
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          ceil_mode=False, data_format="NCHW"):
+    """Max pool returning (out, mask) where mask holds flat H*W argmax
+    indices into the input (reference: operators/max_pool_with_index_op.cc).
+    Tap-wise strided slices + argmax — no scratch im2col. The pooled
+    output is recovered from the mask with one gather, so the window
+    reduction runs once."""
+    assert data_format == "NCHW", "mask path is NCHW (reference kernel too)"
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride if stride is not None else kernel_size, 2)
+    pd = _conv_padding(padding, 2)
+    if isinstance(pd, str):
+        raise ValueError("string padding unsupported with return_mask")
+    (pt, pb), (pl, pr) = pd
+
+    def _out_dim(size, pad0, pad1, k, s):
+        num = size + pad0 + pad1 - k
+        return (num + s - 1) // s + 1 if ceil_mode else num // s + 1
+
+    def _mask(v):
+        N, C, H, W = v.shape
+        ho = _out_dim(H, pt, pb, ks[0], st[0])
+        wo = _out_dim(W, pl, pr, ks[1], st[1])
+        # extend bottom/right padding so every (incl. ceil-mode) window is
+        # in-bounds of the padded array
+        pb2 = max(pb, (ho - 1) * st[0] + ks[0] - H - pt)
+        pr2 = max(pr, (wo - 1) * st[1] + ks[1] - W - pl)
+        vp = jnp.pad(v, ((0, 0), (0, 0), (pt, pb2), (pl, pr2)),
+                     constant_values=-jnp.inf)
+        # flat input index of every padded position (out-of-input = -1,
+        # never the argmax since its value is -inf)
+        iy = jnp.arange(-pt, H + pb2)
+        ix = jnp.arange(-pl, W + pr2)
+        flat = jnp.where((iy[:, None] >= 0) & (iy[:, None] < H)
+                         & (ix[None, :] >= 0) & (ix[None, :] < W),
+                         iy[:, None] * W + ix[None, :], -1)
+        taps, tap_idx = [], []
+        for ky in range(ks[0]):
+            for kx in range(ks[1]):
+                sl = vp[:, :, ky:ky + (ho - 1) * st[0] + 1:st[0],
+                        kx:kx + (wo - 1) * st[1] + 1:st[1]]
+                taps.append(sl)
+                tap_idx.append(flat[ky:ky + (ho - 1) * st[0] + 1:st[0],
+                                    kx:kx + (wo - 1) * st[1] + 1:st[1]])
+        stacked = jnp.stack(taps)            # [taps, N, C, ho, wo]
+        idxs = jnp.stack(tap_idx)            # [taps, ho, wo]
+        arg = jnp.argmax(stacked, axis=0)    # [N, C, ho, wo]
+        mask = jnp.take_along_axis(
+            idxs[:, None, None], arg[None], axis=0)[0]
+        return mask.astype(jnp.int32)
+
+    from ...core.dispatch import call_op_nograd
+    mask = call_op_nograd(_mask, x, op_name="max_pool2d_index")
+    midx = unwrap(mask)
+
+    def _gather(v):
+        N, C, H, W = v.shape
+        flat = jnp.reshape(v, (N, C, H * W))
+        safe = jnp.maximum(midx, 0).reshape(N, C, -1)
+        out = jnp.take_along_axis(flat, safe, axis=2)
+        return out.reshape(midx.shape)
+
+    out = call_op(_gather, x, op_name="max_pool2d")
+    return out, mask
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None):
+    """Scatter pooled values back to their argmax positions (reference:
+    operators/unpool_op.cc); default output size
+    (in-1)*stride - 2*padding + kernel."""
+    assert data_format == "NCHW"
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride if stride is not None else kernel_size, 2)
+    pad = _pair(padding, 2)
+    idx = unwrap(indices)
+
+    def _unpool(v):
+        N, C, ho, wo = v.shape
+        if output_size is not None:
+            H, W = output_size[-2:]
+        else:
+            H = (ho - 1) * st[0] - 2 * pad[0] + ks[0]
+            W = (wo - 1) * st[1] - 2 * pad[1] + ks[1]
+        flat = jnp.reshape(v, (N, C, ho * wo))
+        fidx = jnp.reshape(idx, (N, C, ho * wo)).astype(jnp.int32)
+        out = jnp.zeros((N, C, H * W), v.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, val: o.at[i].set(val)))(out, fidx, flat)
+        return jnp.reshape(out, (N, C, H, W))
+
+    return call_op(_unpool, x, op_name="max_unpool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,

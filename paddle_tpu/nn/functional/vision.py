@@ -1,0 +1,285 @@
+"""Spatial-transform / vision functionals.
+
+Reference: `operators/affine_grid_op.cc`, `grid_sampler_op.cc`,
+`temporal_shift_op.cc`, `shuffle_channel_op.cc`, `space_to_depth_op.cc`,
+`affine_channel_op.cc`, `lrn_op.cc`, `deformable_conv_op.cc` — all lowered
+to gather/segment arithmetic that XLA tiles; no im2col scratch buffers.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import call_op, unwrap
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta [N,2,3] -> sampling grid [N,H,W,2] of normalized (x,y)
+    (reference: operators/affine_grid_op.cc)."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(s) for s in out_shape.numpy()]
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def _ag(t):
+        def axis_coords(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size, dtype=t.dtype)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size,
+                                dtype=t.dtype)
+
+        xs = axis_coords(w)
+        ys = axis_coords(h)
+        gx, gy = jnp.meshgrid(xs, ys)  # [H,W]
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+        # out[n,h,w,k] = sum_j base[h,w,j] * theta[n,k,j]
+        return jnp.einsum("hwj,nkj->nhwk", base, t)
+
+    return call_op(_ag, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample input [N,C,H,W] at normalized grid [N,Hg,Wg,(x,y)]
+    (reference: operators/grid_sampler_op.cc)."""
+
+    def _gs(v, g):
+        N, C, H, W = v.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+
+        def unnormalize(coord, size):
+            if align_corners:
+                return (coord + 1.0) / 2.0 * (size - 1)
+            return ((coord + 1.0) * size - 1.0) / 2.0
+
+        fx = unnormalize(gx, W)
+        fy = unnormalize(gy, H)
+
+        def reflect(coord, size):
+            if align_corners:
+                span = 2.0 * (size - 1)
+                if size == 1:
+                    return jnp.zeros_like(coord)
+                c = jnp.mod(jnp.abs(coord), span)
+                return jnp.where(c > (size - 1), span - c, c)
+            span = 2.0 * size
+            c = jnp.mod(jnp.abs(coord + 0.5), span)
+            c = jnp.where(c > size, span - c, c) - 0.5
+            return jnp.clip(c, 0, size - 1)
+
+        if padding_mode == "border":
+            fx = jnp.clip(fx, 0, W - 1)
+            fy = jnp.clip(fy, 0, H - 1)
+        elif padding_mode == "reflection":
+            fx = reflect(fx, W)
+            fy = reflect(fy, H)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, H - 1)
+            ixc = jnp.clip(ix, 0, W - 1)
+            # v [N,C,H,W]; iy/ix [N,Hg,Wg] -> out [N,C,Hg,Wg]
+            out = v[jnp.arange(N)[:, None, None, None],
+                    jnp.arange(C)[None, :, None, None],
+                    iyc[:, None], ixc[:, None]]
+            if padding_mode == "zeros":
+                inb = ((iy >= 0) & (iy <= H - 1) & (ix >= 0)
+                       & (ix <= W - 1))[:, None]
+                out = jnp.where(inb, out, 0.0)
+            return out
+
+        if mode == "nearest":
+            return gather(jnp.round(fy).astype(jnp.int32),
+                          jnp.round(fx).astype(jnp.int32))
+
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = (fx - x0)[:, None]
+        wy = (fy - y0)[:, None]
+        x0i = x0.astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        tl = gather(y0i, x0i)
+        tr = gather(y0i, x0i + 1)
+        bl = gather(y0i + 1, x0i)
+        br = gather(y0i + 1, x0i + 1)
+        top = tl * (1 - wx) + tr * wx
+        bot = bl * (1 - wx) + br * wx
+        return top * (1 - wy) + bot * wy
+
+    return call_op(_gs, x, grid, op_name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM channel shift across the time axis (reference:
+    operators/temporal_shift_op.cc). x: [N*T, C, H, W]."""
+
+    def _ts(v):
+        val = v
+        if data_format == "NHWC":
+            val = jnp.transpose(val, (0, 3, 1, 2))
+        nt, c, h, w = val.shape
+        n = nt // seg_num
+        val = val.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.pad(val, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        back = pad[:, :seg_num, :c1]          # shift left (from t+1 ... )
+        fwd = pad[:, 2:, c1:c2]               # shift right (from t-1 ... )
+        keep = val[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return call_op(_ts, x, op_name="temporal_shift")
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    """reference: operators/shuffle_channel_op.cc."""
+
+    def _cs(v):
+        if data_format == "NHWC":
+            n, h, w, c = v.shape
+            return v.reshape(n, h, w, groups, c // groups) \
+                    .swapaxes(3, 4).reshape(n, h, w, c)
+        n, c, h, w = v.shape
+        return v.reshape(n, groups, c // groups, h, w) \
+                .swapaxes(1, 2).reshape(n, c, h, w)
+
+    return call_op(_cs, x, op_name="channel_shuffle")
+
+
+shuffle_channel = channel_shuffle  # fluid name
+
+
+def space_to_depth(x, blocksize):
+    """reference: operators/space_to_depth_op.cc (NCHW)."""
+
+    def _s2d(v):
+        n, c, h, w = v.shape
+        b = blocksize
+        v = v.reshape(n, c, h // b, b, w // b, b)
+        v = jnp.transpose(v, (0, 3, 5, 1, 2, 4))
+        return v.reshape(n, c * b * b, h // b, w // b)
+
+    return call_op(_s2d, x, op_name="space_to_depth")
+
+
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    """Per-channel y = scale*x + bias (reference:
+    operators/affine_channel_op.cc)."""
+
+    def _ac(v, s, b):
+        if data_format == "NHWC":
+            return v * s + b
+        return v * s[:, None, None] + b[:, None, None]
+
+    return call_op(_ac, x, scale, bias, op_name="affine_channel")
+
+
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    """LRN across channels (reference: operators/lrn_op.cc; fluid alpha is
+    already divided by n there — here alpha follows the 2.x API: the sum is
+    scaled by alpha/size)."""
+
+    def _lrn(v):
+        val = v if data_format == "NCHW" else jnp.moveaxis(v, -1, 1)
+        sq = jnp.square(val)
+        c = val.shape[1]
+        half = size // 2
+        pad = jnp.pad(sq, ((0, 0), (half, size - 1 - half), (0, 0), (0, 0)))
+        den = sum(pad[:, i:i + c] for i in range(size))
+        out = val / jnp.power(k + alpha / size * den, beta)
+        return out if data_format == "NCHW" else jnp.moveaxis(out, 1, -1)
+
+    return call_op(_lrn, x, op_name="local_response_norm")
+
+
+def lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
+    """fluid signature (reference: fluid/layers/nn.py lrn): alpha scales each
+    squared term directly (not divided by n)."""
+    return local_response_norm(x, size=n, alpha=alpha * n, beta=beta, k=k,
+                               data_format=data_format)
+
+
+def deformable_conv(x, offset, weight, bias=None, stride=1, padding=0,
+                    dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable convolution v1 (mask=None) / v2 (modulated)
+    (reference: operators/deformable_conv_op.cc, deformable_conv_v1_op.cc).
+
+    x [N,Cin,H,W]; offset [N, 2*dg*kh*kw, Ho, Wo] (y,x interleaved per tap);
+    mask [N, dg*kh*kw, Ho, Wo]; weight [Cout, Cin/groups, kh, kw].
+    Implemented as bilinear gather per kernel tap + grouped matmul."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    have_mask = mask is not None
+
+    def _dc(v, off, w, *rest):
+        it = iter(rest)
+        m = next(it) if have_mask else None
+        b = next(it, None)
+        N, Cin, H, W = v.shape
+        Cout, Cin_g, kh, kw = w.shape
+        Ho = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        dg = deformable_groups
+        off = off.reshape(N, dg, kh * kw, 2, Ho, Wo)
+        if m is not None:
+            m = m.reshape(N, dg, kh * kw, Ho, Wo)
+
+        oy = jnp.arange(Ho) * s[0] - p[0]
+        ox = jnp.arange(Wo) * s[1] - p[1]
+        cols = []
+        cpg = Cin // dg  # channels per deformable group
+        for ky in range(kh):
+            for kw_i in range(kw):
+                tap = ky * kw + kw_i
+                base_y = (oy + ky * d[0])[None, None, :, None]
+                base_x = (ox + kw_i * d[1])[None, None, None, :]
+                fy = base_y + off[:, :, tap, 0]  # [N,dg,Ho,Wo]
+                fx = base_x + off[:, :, tap, 1]
+                y0 = jnp.floor(fy)
+                x0 = jnp.floor(fx)
+                wy = fy - y0
+                wx = fx - x0
+                y0i = y0.astype(jnp.int32)
+                x0i = x0.astype(jnp.int32)
+
+                def samp(iy, ix):
+                    iyc = jnp.clip(iy, 0, H - 1)
+                    ixc = jnp.clip(ix, 0, W - 1)
+                    # v regrouped [N,dg,cpg,H,W]; index per (N,dg,Ho,Wo)
+                    vg = v.reshape(N, dg, cpg, H, W)
+                    out = vg[jnp.arange(N)[:, None, None, None, None],
+                             jnp.arange(dg)[None, :, None, None, None],
+                             jnp.arange(cpg)[None, None, :, None, None],
+                             iyc[:, :, None], ixc[:, :, None]]
+                    inb = ((iy >= 0) & (iy <= H - 1) & (ix >= 0)
+                           & (ix <= W - 1))[:, :, None]
+                    return jnp.where(inb, out, 0.0)
+
+                val = (samp(y0i, x0i) * ((1 - wy) * (1 - wx))[:, :, None]
+                       + samp(y0i, x0i + 1) * ((1 - wy) * wx)[:, :, None]
+                       + samp(y0i + 1, x0i) * (wy * (1 - wx))[:, :, None]
+                       + samp(y0i + 1, x0i + 1) * (wy * wx)[:, :, None])
+                if m is not None:
+                    val = val * m[:, :, tap][:, :, None]
+                cols.append(val.reshape(N, Cin, Ho, Wo))
+        # cols: kh*kw entries [N,Cin,Ho,Wo] -> [N, Cin*kh*kw, Ho*Wo]
+        col = jnp.stack(cols, axis=2).reshape(N, Cin * kh * kw, Ho * Wo)
+        wmat = w.reshape(Cout, Cin_g * kh * kw)
+        if groups == 1:
+            out = jnp.einsum("ok,nkl->nol", wmat, col)
+        else:
+            col = col.reshape(N, groups, (Cin // groups) * kh * kw, Ho * Wo)
+            wg = wmat.reshape(groups, Cout // groups, Cin_g * kh * kw)
+            out = jnp.einsum("gok,ngkl->ngol", wg, col) \
+                     .reshape(N, Cout, Ho * Wo)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    args = (x, offset, weight) + ((mask,) if have_mask else ()) \
+        + ((bias,) if bias is not None else ())
+    return call_op(_dc, *args, op_name="deformable_conv")

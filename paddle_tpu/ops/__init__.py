@@ -70,6 +70,17 @@ def _patch_tensor():
     ]:
         setattr(T, name, _make_method(getattr(manipulation, name)))
 
+    from . import extras
+    for name in [
+        "median", "kthvalue", "mode", "quantile", "nanmedian", "histogram",
+        "bincount", "unique_consecutive", "diff", "trace", "kron", "outer",
+        "cross", "diagonal", "rot90", "lerp", "trunc", "frac", "nanmean",
+        "nansum", "deg2rad", "rad2deg", "gcd", "lcm", "heaviside",
+        "digamma", "lgamma", "conj", "real", "imag", "mv", "dist",
+        "increment", "unbind",
+    ]:
+        setattr(T, name, _make_method(getattr(extras, name)))
+
     T.astype = lambda self, dtype: math.cast(self, dtype)
     T.t = lambda self: math.t(self)
     T.T = property(lambda self: math.t(self))

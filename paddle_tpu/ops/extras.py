@@ -17,6 +17,9 @@ __all__ = [
     "trace", "kron", "outer", "cross", "diagonal", "rot90",
     "searchsorted", "bucketize", "take", "lerp", "trunc", "frac",
     "nanmean", "nansum", "deg2rad", "rad2deg", "gcd", "lcm", "heaviside",
+    "digamma", "lgamma", "conj", "real", "imag", "mv", "dist", "increment",
+    "unbind", "broadcast_tensors", "multiplex", "crop", "squared_l2_norm",
+    "cvm", "data_norm",
 ]
 
 
@@ -267,3 +270,167 @@ def lcm(x, y):
 
 def heaviside(x, y):
     return call_op(jnp.heaviside, x, y, op_name="heaviside")
+
+
+# ------------------------------------------------------- math tail (round 2)
+
+def digamma(x):
+    """reference: operators/digamma_op.cc."""
+    return call_op(lambda v: jax.scipy.special.digamma(v), x,
+                   op_name="digamma")
+
+
+def lgamma(x):
+    """reference: operators/lgamma_op.cc."""
+    return call_op(lambda v: jax.scipy.special.gammaln(v), x,
+                   op_name="lgamma")
+
+
+def conj(x):
+    """reference: operators/conj_op.cc."""
+    return call_op_nograd(lambda v: jnp.conj(v), x, op_name="conj")
+
+
+def real(x):
+    """reference: operators/real_op.cc."""
+    return call_op_nograd(lambda v: jnp.real(v), x, op_name="real")
+
+
+def imag(x):
+    """reference: operators/imag_op.cc."""
+    return call_op_nograd(lambda v: jnp.imag(v), x, op_name="imag")
+
+
+def mv(x, vec):
+    """Matrix-vector product (reference: operators/mv_op.cc)."""
+    return call_op(lambda m, v: jnp.matmul(m, v), x, vec, op_name="mv")
+
+
+def dist(x, y, p=2):
+    """p-norm of (x - y) (reference: operators/dist_op.cc)."""
+    pv = float(p)
+
+    def _dist(a, b):
+        d = jnp.abs(a - b)
+        if pv == float("inf"):
+            return jnp.max(d)
+        if pv == float("-inf"):
+            return jnp.min(d)
+        if pv == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        return jnp.power(jnp.sum(jnp.power(d, pv)), 1.0 / pv)
+
+    return call_op(_dist, x, y, op_name="dist")
+
+
+def increment(x, value=1.0):
+    """reference: operators/increment_op.cc (fluid in-place counter; 2.x
+    returns the incremented tensor)."""
+    return call_op(lambda v: v + jnp.asarray(value, v.dtype), x,
+                   op_name="increment")
+
+
+def unbind(x, axis=0):
+    """Split along axis removing it (reference: operators/unbind_op.cc)."""
+    n = jnp.shape(unwrap(x))[axis]
+
+    def _unbind(v):
+        return tuple(jnp.squeeze(p, axis=axis)
+                     for p in jnp.split(v, n, axis=axis))
+
+    out = call_op(_unbind, x, op_name="unbind")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def broadcast_tensors(inputs):
+    """reference: operators/broadcast_tensors_op.cc."""
+    shapes = [tuple(jnp.shape(unwrap(t))) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+
+    def _bt(*vals):
+        return tuple(jnp.broadcast_to(v, out_shape) for v in vals)
+
+    out = call_op(_bt, *inputs, op_name="broadcast_tensors")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def multiplex(inputs, index):
+    """Row-wise select among candidate tensors: out[i] = inputs[index[i]][i]
+    (reference: operators/multiplex_op.cc)."""
+    idx = unwrap(index)
+
+    def _mp(*vals):
+        stacked = jnp.stack(vals, axis=0)  # [n, batch, ...]
+        sel = jnp.reshape(idx, (-1,)).astype(jnp.int32)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[sel, rows]
+
+    return call_op(_mp, *inputs, op_name="multiplex")
+
+
+def crop(x, shape=None, offsets=None):
+    """Static slice by offsets/shape (reference: operators/crop_tensor_op.cc).
+    -1 in `shape` keeps the remainder of that axis; None offsets = zeros."""
+    v = unwrap(x)
+    in_shape = tuple(v.shape)
+    if shape is None:
+        shape = list(in_shape)
+    shape = [int(s) for s in (shape.numpy() if hasattr(shape, "numpy")
+                              else shape)]
+    if offsets is None:
+        offsets = [0] * len(in_shape)
+    offsets = [int(o) for o in (offsets.numpy() if hasattr(offsets, "numpy")
+                                else offsets)]
+    shape = [in_shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return call_op(lambda val: val[idx], x, op_name="crop")
+
+
+def squared_l2_norm(x):
+    """reference: operators/squared_l2_norm_op.cc (grad-clip helper)."""
+    return call_op(lambda v: jnp.sum(jnp.square(v)), x,
+                   op_name="squared_l2_norm")
+
+
+def cvm(input, cvm_input=None, use_cvm=True):  # noqa: A002
+    """Continuous-value-model feature transform (reference:
+    operators/cvm_op.h): with use_cvm the first two columns (show, click)
+    become log(show+1), log(click+1)-log(show+1); otherwise they are
+    dropped."""
+
+    def _cvm(v):
+        if use_cvm:
+            c0 = jnp.log(v[:, 0:1] + 1.0)
+            c1 = jnp.log(v[:, 1:2] + 1.0) - c0
+            return jnp.concatenate([c0, c1, v[:, 2:]], axis=1)
+        return v[:, 2:]
+
+    return call_op(_cvm, input, op_name="cvm")
+
+
+def data_norm(input, batch_size, batch_sum, batch_square_sum,  # noqa: A002
+              epsilon=1e-4, do_model_average_for_mean_and_var=True,
+              update_stats=True, summary_decay_rate=0.9999999):
+    """CTR data normalization (reference: operators/data_norm_op.cc):
+    y = (x - mean) * scale with mean = batch_sum/batch_size and
+    scale = sqrt(batch_size / batch_square_sum), per feature. The three
+    summary tensors are framework state (the reference's persistable
+    parameters); update_stats accumulates the current minibatch into them
+    the way the reference's in-kernel SGD summary update does."""
+    def _dn(v, bs, bsum, bsq):
+        mean = bsum / bs
+        scale = jnp.sqrt(bs / (bsq + epsilon))
+        return (v - mean) * scale
+
+    out = call_op(_dn, input, batch_size, batch_sum, batch_square_sum,
+                  op_name="data_norm")
+    if update_stats:
+        v = unwrap(input)
+        n = v.shape[0]
+        dr = summary_decay_rate
+        batch_size.set_value(unwrap(batch_size) * dr + n)
+        batch_sum.set_value(unwrap(batch_sum) * dr + v.sum(axis=0))
+        batch_square_sum.set_value(
+            unwrap(batch_square_sum) * dr + (v ** 2).sum(axis=0))
+    return out

@@ -235,3 +235,126 @@ def sequence_pool(x, lengths, pool_type="average", name=None):
         raise ValueError(f"unknown pool_type {pool_type}")
 
     return call_op(f, x, lengths, op_name=f"sequence_pool_{pool_type}")
+
+
+# --------------------------------------------------- decoding tail (round 2)
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: operators/gather_tree_op.cc).
+    ids/parents: [max_time, batch, beam] -> full beams re-threaded from the
+    final step's parent pointers."""
+    import jax
+
+    def _gt(idv, parv):
+        T = idv.shape[0]
+
+        def step(parent, t):
+            # walking backwards from T-1
+            out = jnp.take_along_axis(idv[t], parent, axis=1)
+            nxt = jnp.take_along_axis(parv[t], parent, axis=1)
+            return nxt, out
+
+        beams = jnp.broadcast_to(jnp.arange(idv.shape[2]), idv.shape[1:])
+        _, outs = jax.lax.scan(step, beams, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+
+    return call_op_nograd(_gt, ids, parents, op_name="gather_tree")
+
+
+def edit_distance(input, label, normalized=True, input_length=None,  # noqa: A002
+                  label_length=None):
+    """Levenshtein distance per batch row (reference:
+    operators/edit_distance_op.h). Padded [B, T] int tensors + lengths;
+    returns (distance [B,1] float, sequence_num)."""
+    import jax
+
+    a = unwrap(input)
+    b = unwrap(label)
+    la = (unwrap(input_length).astype(jnp.int32) if input_length is not None
+          else jnp.full((a.shape[0],), a.shape[1], jnp.int32))
+    lb = (unwrap(label_length).astype(jnp.int32) if label_length is not None
+          else jnp.full((b.shape[0],), b.shape[1], jnp.int32))
+
+    def one(av, bv, na, nb):
+        m = bv.shape[0]
+        init = jnp.arange(m + 1, dtype=jnp.float32)
+        big = jnp.asarray(1e9, jnp.float32)
+
+        def row(prev, i):
+            # prev = dp[i-1, :]; compute dp[i, :] with a scan over j
+            def cell(left, j):
+                up = prev[j + 1]
+                diag = prev[j]
+                cost = jnp.where(av[i] == bv[j], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(up + 1.0, left + 1.0),
+                                  diag + cost)
+                # past label length: carry the boundary value
+                val = jnp.where(j < nb, val, big)
+                return val, val
+
+            first = jnp.asarray(i + 1, jnp.float32)
+            _, rest = jax.lax.scan(cell, first, jnp.arange(m))
+            cur = jnp.concatenate([first[None], rest])
+            cur = jnp.where(i < na, cur, prev)
+            return cur, None
+
+        last, _ = jax.lax.scan(row, init, jnp.arange(av.shape[0]))
+        d = last[nb]
+        if normalized:
+            d = d / jnp.maximum(nb.astype(jnp.float32), 1.0)
+        return d
+
+    def _ed(av, bv):
+        return jax.vmap(one)(av, bv, la, lb)[:, None].astype(jnp.float32)
+
+    dist = call_op_nograd(_ed, a, b, op_name="edit_distance")
+    return dist, wrap(jnp.asarray(a.shape[0], jnp.int32))
+
+
+def ctc_align(input, input_length=None, blank=0, padding_value=0):  # noqa: A002
+    """Merge repeated labels then drop blanks (reference:
+    operators/ctc_align_op.h). Padded [B, T]; returns (aligned [B, T] padded
+    with padding_value, lengths [B])."""
+    x = unwrap(input)
+    B, T = x.shape
+    ln = (unwrap(input_length).astype(jnp.int32) if input_length is not None
+          else jnp.full((B,), T, jnp.int32))
+
+    def _ca(v):
+        pos = jnp.arange(T)
+        valid = pos[None, :] < ln[:, None]
+        prev = jnp.concatenate([jnp.full((B, 1), -1, v.dtype), v[:, :-1]],
+                               axis=1)
+        keep = (v != blank) & (v != prev) & valid
+        # stable compaction: target slot = cumsum(keep)-1
+        slot = jnp.cumsum(keep, axis=1) - 1
+        slot = jnp.where(keep, slot, T)  # dropped -> out-of-range
+        out = jnp.full((B, T + 1), padding_value, v.dtype)
+        rows = jnp.arange(B)[:, None].repeat(T, 1)
+        out = out.at[rows, slot].set(v, mode="drop")
+        return out[:, :T]
+
+    def _lens(v):
+        pos = jnp.arange(T)
+        valid = pos[None, :] < ln[:, None]
+        prev = jnp.concatenate([jnp.full((B, 1), -1, v.dtype), v[:, :-1]],
+                               axis=1)
+        keep = (v != blank) & (v != prev) & valid
+        return jnp.sum(keep, axis=1).astype(jnp.int32)
+
+    return (call_op_nograd(_ca, x, op_name="ctc_align"),
+            call_op_nograd(_lens, x, op_name="ctc_align_len"))
+
+
+def row_conv(input, weight):  # noqa: A002
+    """Lookahead row convolution (reference: operators/row_conv_op.cc):
+    out[b,t,d] = sum_i x[b,t+i,d] * w[i,d] for the future-context window."""
+
+    def _rc(v, w):
+        k = w.shape[0]
+        T = v.shape[1]
+        pad = jnp.pad(v, ((0, 0), (0, k - 1), (0, 0)))
+        out = sum(pad[:, i:i + T] * w[i] for i in range(k))
+        return out
+
+    return call_op(_rc, input, weight, op_name="row_conv")

@@ -161,3 +161,91 @@ def load_inference_model(path_prefix, executor):
     feed_names = getattr(layer, "input_names", None)
     fetch_names = getattr(layer, "output_names", None)
     return layer, feed_names, fetch_names
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Embed a host-python callback in the computation (reference:
+    operators/py_func_op.cc / paddle.static.py_func). `out` declares the
+    result shape/dtype (an InputSpec or template Tensor). Eager calls run
+    the callback directly on host values with a tape node for
+    `backward_func`; under tracing the call lowers to jax.pure_callback
+    (unsupported by backends without host send/recv, e.g. the tunneled
+    axon TPU — use eager mode there)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ..core import autograd
+    from ..core.dispatch import unwrap, wrap
+    from ..core.tensor import Tensor
+
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape),
+                                   np.dtype(getattr(o, "dtype", "float32")
+                                            if not isinstance(o, Tensor)
+                                            else o.numpy().dtype))
+              for o in outs]
+    vals = [unwrap(v) for v in xs]
+    single = not isinstance(out, (list, tuple))
+
+    def host_fwd(*a):
+        res = func(*[np.asarray(v) for v in a])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return [np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                for r, s in zip(res, shapes)]
+
+    traced = any(isinstance(v, jax.core.Tracer) for v in vals)
+    if traced:
+        res = jax.pure_callback(
+            lambda *a: tuple(host_fwd(*a)), tuple(shapes), *vals)
+        res = list(res)
+    else:
+        res = [jnp.asarray(r) for r in host_fwd(*vals)]
+
+    diff_pos = [i for i, t in enumerate(xs)
+                if isinstance(t, Tensor) and not t.stop_gradient]
+    diff = [xs[i] for i in diff_pos]
+    if backward_func is None or not diff or not autograd.grad_enabled():
+        wrapped = [wrap(r) for r in res]
+        return wrapped[0] if single else wrapped
+
+    skip = set()
+    if skip_vars_in_backward_input is not None:
+        sk = (skip_vars_in_backward_input
+              if isinstance(skip_vars_in_backward_input, (list, tuple))
+              else [skip_vars_in_backward_input])
+        skip = {id(t) for t in sk}
+    bwd_in = [v for t, v in zip(xs, vals) if id(t) not in skip]
+    out_vals = list(res)
+
+    def vjp_fn(cots):
+        # reference contract (operators/py_func_op.cc): backward_func
+        # receives (non-skipped inputs) + outputs + output-grads and
+        # returns one gradient per input of x, in x order
+        grads = backward_func(*[np.asarray(v) for v in bwd_in],
+                              *[np.asarray(o) for o in out_vals],
+                              *[np.asarray(c) for c in cots])
+        grads = grads if isinstance(grads, (list, tuple)) else [grads]
+        grads = [None if g is None else jnp.asarray(g) for g in grads]
+        if len(grads) == len(xs):
+            picked = [grads[i] for i in diff_pos]
+        elif len(grads) == len(diff_pos):
+            picked = grads  # already one per differentiable input
+        else:
+            raise ValueError(
+                f"backward_func returned {len(grads)} grads for "
+                f"{len(xs)} inputs ({len(diff_pos)} differentiable)")
+        return tuple(jnp.zeros(np.shape(v), np.asarray(v).dtype)
+                     if g is None else g
+                     for g, v in zip(picked, (vals[i] for i in diff_pos)))
+
+    node = autograd.TapeNode(vjp_fn, diff,
+                             [(tuple(r.shape), r.dtype) for r in res],
+                             name="py_func")
+    wrapped = []
+    for i, r in enumerate(res):
+        t = Tensor(r, stop_gradient=False)
+        t._tape_node = node
+        t._tape_index = i
+        wrapped.append(t)
+    return wrapped[0] if single else wrapped

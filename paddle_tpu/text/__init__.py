@@ -276,3 +276,113 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+def linear_chain_crf(input, label, transition, length=None):  # noqa: A002
+    """Linear-chain CRF log-likelihood (reference:
+    operators/linear_chain_crf_op.h; fluid transition layout [N+2, N]:
+    row 0 = start->tag, row 1 = tag->stop, rows 2+ = square tag->tag).
+
+    input: [B, T, N] emissions (padded), label: [B, T] int tags,
+    length: [B]. Returns the NEGATIVE log-likelihood [B, 1] — the reference
+    kernel's `return -ll` (linear_chain_crf_op.h:223) — usable directly as
+    a cost to minimize."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.dispatch import call_op, unwrap
+
+    lab = unwrap(label).astype(jnp.int32)
+    lens = (unwrap(length).astype(jnp.int32) if length is not None else None)
+
+    def _crf(emis, trans):
+        B, T, N = emis.shape
+        ln = (jnp.full((B,), T, jnp.int32) if lens is None else lens)
+        start, stop, sq = trans[0], trans[1], trans[2:]
+
+        # --- partition function ---
+        alpha0 = emis[:, 0] + start[None, :]
+
+        def step(alpha, x):
+            emit, t = x
+            nxt = jax.scipy.special.logsumexp(
+                alpha[:, :, None] + sq[None, :, :], axis=1) + emit
+            return jnp.where((t < ln)[:, None], nxt, alpha), None
+
+        alpha, _ = jax.lax.scan(
+            step, alpha0, (jnp.moveaxis(emis[:, 1:], 1, 0),
+                           jnp.arange(1, T)))
+        logz = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=1)
+
+        # --- gold score ---
+        t_idx = jnp.arange(T)
+        valid = t_idx[None, :] < ln[:, None]
+        emit_sc = jnp.take_along_axis(emis, lab[..., None], axis=2)[..., 0]
+        emit_sum = jnp.sum(jnp.where(valid, emit_sc, 0.0), axis=1)
+        prev = lab[:, :-1]
+        nxt = lab[:, 1:]
+        tr_sc = sq[prev, nxt]
+        tr_valid = t_idx[None, 1:] < ln[:, None]
+        tr_sum = jnp.sum(jnp.where(tr_valid, tr_sc, 0.0), axis=1)
+        first = lab[:, 0]
+        last = jnp.take_along_axis(lab, (ln - 1)[:, None], axis=1)[:, 0]
+        gold = start[first] + emit_sum + tr_sum + stop[last]
+        return (logz - gold)[:, None]
+
+    return call_op(_crf, input, transition, op_name="linear_chain_crf")
+
+
+def crf_decoding(input, transition, label=None, length=None):  # noqa: A002
+    """Viterbi decode with the fluid [N+2, N] transition layout (reference:
+    operators/crf_decoding_op.h). Returns the best path [B, T]; with
+    `label`, returns 1 where the decoded tag equals the label (the
+    reference's error-indicator mode)."""
+    import jax.numpy as jnp
+    from ..core.dispatch import unwrap, wrap
+    from ..core.tensor import Tensor
+
+    trans = unwrap(transition)
+    N = trans.shape[1]
+    # fold start/stop into an [N, N] problem for viterbi_decode: start goes
+    # into alpha0 via a synthetic BOS/EOS tag pair in its convention, so
+    # decode manually here instead
+    import jax
+
+    emis = unwrap(input)
+    lens = (unwrap(length).astype(jnp.int32) if length is not None else None)
+
+    def _dec(emis):
+        B, T, _ = emis.shape
+        ln = jnp.full((B,), T, jnp.int32) if lens is None else lens
+        start, stop, sq = trans[0], trans[1], trans[2:]
+        alpha0 = emis[:, 0] + start[None, :]
+        ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+
+        def step(alpha, x):
+            emit, t = x
+            scores = alpha[:, :, None] + sq[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)
+            nxt = jnp.max(scores, axis=1) + emit
+            active = (t < ln)[:, None]
+            return (jnp.where(active, nxt, alpha),
+                    jnp.where(active, best_prev, ident))
+
+        alpha, backptrs = jax.lax.scan(
+            step, alpha0, (jnp.moveaxis(emis[:, 1:], 1, 0),
+                           jnp.arange(1, T)))
+        last = jnp.argmax(alpha + stop[None, :], axis=-1)
+
+        def back(tag, bp):
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(back, last, backptrs, reverse=True)
+        path = jnp.concatenate(
+            [jnp.moveaxis(path_rev, 0, 1), last[:, None]], axis=1)
+        # padded slots report tag at the sequence end (consistent carry)
+        return path
+
+    path = _dec(emis)
+    if label is not None:
+        lab = unwrap(label).astype(path.dtype)
+        return wrap((path == lab).astype(jnp.int64))
+    return wrap(path)

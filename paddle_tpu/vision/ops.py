@@ -468,3 +468,218 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
         idxs.append(sel)
     restore = np.argsort(np.concatenate(idxs)).astype(np.int64)
     return outs, wrap(jnp.asarray(restore))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (reference: operators/roi_pool_op.cc): integer bin boundaries,
+    max within each bin — vmapped dense gathers like roi_align above."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(xv, bv):
+        N, C, H, W = xv.shape
+        nums = np.asarray(unwrap(boxes_num))
+        img_of_roi = np.repeat(np.arange(len(nums)), nums)
+        img_idx = jnp.asarray(img_of_roi, jnp.int32)
+
+        def one_roi(box, img):
+            x0 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+            y0 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+            x1 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y1 - y0 + 1, 1)
+            rw = jnp.maximum(x1 - x0 + 1, 1)
+            img_feat = xv[img]  # [C, H, W]
+            # dense [C, ph*ceil, pw*ceil] gather is dynamic; instead gather
+            # per output cell over a fixed max-bin grid: sample every pixel
+            # position of the largest possible bin via clamped indices and
+            # mask out-of-bin entries with -inf before the max
+            gy = jnp.arange(H)
+            gx = jnp.arange(W)
+
+            def one_cell(iy, ix):
+                hstart = y0 + (iy * rh) // ph
+                hend = y0 + ((iy + 1) * rh + ph - 1) // ph
+                wstart = x0 + (ix * rw) // pw
+                wend = x0 + ((ix + 1) * rw + pw - 1) // pw
+                hstart = jnp.clip(hstart, 0, H)
+                hend = jnp.clip(hend, 0, H)
+                wstart = jnp.clip(wstart, 0, W)
+                wend = jnp.clip(wend, 0, W)
+                my = (gy >= hstart) & (gy < hend)
+                mx = (gx >= wstart) & (gx < wend)
+                m = my[:, None] & mx[None, :]
+                masked = jnp.where(m, img_feat, -jnp.inf)
+                out = jnp.max(masked, axis=(1, 2))
+                return jnp.where(jnp.any(m), out, 0.0)
+
+            cells = jax.vmap(lambda iy: jax.vmap(
+                lambda ix: one_cell(iy, ix))(jnp.arange(pw)))(jnp.arange(ph))
+            return jnp.transpose(cells, (2, 0, 1))  # [C, ph, pw]
+
+        return jax.vmap(one_roi)(bv, img_idx)
+
+    return call_op(f, x, boxes, op_name="roi_pool")
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (reference: operators/detection/yolov3_loss_op.h).
+
+    x: [N, mask_num*(5+C), H, W]; gt_box: [N, B, 4] normalized (cx,cy,w,h);
+    gt_label: [N, B] int; gt_score: [N, B] mixup scores (default 1).
+    Returns per-image loss [N]. The reference's per-cell loops become
+    vectorized gathers/scatters; with two gt boxes claiming the same
+    (anchor, cell) the positive score resolves by max instead of the
+    reference's last-write (only differs on exact collisions)."""
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    bias = -0.5 * (scale_x_y - 1.0)
+    lab = unwrap(gt_label).astype(jnp.int32)
+    have_score = gt_score is not None
+
+    def _sce(logit, target):
+        # numerically-stable sigmoid cross entropy (reference
+        # SigmoidCrossEntropy)
+        return (jnp.maximum(logit, 0.0) - logit * target
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    def _loss(xv, gtb, *rest):
+        score = rest[0] if have_score else None
+        N, _, H, W = xv.shape
+        B = gtb.shape[1]
+        input_size = downsample_ratio * H
+        v = xv.reshape(N, mask_num, 5 + class_num, H, W)
+        anc = jnp.asarray(anchors, jnp.float32).reshape(an_num, 2)
+        m_anc = anc[jnp.asarray(anchor_mask, jnp.int32)]  # [mask_num, 2]
+        if score is None:
+            score = jnp.ones((N, B), v.dtype)
+
+        valid = (gtb[..., 2] > 1e-6) & (gtb[..., 3] > 1e-6)  # [N, B]
+
+        # ---- predicted boxes for the ignore pass ----
+        gx = jnp.arange(W, dtype=v.dtype)
+        gy = jnp.arange(H, dtype=v.dtype)
+        px = (gx[None, None, None, :] + jax.nn.sigmoid(v[:, :, 0])
+              * scale_x_y + bias) / W
+        py = (gy[None, None, :, None] + jax.nn.sigmoid(v[:, :, 1])
+              * scale_x_y + bias) / H
+        pw = jnp.exp(v[:, :, 2]) * m_anc[None, :, 0, None, None] / input_size
+        ph = jnp.exp(v[:, :, 3]) * m_anc[None, :, 1, None, None] / input_size
+
+        def iou_cwh(x1, y1, w1, h1, x2, y2, w2, h2):
+            ov_w = (jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+                    - jnp.maximum(x1 - w1 / 2, x2 - w2 / 2))
+            ov_h = (jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+                    - jnp.maximum(y1 - h1 / 2, y2 - h2 / 2))
+            inter = jnp.where((ov_w > 0) & (ov_h > 0), ov_w * ov_h, 0.0)
+            return inter / (w1 * h1 + w2 * h2 - inter + 1e-10)
+
+        # best IoU of each pred box vs all valid gts: [N, mask, H, W]
+        ious = iou_cwh(px[..., None], py[..., None], pw[..., None],
+                       ph[..., None],
+                       gtb[:, None, None, None, :, 0],
+                       gtb[:, None, None, None, :, 1],
+                       gtb[:, None, None, None, :, 2],
+                       gtb[:, None, None, None, :, 3])
+        ious = jnp.where(valid[:, None, None, None, :], ious, 0.0)
+        best_iou = jnp.max(ious, axis=-1) if B else jnp.zeros_like(px)
+        ignored = best_iou > ignore_thresh
+
+        # ---- per-gt best anchor (shape IoU vs ALL anchors) ----
+        aw = anc[:, 0] / input_size
+        ah = anc[:, 1] / input_size
+        shape_iou = iou_cwh(0.0, 0.0, gtb[..., 2:3], gtb[..., 3:4],
+                            0.0, 0.0, aw[None, None, :], ah[None, None, :])
+        best_n = jnp.argmax(shape_iou, axis=-1)  # [N, B]
+        mask_lut = jnp.full((an_num,), -1, jnp.int32)
+        for mi, a in enumerate(anchor_mask):
+            mask_lut = mask_lut.at[a].set(mi)
+        mask_idx = mask_lut[best_n]  # [N, B], -1 when not in this head
+        pos = valid & (mask_idx >= 0)
+
+        gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+        safe_mi = jnp.maximum(mask_idx, 0)
+
+        # gather per-gt channel vector [N, B, 5+C]
+        bidx = jnp.arange(N)[:, None]
+        pred = v[bidx, safe_mi, :, gj, gi]
+
+        tx = gtb[..., 0] * W - gi
+        ty = gtb[..., 1] * H - gj
+        tw = jnp.log(gtb[..., 2] * input_size
+                     / jnp.maximum(anc[best_n, 0], 1e-10) + 1e-10)
+        th = jnp.log(gtb[..., 3] * input_size
+                     / jnp.maximum(anc[best_n, 1], 1e-10) + 1e-10)
+        box_scale = (2.0 - gtb[..., 2] * gtb[..., 3]) * score
+        loc = (_sce(pred[..., 0], tx) + _sce(pred[..., 1], ty)
+               + jnp.abs(pred[..., 2] - tw) + jnp.abs(pred[..., 3] - th))
+        loc_loss = jnp.sum(jnp.where(pos, loc * box_scale, 0.0), axis=1)
+
+        if use_label_smooth:
+            smooth = min(1.0 / class_num, 1.0 / 40)
+            pos_t, neg_t = 1.0 - smooth, smooth
+        else:
+            pos_t, neg_t = 1.0, 0.0
+        cls_target = jnp.where(
+            jax.nn.one_hot(lab, class_num, dtype=v.dtype) > 0, pos_t, neg_t)
+        cls = jnp.sum(_sce(pred[..., 5:], cls_target), axis=-1)
+        cls_loss = jnp.sum(jnp.where(pos, cls * score, 0.0), axis=1)
+
+        # ---- objectness mask: score at positives, -1 ignored, else 0 ----
+        obj_score = jnp.zeros((N, mask_num, H, W), v.dtype)
+        obj_score = obj_score.at[bidx, safe_mi, gj, gi].max(
+            jnp.where(pos, score, 0.0))
+        obj = jnp.where(obj_score > 1e-5, obj_score,
+                        jnp.where(ignored, -1.0, 0.0))
+        pred_obj = v[:, :, 4]
+        obj_loss = jnp.where(
+            obj > 1e-5, _sce(pred_obj, 1.0) * obj,
+            jnp.where(obj > -0.5, _sce(pred_obj, 0.0), 0.0))
+        return loc_loss + cls_loss + jnp.sum(obj_loss, axis=(1, 2, 3))
+
+    args = (x, gt_box) + ((gt_score,) if have_score else ())
+    return call_op(_loss, *args, op_name="yolov3_loss")
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, stride,  # noqa: A002
+                     variances=(0.1, 0.1, 0.2, 0.2), offset=0.5, name=None):
+    """RPN anchor generation (reference:
+    operators/detection/anchor_generator_op.h). Returns (anchors [H, W,
+    num_anchors, 4] xyxy, variances broadcast to the same shape)."""
+    v = unwrap(input)
+    H, W = int(v.shape[2]), int(v.shape[3])
+    sizes = np.asarray(anchor_sizes, np.float32)
+    ratios = np.asarray(aspect_ratios, np.float32)
+    sw, sh = float(stride[0]), float(stride[1])
+
+    ws, hs = [], []
+    for r in ratios:
+        # reference: area = stride_w*stride_h; base w/h from ratio then
+        # scaled per size
+        base_area = sw * sh
+        base_w = np.round(np.sqrt(base_area / r))
+        base_h = np.round(base_w * r)
+        for s in sizes:
+            scale = s / sw
+            scale_h = s / sh
+            ws.append(0.5 * (base_w * scale - 1))
+            hs.append(0.5 * (base_h * scale_h - 1))
+    half_w = jnp.asarray(ws, jnp.float32)
+    half_h = jnp.asarray(hs, jnp.float32)
+    num = half_w.shape[0]
+
+    cx = (jnp.arange(W, dtype=jnp.float32) * sw + offset * sw)
+    cy = (jnp.arange(H, dtype=jnp.float32) * sh + offset * sh)
+    anchors = jnp.stack([
+        jnp.broadcast_to(cx[None, :, None], (H, W, num)) - half_w,
+        jnp.broadcast_to(cy[:, None, None], (H, W, num)) - half_h,
+        jnp.broadcast_to(cx[None, :, None], (H, W, num)) + half_w,
+        jnp.broadcast_to(cy[:, None, None], (H, W, num)) + half_h,
+    ], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, num, 4))
+    return wrap(anchors), wrap(var)

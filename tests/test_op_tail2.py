@@ -1,0 +1,464 @@
+"""Round-2 op-breadth tail: math extras, loss tail, spatial/vision ops,
+decoding/CRF/sampled-softmax, segment pool. Numpy-reference checks plus
+spot grad checks through the tape."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.incubate as incubate
+from paddle_tpu.ops import sequence as seq
+from paddle_tpu.core.tensor import Tensor
+
+rng = np.random.RandomState(7)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestMathTail:
+    def test_gamma_funcs(self):
+        x = t(np.array([0.5, 1.0, 2.5], np.float32))
+        from scipy import special as sp  # scipy is available with jax
+        np.testing.assert_allclose(paddle.digamma(x).numpy(),
+                                   sp.digamma([0.5, 1, 2.5]), rtol=1e-5)
+        np.testing.assert_allclose(paddle.lgamma(x).numpy(),
+                                   sp.gammaln([0.5, 1, 2.5]), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_complex_parts(self):
+        x = t(np.array([1 + 2j, 3 - 4j], np.complex64))
+        np.testing.assert_allclose(paddle.real(x).numpy(), [1, 3])
+        np.testing.assert_allclose(paddle.imag(x).numpy(), [2, -4])
+        np.testing.assert_allclose(paddle.conj(x).numpy(), [1 - 2j, 3 + 4j])
+
+    def test_mv_dist_increment(self):
+        m = rng.rand(3, 4).astype(np.float32)
+        v = rng.rand(4).astype(np.float32)
+        np.testing.assert_allclose(paddle.mv(t(m), t(v)).numpy(), m @ v,
+                                   rtol=1e-5)
+        a = rng.rand(5).astype(np.float32)
+        b = rng.rand(5).astype(np.float32)
+        np.testing.assert_allclose(paddle.dist(t(a), t(b), p=2).numpy(),
+                                   np.linalg.norm(a - b), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.dist(t(a), t(b), p=float("inf")).numpy(),
+            np.abs(a - b).max(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.increment(t(a), 2.0).numpy(), a + 2)
+
+    def test_unbind_broadcast_multiplex_crop(self):
+        x = rng.rand(2, 3).astype(np.float32)
+        parts = paddle.unbind(t(x), axis=1)
+        assert len(parts) == 3
+        np.testing.assert_allclose(parts[1].numpy(), x[:, 1])
+        outs = paddle.broadcast_tensors([t(np.ones((1, 3), np.float32)),
+                                         t(np.ones((2, 1), np.float32))])
+        assert outs[0].shape == [2, 3] and outs[1].shape == [2, 3]
+        sel = paddle.multiplex([t(x), t(x * 10)], t(np.array([1, 0])))
+        np.testing.assert_allclose(sel.numpy(), np.stack([x[0] * 10, x[1]]))
+        c = paddle.crop(t(x), shape=[1, -1], offsets=[1, 1])
+        np.testing.assert_allclose(c.numpy(), x[1:2, 1:])
+        np.testing.assert_allclose(
+            paddle.ops.extras.squared_l2_norm(t(x)).numpy(),
+            (x ** 2).sum(), rtol=1e-5)
+
+    def test_dist_grad(self):
+        a = t(rng.rand(4).astype(np.float32))
+        a.stop_gradient = False
+        loss = paddle.dist(a, t(np.zeros(4, np.float32)), p=2)
+        loss.backward()
+        np.testing.assert_allclose(
+            a.grad.numpy(), a.numpy() / np.linalg.norm(a.numpy()), rtol=1e-4)
+
+
+class TestLossTail:
+    def test_rank_and_margin_rank(self):
+        lab = t(np.array([[1.0], [0.0]], np.float32))
+        l = t(np.array([[0.5], [0.2]], np.float32))
+        r = t(np.array([[0.3], [0.6]], np.float32))
+        o = (l.numpy() - r.numpy())
+        want = -lab.numpy() * o + np.log1p(np.exp(o))
+        np.testing.assert_allclose(F.rank_loss(lab, l, r).numpy(), want,
+                                   rtol=1e-5)
+        want2 = np.maximum(0, -lab.numpy() * o + 0.1)
+        np.testing.assert_allclose(
+            F.margin_rank_loss(lab, l, r, margin=0.1).numpy(), want2,
+            rtol=1e-5)
+
+    def test_huber_matches_reference_example(self):
+        x = t(np.array([[1.], [2.], [3.], [4.]], np.float32))
+        y = t(np.array([[3.], [3.], [4.], [4.]], np.float32))
+        np.testing.assert_allclose(
+            F.huber_loss(x, y, 1.0).numpy().ravel(), [1.5, 0.5, 0.5, 0.0])
+
+    def test_log_loss(self):
+        p = t(np.array([[0.9], [0.1]], np.float32))
+        lab = t(np.array([[1.0], [0.0]], np.float32))
+        want = -np.log(np.array([0.9, 0.9]) + 1e-4)
+        np.testing.assert_allclose(F.log_loss(p, lab).numpy().ravel(), want,
+                                   rtol=1e-4)
+
+    def test_bpr_loss_reference_formula(self):
+        x = rng.randn(3, 5).astype(np.float32)
+        lab = np.array([[0], [2], [4]])
+        got = F.bpr_loss(t(x), t(lab)).numpy().ravel()
+
+        def sig(z):
+            return 1 / (1 + np.exp(-z))
+
+        want = []
+        for i in range(3):
+            li = lab[i, 0]
+            s = [np.log(sig(x[i, li] - x[i, j])) for j in range(5) if j != li]
+            want.append(-np.mean(s))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_npair_center(self):
+        a = t(rng.rand(4, 6).astype(np.float32))
+        p = t(rng.rand(4, 6).astype(np.float32))
+        labels = t(np.array([0, 1, 1, 0]))
+        val = float(F.npair_loss(a, p, labels).numpy())
+        assert np.isfinite(val) and val > 0
+        centers = t(np.zeros((3, 6), np.float32))
+        centers._mark_stateful()
+        loss = F.center_loss(a, t(np.array([0, 1, 2, 0])), 3, 0.5, centers)
+        assert loss.shape == [4, 1]
+        assert np.abs(centers.numpy()).sum() > 0  # centers moved
+
+    def test_nce_and_sampled_softmax(self):
+        x = t(rng.rand(4, 8).astype(np.float32))
+        x.stop_gradient = False
+        w = t(rng.rand(50, 8).astype(np.float32))
+        lab = t(np.array([[3], [10], [20], [49]]))
+        loss = F.nce(x, lab, w, None, 50, 5).sum()
+        loss.backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+        ssce = F.sampled_softmax_with_cross_entropy(
+            t(rng.randn(4, 50).astype(np.float32)), lab, 10)
+        assert ssce.shape == [4, 1]
+        assert (ssce.numpy() > 0).all()
+
+
+class TestSpatial:
+    def test_affine_grid_sample_identity(self):
+        x = t(rng.rand(2, 3, 4, 5).astype(np.float32))
+        theta = t(np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                          (2, 1, 1)))
+        g = F.affine_grid(theta, [2, 3, 4, 5])
+        y = F.grid_sample(x, g)
+        np.testing.assert_allclose(y.numpy(), x.numpy(), atol=2e-3)
+
+    def test_grid_sample_padding_modes(self):
+        x = t(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+        g = t(np.array([[[[-2.0, -2.0]]]], np.float32))  # out of range
+        z = F.grid_sample(x, g, padding_mode="zeros")
+        assert z.numpy().ravel()[0] == 0.0
+        b = F.grid_sample(x, g, padding_mode="border")
+        assert b.numpy().ravel()[0] == 0.0  # clamps to top-left corner value 0
+
+    def test_grid_sample_grad(self):
+        x = t(rng.rand(1, 2, 3, 3).astype(np.float32))
+        x.stop_gradient = False
+        theta = t(np.array([[[0.8, 0, 0.1], [0, 0.8, -0.1]]], np.float32))
+        g = F.affine_grid(theta, [1, 2, 3, 3])
+        F.grid_sample(x, g).sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+    def test_channel_ops(self):
+        cs = F.channel_shuffle(
+            t(np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1)), 2)
+        np.testing.assert_allclose(cs.numpy().ravel(),
+                                   [0, 4, 1, 5, 2, 6, 3, 7])
+        s2d = F.space_to_depth(
+            t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)), 2)
+        assert s2d.shape == [1, 4, 2, 2]
+        x = t(rng.rand(2, 3, 4, 5).astype(np.float32))
+        ac = F.affine_channel(x, t(np.full(3, 2.0, np.float32)),
+                              t(np.ones(3, np.float32)))
+        np.testing.assert_allclose(ac.numpy(), 2 * x.numpy() + 1, rtol=1e-6)
+        ts = F.temporal_shift(t(rng.rand(4, 8, 2, 2).astype(np.float32)), 2)
+        assert ts.shape == [4, 8, 2, 2]
+        l = F.local_response_norm(x)
+        assert l.shape == x.shape
+
+    def test_deformable_conv_zero_offset_equals_conv(self):
+        import jax
+        import jax.numpy as jnp
+        xx = rng.rand(1, 4, 6, 6).astype(np.float32)
+        w = rng.rand(5, 4, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 4, 4), np.float32)
+        dc = F.deformable_conv(t(xx), t(off), t(w))
+        ref = jax.lax.conv_general_dilated(jnp.asarray(xx), jnp.asarray(w),
+                                           (1, 1), "VALID")
+        np.testing.assert_allclose(dc.numpy(), np.asarray(ref), rtol=1e-4,
+                                   atol=1e-5)
+        # v2: mask of 0.5 halves the output
+        m = np.full((1, 9, 4, 4), 0.5, np.float32)
+        dc2 = F.deformable_conv(t(xx), t(off), t(w), mask=t(m))
+        np.testing.assert_allclose(dc2.numpy(), 0.5 * np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_max_pool_mask_roundtrip(self):
+        x = t(rng.rand(2, 3, 6, 6).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, return_mask=True)
+        g = np.take_along_axis(x.numpy().reshape(2, 3, 36),
+                               mask.numpy().reshape(2, 3, -1),
+                               axis=2).reshape(out.shape)
+        np.testing.assert_allclose(g, out.numpy())
+        up = F.max_unpool2d(out, mask, 2)
+        assert up.shape == [2, 3, 6, 6]
+        assert int((up.numpy() != 0).sum()) <= 2 * 3 * 9
+
+    def test_roi_pool(self):
+        from paddle_tpu.vision.ops import roi_pool
+        feat = t(np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6))
+        boxes = t(np.array([[0, 0, 5, 5], [2, 2, 4, 4]], np.float32))
+        bn = t(np.array([2], np.int32))
+        out = roi_pool(feat, boxes, bn, 2)
+        np.testing.assert_allclose(out.numpy()[0, 0],
+                                   [[14, 17], [32, 35]])
+
+
+class TestDecoding:
+    def test_gather_tree_reference_example(self):
+        ids = t(np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                          [[0, 1], [9, 0]]], np.int64))
+        par = t(np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                          [[0, 0], [0, 1]]], np.int64))
+        out = seq.gather_tree(ids, par).numpy()
+        want = [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]]
+        np.testing.assert_array_equal(out, want)
+
+    def test_edit_distance(self):
+        a = t(np.array([[1, 2, 3, 4], [1, 1, 0, 0]], np.int64))
+        b = t(np.array([[1, 3, 3, 0], [1, 1, 0, 0]], np.int64))
+        d, n = seq.edit_distance(
+            a, b, normalized=False,
+            input_length=t(np.array([4, 2])), label_length=t(np.array([3, 2])))
+        np.testing.assert_allclose(d.numpy().ravel(), [2.0, 0.0])
+        dn, _ = seq.edit_distance(
+            a, b, normalized=True,
+            input_length=t(np.array([4, 2])), label_length=t(np.array([3, 2])))
+        np.testing.assert_allclose(dn.numpy().ravel(), [2 / 3, 0.0],
+                                   rtol=1e-6)
+
+    def test_ctc_align(self):
+        x = t(np.array([[0, 1, 1, 0, 2, 2, 0, 3]], np.int64))
+        al, ln = seq.ctc_align(x)
+        np.testing.assert_array_equal(al.numpy()[0][:3], [1, 2, 3])
+        assert int(ln.numpy()[0]) == 3
+
+    def test_row_conv(self):
+        out = seq.row_conv(t(np.ones((1, 4, 2), np.float32)),
+                           t(np.ones((2, 2), np.float32)))
+        np.testing.assert_allclose(out.numpy()[0, :, 0], [2, 2, 2, 1])
+
+    def test_linear_chain_crf_brute_force(self):
+        import itertools
+        B, T, N = 2, 4, 3
+        emis = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N + 2, N).astype(np.float32)
+        lab = rng.randint(0, N, (B, T)).astype(np.int64)
+        lens = np.array([4, 3])
+        from paddle_tpu.text import linear_chain_crf, crf_decoding
+        ll = linear_chain_crf(t(emis), t(lab), t(trans), t(lens)).numpy()
+
+        def score(e, path):
+            s = trans[0, path[0]] + e[0, path[0]]
+            for i in range(1, len(path)):
+                s += trans[2 + path[i - 1], path[i]] + e[i, path[i]]
+            return s + trans[1, path[-1]]
+
+        for bi in range(B):
+            L = lens[bi]
+            allp = list(itertools.product(range(N), repeat=L))
+            logz = np.log(sum(np.exp(score(emis[bi], p)) for p in allp))
+            # reference returns the NEGATIVE log-likelihood (kernel's -ll)
+            want = logz - score(emis[bi], tuple(lab[bi, :L]))
+            np.testing.assert_allclose(ll[bi, 0], want, rtol=1e-4)
+            best = max(allp, key=lambda p: score(emis[bi], p))
+            dec = crf_decoding(t(emis), t(trans), length=t(lens)).numpy()
+            np.testing.assert_array_equal(dec[bi, :L], best)
+
+    def test_crf_grad(self):
+        emis = t(rng.randn(2, 3, 4).astype(np.float32))
+        trans = t(rng.randn(6, 4).astype(np.float32))
+        emis.stop_gradient = False
+        trans.stop_gradient = False
+        from paddle_tpu.text import linear_chain_crf
+        lab = t(rng.randint(0, 4, (2, 3)).astype(np.int64))
+        linear_chain_crf(emis, lab, trans).sum().backward()
+        assert np.isfinite(emis.grad.numpy()).all()
+        assert np.isfinite(trans.grad.numpy()).all()
+
+
+class TestSegment:
+    def test_segment_ops(self):
+        d = t(np.array([[1.0, 2], [3, 4], [5, 6]], np.float32))
+        s = t(np.array([0, 0, 1]))
+        np.testing.assert_allclose(incubate.segment_sum(d, s).numpy(),
+                                   [[4, 6], [5, 6]])
+        np.testing.assert_allclose(incubate.segment_mean(d, s).numpy(),
+                                   [[2, 3], [5, 6]])
+        np.testing.assert_allclose(incubate.segment_max(d, s).numpy(),
+                                   [[3, 4], [5, 6]])
+        np.testing.assert_allclose(incubate.segment_min(d, s).numpy(),
+                                   [[1, 2], [5, 6]])
+
+    def test_segment_sum_grad(self):
+        d = t(np.array([[1.0, 2], [3, 4], [5, 6]], np.float32))
+        d.stop_gradient = False
+        incubate.segment_sum(d, t(np.array([0, 0, 1]))).sum().backward()
+        np.testing.assert_allclose(d.grad.numpy(), np.ones((3, 2)))
+
+
+class TestDetectionMisc:
+    def test_yolov3_loss_grad(self):
+        from paddle_tpu.vision.ops import yolov3_loss
+        N, H, W, C = 2, 4, 4, 3
+        mask = [0, 1]
+        anchors = [10, 13, 16, 30, 33, 23]
+        x = t((rng.randn(N, len(mask) * (5 + C), H, W) * 0.1)
+              .astype(np.float32))
+        x.stop_gradient = False
+        gtb = t(np.array([[[.3, .3, .2, .2], [.7, .6, .3, .4]],
+                          [[.5, .5, .4, .3], [0, 0, 0, 0]]], np.float32))
+        gtl = t(np.array([[0, 2], [1, 0]], np.int64))
+        loss = yolov3_loss(x, gtb, gtl, anchors, mask, C, 0.7, 8)
+        assert loss.shape == [N]
+        assert (loss.numpy() > 0).all()
+        loss.sum().backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        # mixup scores scale the positive losses
+        gts = t(np.array([[0.5, 0.5], [0.5, 0.5]], np.float32))
+        loss2 = yolov3_loss(x, gtb, gtl, anchors, mask, C, 0.7, 8,
+                            gt_score=gts)
+        assert (loss2.numpy() <= loss.numpy() + 1e-5).all()
+
+    def test_anchor_generator(self):
+        from paddle_tpu.vision.ops import anchor_generator
+        a, v = anchor_generator(t(np.zeros((1, 8, 2, 3), np.float32)),
+                                [64.0], [1.0], [16.0, 16.0])
+        assert a.shape == [2, 3, 1, 4] and v.shape == [2, 3, 1, 4]
+        an = a.numpy()
+        # centers advance by the stride
+        np.testing.assert_allclose(an[0, 1, 0, 0] - an[0, 0, 0, 0], 16.0)
+        np.testing.assert_allclose(an[1, 0, 0, 1] - an[0, 0, 0, 1], 16.0)
+        np.testing.assert_allclose(v.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_cvm(self):
+        x = t(np.array([[3.0, 1, 5, 6], [7, 0, 1, 2]], np.float32))
+        out = paddle.cvm(x)
+        np.testing.assert_allclose(out.numpy()[0, 0], np.log(4.0), rtol=1e-6)
+        np.testing.assert_allclose(out.numpy()[0, 1],
+                                   np.log(2.0) - np.log(4.0), rtol=1e-5)
+        np.testing.assert_allclose(out.numpy()[:, 2:], x.numpy()[:, 2:])
+        assert paddle.cvm(x, use_cvm=False).shape == [2, 2]
+
+    def test_data_norm(self):
+        from paddle_tpu.ops.extras import data_norm
+        x = t(rng.rand(8, 4).astype(np.float32))
+        bs = t(np.full(4, 1e4, np.float32))
+        bsum = t(np.zeros(4, np.float32))
+        bsq = t(np.full(4, 1e4, np.float32))
+        for s in (bs, bsum, bsq):
+            s._mark_stateful()
+        out = data_norm(x, bs, bsum, bsq)
+        # mean 0 scale 1 summaries: y = x
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-5)
+        assert float(bs.numpy()[0]) > 1e4  # stats accumulated
+
+
+class TestPyFunc:
+    def test_py_func_forward_backward(self):
+        import paddle_tpu.static as static
+        x = t(np.array([1.0, 2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        spec = static.InputSpec([3], "float32")
+        # backward_func receives (inputs, outputs, out-grads)
+        y = static.py_func(lambda a: a * 2 + 1, x, spec,
+                           backward_func=lambda a, y, g: g * 2)
+        np.testing.assert_allclose(y.numpy(), [3, 5, 7])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2])
+
+    def test_py_func_multi_io(self):
+        import paddle_tpu.static as static
+        a = t(np.ones(2, np.float32))
+        b = t(np.full(2, 3.0, np.float32))
+        specs = [static.InputSpec([2], "float32"),
+                 static.InputSpec([2], "float32")]
+        o1, o2 = static.py_func(lambda u, v: (u + v, u * v), [a, b], specs)
+        np.testing.assert_allclose(o1.numpy(), [4, 4])
+        np.testing.assert_allclose(o2.numpy(), [3, 3])
+
+
+class TestPoolingEdgeCases:
+    def test_max_pool_mask_ceil_mode(self):
+        x = t(rng.rand(1, 2, 5, 5).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, stride=2, ceil_mode=True,
+                                 return_mask=True)
+        ref = F.max_pool2d(x, 2, stride=2, ceil_mode=True)
+        assert out.shape == ref.shape == [1, 2, 3, 3]
+        np.testing.assert_allclose(out.numpy(), ref.numpy())
+        g = np.take_along_axis(x.numpy().reshape(1, 2, 25),
+                               mask.numpy().reshape(1, 2, -1),
+                               axis=2).reshape(out.shape)
+        np.testing.assert_allclose(g, out.numpy())
+
+    def test_max_unpool_padding_output_size(self):
+        # reference default output: (in-1)*stride - 2*pad + ksize
+        x = t(rng.rand(1, 1, 8, 8).astype(np.float32))
+        out, mask = F.max_pool2d(x, 3, stride=2, padding=1, return_mask=True)
+        assert out.shape == [1, 1, 4, 4]
+        up = F.max_unpool2d(out, mask, 3, stride=2, padding=1)
+        assert up.shape == [1, 1, 7, 7]  # (4-1)*2 - 2*1 + 3
+        up2 = F.max_unpool2d(out, mask, 3, stride=2, padding=1,
+                             output_size=[8, 8])
+        assert up2.shape == [1, 1, 8, 8]
+
+
+class TestHapiTail:
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = []\n"
+            "def lenet(num_classes=10):\n"
+            "    '''LeNet entry.'''\n"
+            "    from paddle_tpu.vision.models import LeNet\n"
+            "    return LeNet(num_classes=num_classes)\n")
+        assert paddle.hub.list(str(tmp_path)) == ["lenet"]
+        assert "LeNet" in paddle.hub.help(str(tmp_path), "lenet")
+        m = paddle.hub.load(str(tmp_path), "lenet", num_classes=7)
+        out = m(t(np.zeros((1, 1, 28, 28), np.float32)))
+        assert out.shape == [1, 7]
+        with pytest.raises(RuntimeError):
+            paddle.hub.load(str(tmp_path), "lenet", source="github")
+
+    def test_reduce_lr_on_plateau(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        class FakeModel:
+            pass
+
+        m = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        fm = FakeModel()
+        fm._optimizer = opt
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0)
+        cb.model = fm
+        cb.on_epoch_end(0, {"loss": 1.0})
+        cb.on_epoch_end(1, {"loss": 1.0})  # no improvement -> wait=1 -> cut
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_visualdl_writes_scalars(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import VisualDL
+        cb = VisualDL(str(tmp_path))
+        cb.on_batch_end("train", 0, {"loss": 0.5})
+        cb.on_epoch_end(0, {"loss": 0.4})
+        body = (tmp_path / "train.tsv").read_text()
+        assert "train/loss" in body and "0.5" in body
