@@ -5,9 +5,9 @@ Configs (BASELINE.md / SURVEY.md §6):
   2. ResNet-50 @to_static             — img/s/chip               (here)
   3. BERT-base pretraining            — bench.py (the headline; driver-run)
   4. GPT-1.3B sharding + pipeline     — hybrid dryrun step time  (here)
-  5. detection variable-shape path    — covered by tests/test_detection_sequence.py
+  5. detection variable-shape path    — img/s, shape buckets   (here)
 
-Run: `python benchmarks/run_all.py [--configs resnet,gpt,allreduce]`
+Run: `python benchmarks/run_all.py [--configs resnet,gpt,allreduce,detection]`
 Prints one JSON line per config. On a host without TPU the numbers are
 CPU-smoke only (marked "backend": "cpu").
 """
@@ -176,13 +176,89 @@ def bench_allreduce():
             "unit": "GB/s", "backend": jax.default_backend(), "devices": n}
 
 
+def bench_detection():
+    """Config 5: variable-shape detection training (PP-YOLOE/Faster-RCNN
+    class of workload). Images arrive in mixed resolutions; the
+    LoDTensor-era variable-shape story on TPU is shape BUCKETING — each
+    bucket compiles once (to_static cache) and steps reuse the executable.
+    Measures img/s across mixed-bucket traffic with ragged gt boxes padded
+    per batch, trained through yolov3_loss."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet18
+    from paddle_tpu.vision.ops import yolov3_loss
+
+    backend = jax.default_backend()
+    on_tpu = backend != "cpu"
+    if on_tpu:
+        buckets, bs, iters, warmup = [320, 416, 512], 8, 4, 1
+    else:
+        buckets, bs, iters, warmup = [64, 96], 2, 1, 1
+    class_num, max_boxes = 80, 50
+    anchors = [116, 90, 156, 198, 373, 326]
+    mask = [0, 1, 2]
+
+    paddle.seed(0)
+    backbone = resnet18(num_classes=0, with_pool=False)  # trunk only
+    head = nn.Conv2D(512, len(mask) * (5 + class_num), 1)
+    params = backbone.parameters() + head.parameters()
+    opt = paddle.optimizer.Momentum(parameters=params, learning_rate=0.01,
+                                    momentum=0.9)
+
+    def train_step(img, gtb, gtl):
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+            feat = backbone(img)
+            pred = head(feat)
+            loss = yolov3_loss(pred, gtb, gtl, anchors, mask, class_num,
+                               ignore_thresh=0.7, downsample_ratio=32).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step)
+    rng = np.random.RandomState(0)
+
+    def batch(size):
+        img = paddle.to_tensor(rng.rand(bs, 3, size, size).astype("float32"))
+        # ragged gt: random box count per image, padded to max_boxes with
+        # zero-wh (invalid) boxes — the reference's LoD ragged layout
+        gtb = np.zeros((bs, max_boxes, 4), np.float32)
+        for i in range(bs):
+            k = rng.randint(1, 20)
+            cxy = rng.rand(k, 2) * 0.8 + 0.1
+            wh = rng.rand(k, 2) * 0.2 + 0.05
+            gtb[i, :k] = np.concatenate([cxy, wh], 1)
+        gtl = rng.randint(0, class_num, (bs, max_boxes)).astype("int64")
+        return img, paddle.to_tensor(gtb), paddle.to_tensor(gtl)
+
+    data = {s: batch(s) for s in buckets}
+    for s in buckets:  # one compile per bucket
+        for _ in range(warmup):
+            loss = step(*data[s])
+    _sync(loss)
+    order = [buckets[i % len(buckets)] for i in range(iters * len(buckets))]
+    t0 = time.perf_counter()
+    for s in order:
+        loss = step(*data[s])
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    img_s = bs * len(order) / dt
+    return {"metric": "detection_varshape_img_per_s_per_chip",
+            "value": round(img_s, 1), "unit": "img/s", "backend": backend,
+            "batch": bs, "shape_buckets": buckets,
+            "compiles": len(step._cache),
+            "loss": round(float(np.asarray(loss.numpy())), 3)}
+
+
 BENCHES = {"resnet": bench_resnet50, "gpt": bench_gpt_sharding_pp,
-           "allreduce": bench_allreduce}
+           "allreduce": bench_allreduce, "detection": bench_detection}
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="resnet,gpt,allreduce")
+    ap.add_argument("--configs", default="resnet,gpt,allreduce,detection")
     args = ap.parse_args()
     failed = False
     for name in args.configs.split(","):

@@ -26,6 +26,12 @@ class Callback:
     def on_batch_end(self, mode, step, logs=None):
         pass
 
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
 
 class CallbackList:
     def __init__(self, callbacks):

@@ -116,7 +116,8 @@ class Model:
             history.append(logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
-                              num_workers=num_workers, verbose=0)
+                              num_workers=num_workers, verbose=0,
+                              _cbks=cbks)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/{epoch}")
             cbks.on_epoch_end(epoch, logs)
@@ -124,21 +125,31 @@ class Model:
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
-                 num_workers=0, callbacks=None):
+                 num_workers=0, callbacks=None, _cbks=None):
         if not isinstance(eval_data, DataLoader):
             loader = DataLoader(eval_data, batch_size=batch_size,
                                 num_workers=num_workers)
         else:
             loader = eval_data
+        cbks = _cbks  # fit() forwards its live callback list
+        if cbks is None and callbacks:
+            cbks = cbks_mod.CallbackList(callbacks)
+            cbks.set_model(self)
         for m in self._metrics:
             m.reset()
+        if cbks is not None:
+            cbks.on_eval_begin()
         losses = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
             x, y = batch[0], batch[1]
             res = self.eval_batch([x], [y])
             l = res[0] if not isinstance(res, tuple) else res[0]
             if l:
                 losses.append(l[0] if isinstance(l, list) else l)
+            if cbks is not None:
+                cbks.on_batch_end(
+                    "eval", step,
+                    {"loss": losses[-1]} if losses else {})
         out = {"loss": [float(np.mean(losses))] if losses else []}
         for m in self._metrics:
             names = m.name()
@@ -146,6 +157,8 @@ class Model:
             vals = vals if isinstance(vals, list) else [vals]
             for n, v in zip(names, vals):
                 out[n] = v
+        if cbks is not None:
+            cbks.on_eval_end(out)
         return out
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,

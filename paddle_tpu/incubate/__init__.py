@@ -35,9 +35,8 @@ def _segment_op(data, segment_ids, kind):
     seg = unwrap(segment_ids).astype(jnp.int32)
 
     def _seg(v):
-        n_seg = seg[-1] + 1 if seg.shape[0] else 0
         # segment ids are sorted (reference contract); static upper bound =
-        # number of rows, sliced by the caller's expectation
+        # number of rows, sliced to the real segment count by the caller
         n = v.shape[0]
         if kind == "sum" or kind == "mean":
             out = jnp.zeros((n,) + v.shape[1:], v.dtype).at[seg].add(v)
@@ -75,3 +74,17 @@ def segment_max(data, segment_ids):
 
 def segment_min(data, segment_ids):
     return _segment_op(data, segment_ids, "min")
+
+
+def softmax_mask_fuse(x, mask):
+    """Fused softmax(x + mask) (reference: later snapshots'
+    fused_softmax_mask_op; upper-triangle variant above). mask broadcasts
+    over the head axis: x [B, H, S, S], mask [B, 1, S, S]."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.dispatch import call_op
+
+    def _fused(v, m):
+        return jax.nn.softmax(v + m, axis=-1)
+
+    return call_op(_fused, x, mask, op_name="softmax_mask_fuse")

@@ -329,8 +329,10 @@ def rank_loss(label, left, right):
     """RankNet pairwise loss (reference: operators/rank_loss_op.cc):
     C = -label * (left - right) + log(1 + exp(left - right))."""
     def _rl(lab, l, r):
+        # stable form of -lab*o + log(1+exp(o)) (see _sce in yolov3_loss)
         o = l - r
-        return -lab * o + jnp.log1p(jnp.exp(o))
+        return (jnp.maximum(o, 0.0) - lab * o
+                + jnp.log1p(jnp.exp(-jnp.abs(o))))
     return call_op(_rl, label, left, right, op_name="rank_loss")
 
 
@@ -505,3 +507,70 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples, seed=None):
         return -logp[:, :1]
 
     return call_op(_ssce, logits, op_name="sampled_softmax_with_cross_entropy")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid loss (reference: operators/hierarchical_sigmoid_op
+    + math/matrix_bit_code.h SimpleCode). Default tree: class c encodes as
+    c + num_classes in a complete binary tree whose internal node for bit j
+    is (code >> (j+1)) - 1 and whose bit target is (code >> j) & 1; loss is
+    the summed sigmoid cross entropy along the path. Custom trees pass
+    path_table/path_code ([N, L], id < 0 = padding). weight: [num_classes-1
+    (or max node id+1), D], bias: same rows. Returns [N, 1]."""
+    lab = jnp.reshape(unwrap(label), (-1,)).astype(jnp.int32)
+    have_bias = bias is not None
+
+    if path_table is not None:
+        tbl = unwrap(path_table).astype(jnp.int32)
+        code = unwrap(path_code)
+        valid = tbl >= 0
+        idxs = jnp.maximum(tbl, 0)
+        bits = jnp.where(valid, code.astype(jnp.float32), 0.0)
+    else:
+        max_len = int(2 * num_classes - 1).bit_length() - 1
+        c = lab + num_classes  # root id 1 => leaf code c+num_classes
+        js = jnp.arange(max_len)
+        idxs = (c[:, None] >> (js[None, :] + 1)) - 1
+        bits = ((c[:, None] >> js[None, :]) & 1).astype(jnp.float32)
+        # path length = highest set bit position of c
+        length = jnp.floor(
+            jnp.log2(c.astype(jnp.float32) + 0.5)).astype(jnp.int32)
+        valid = js[None, :] < length[:, None]
+        idxs = jnp.where(valid, idxs, 0)
+
+    def _hs(x, w, *rest):
+        b = rest[0] if have_bias else None
+        path_w = w[idxs]                      # [N, L, D]
+        logits = jnp.einsum("nd,nld->nl", x, path_w)
+        if b is not None:
+            logits = logits + b[idxs]
+        sce = (jnp.maximum(logits, 0.0) - logits * bits
+               + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+        return jnp.sum(jnp.where(valid, sce, 0.0), axis=1, keepdims=True)
+
+    args = (input, weight) + ((bias,) if have_bias else ())
+    return call_op(_hs, *args, op_name="hsigmoid_loss")
+
+
+def teacher_student_sigmoid_loss(input, label,  # noqa: A002
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """CTR distillation loss (reference:
+    operators/teacher_student_sigmoid_loss_op.h): label < -1 → BCE(x, 0);
+    -1 <= label < 0 → BCE(x, 1); 0 <= label < 1 → BCE(x, 0) + BCE(x, q);
+    label >= 1 → BCE(x, 1) + BCE(x, q) with q = label - 1."""
+
+    def _ts(x, lab):
+        x = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+        base = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        bce0 = base                 # target 0
+        bce1 = base - x             # target 1
+        soft = jnp.where(lab < 1.0, base - x * lab,
+                         base - x * (lab - 1.0))
+        return jnp.where(
+            lab < -1.0, bce0,
+            jnp.where(lab < 0.0, bce1,
+                      jnp.where(lab < 1.0, bce0 + soft, bce1 + soft)))
+
+    return call_op(_ts, input, label, op_name="teacher_student_sigmoid_loss")

@@ -121,8 +121,8 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
         c1 = int(c * shift_ratio)
         c2 = int(c * 2 * shift_ratio)
         pad = jnp.pad(val, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
-        back = pad[:, :seg_num, :c1]          # shift left (from t+1 ... )
-        fwd = pad[:, 2:, c1:c2]               # shift right (from t-1 ... )
+        back = pad[:, :seg_num, :c1]          # channels shifted from t-1
+        fwd = pad[:, 2:, c1:c2]               # channels shifted from t+1
         keep = val[:, :, c2:]
         out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
         if data_format == "NHWC":

@@ -19,7 +19,7 @@ __all__ = [
     "nanmean", "nansum", "deg2rad", "rad2deg", "gcd", "lcm", "heaviside",
     "digamma", "lgamma", "conj", "real", "imag", "mv", "dist", "increment",
     "unbind", "broadcast_tensors", "multiplex", "crop", "squared_l2_norm",
-    "cvm", "data_norm",
+    "cvm", "data_norm", "fsp_matrix",
 ]
 
 
@@ -434,3 +434,14 @@ def data_norm(input, batch_size, batch_sum, batch_square_sum,  # noqa: A002
         batch_square_sum.set_value(
             unwrap(batch_square_sum) * dr + (v ** 2).sum(axis=0))
     return out
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix for distillation (reference:
+    operators/fsp_op.h): out[n, i, j] = (1/HW) sum_hw x[n,i,h,w]*y[n,j,h,w]."""
+
+    def _fsp(a, b):
+        n, c1, h, w = a.shape
+        return jnp.einsum("nihw,njhw->nij", a, b) / (h * w)
+
+    return call_op(_fsp, x, y, op_name="fsp_matrix")

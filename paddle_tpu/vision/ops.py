@@ -683,3 +683,47 @@ def anchor_generator(input, anchor_sizes, aspect_ratios, stride,  # noqa: A002
     var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
                            (H, W, num, 4))
     return wrap(anchors), wrap(var)
+
+
+def iou_similarity(x, y, box_normalized=True):
+    """Pairwise IoU matrix [N, M] between two xyxy box sets (reference:
+    operators/detection/iou_similarity_op.h)."""
+
+    def _iou(a, b):
+        off = 0.0 if box_normalized else 1.0
+        ax0, ay0, ax1, ay1 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+        bx0, by0, bx1, by1 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        area_a = (ax1 - ax0 + off) * (ay1 - ay0 + off)
+        area_b = (bx1 - bx0 + off) * (by1 - by0 + off)
+        iw = (jnp.minimum(ax1[:, None], bx1[None, :])
+              - jnp.maximum(ax0[:, None], bx0[None, :]) + off)
+        ih = (jnp.minimum(ay1[:, None], by1[None, :])
+              - jnp.maximum(ay0[:, None], by0[None, :]) + off)
+        inter = jnp.maximum(iw, 0.0) * jnp.maximum(ih, 0.0)
+        return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
+
+    return call_op(_iou, x, y, op_name="iou_similarity")
+
+
+def box_clip(input, im_info, name=None):  # noqa: A002
+    """Clip xyxy boxes to image bounds (reference:
+    operators/detection/box_clip_op.h). im_info rows: (height, width,
+    scale); boxes clipped to [0, dim/scale - 1]."""
+    info = unwrap(im_info)
+
+    def _clip(b):
+        h = info[..., 0] / info[..., 2] - 1.0
+        w = info[..., 1] / info[..., 2] - 1.0
+        if b.ndim == 3:  # [N, B, 4] batched with per-image info
+            h = h[:, None]
+            w = w[:, None]
+        else:
+            h = jnp.reshape(h, ())
+            w = jnp.reshape(w, ())
+        x0 = jnp.clip(b[..., 0], 0.0, w)
+        y0 = jnp.clip(b[..., 1], 0.0, h)
+        x1 = jnp.clip(b[..., 2], 0.0, w)
+        y1 = jnp.clip(b[..., 3], 0.0, h)
+        return jnp.stack([x0, y0, x1, y1], axis=-1)
+
+    return call_op(_clip, input, op_name="box_clip")

@@ -462,3 +462,88 @@ class TestHapiTail:
         cb.on_epoch_end(0, {"loss": 0.4})
         body = (tmp_path / "train.tsv").read_text()
         assert "train/loss" in body and "0.5" in body
+
+
+class TestLossTail2:
+    def test_hsigmoid_default_tree(self):
+        x = t(rng.randn(4, 8).astype(np.float32))
+        x.stop_gradient = False
+        w = t((rng.randn(9, 8) * 0.1).astype(np.float32))
+        w.stop_gradient = False
+        lab = t(np.array([0, 3, 7, 9]))
+        loss = F.hsigmoid_loss(x, lab, 10, w)
+        assert loss.shape == [4, 1] and (loss.numpy() > 0).all()
+        loss.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.isfinite(w.grad.numpy()).all()
+        # training decreases the loss
+        xv, wv = x.numpy().copy(), w.numpy().copy()
+        for _ in range(50):
+            x2 = t(xv); x2.stop_gradient = False
+            w2 = t(wv); w2.stop_gradient = False
+            l2 = F.hsigmoid_loss(x2, lab, 10, w2).sum()
+            l2.backward()
+            wv = wv - 0.5 * w2.grad.numpy()
+        assert float(l2.numpy()) < float(loss.sum().numpy())
+
+    def test_hsigmoid_custom_tree(self):
+        x = t(rng.randn(2, 4).astype(np.float32))
+        w = t((rng.randn(5, 4) * 0.1).astype(np.float32))
+        tbl = t(np.array([[0, 2, -1], [1, 3, 4]], np.int64))
+        code = t(np.array([[1, 0, 0], [0, 1, 1]], np.int64))
+        loss = F.hsigmoid_loss(x, t(np.array([0, 1])), 6, w,
+                               path_table=tbl, path_code=code)
+        assert loss.shape == [2, 1] and np.isfinite(loss.numpy()).all()
+
+    def test_teacher_student_sigmoid_loss(self):
+        ts = F.teacher_student_sigmoid_loss(
+            t(np.array([1.0, 1.0, 1.0, 1.0], np.float32)),
+            t(np.array([-2.0, -1.0, 0.3, 1.6], np.float32)))
+        base = 1 + np.log1p(np.exp(-1.0))
+        want = [base, base - 1, base + base - 0.3, base - 1 + base - 0.6]
+        np.testing.assert_allclose(ts.numpy(), want, rtol=1e-5)
+
+
+class TestDetectionTail:
+    def test_iou_similarity(self):
+        from paddle_tpu.vision.ops import iou_similarity
+        a = t(np.array([[0, 0, 2, 2]], np.float32))
+        b = t(np.array([[1, 1, 3, 3], [0, 0, 2, 2]], np.float32))
+        np.testing.assert_allclose(iou_similarity(a, b).numpy(),
+                                   [[1 / 7, 1.0]], rtol=1e-5)
+
+    def test_box_clip(self):
+        from paddle_tpu.vision.ops import box_clip
+        out = box_clip(t(np.array([[-1, -1, 5, 9]], np.float32)),
+                       t(np.array([5.0, 5.0, 1.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [[0, 0, 4, 4]])
+
+    def test_fsp_matrix(self):
+        f = paddle.ops.extras.fsp_matrix(
+            t(np.ones((1, 2, 2, 2), np.float32)),
+            t(np.ones((1, 3, 2, 2), np.float32)))
+        assert f.shape == [1, 2, 3]
+        np.testing.assert_allclose(f.numpy(), np.ones((1, 2, 3)))
+
+    def test_softmax_mask_fuse(self):
+        x = t(rng.randn(1, 2, 3, 3).astype(np.float32))
+        m = np.zeros((1, 1, 3, 3), np.float32)
+        m[..., 2] = -1e9  # mask out last key
+        out = incubate.softmax_mask_fuse(x, t(m)).numpy()
+        np.testing.assert_allclose(out.sum(-1), np.ones((1, 2, 3)), rtol=1e-5)
+        assert (out[..., 2] < 1e-6).all()
+
+
+class TestEvalCallbacks:
+    def test_evaluate_fires_eval_hooks(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import VisualDL
+        model = paddle.Model(nn.Linear(4, 2))
+        model.prepare(loss=nn.CrossEntropyLoss())
+        xs = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        ys = np.random.RandomState(0).randint(0, 2, (8,)).astype(np.int64)
+        data = [(xs[i], ys[i]) for i in range(8)]
+        cb = VisualDL(str(tmp_path))
+        out = model.evaluate(data, batch_size=4, callbacks=[cb])
+        assert "loss" in out
+        body = (tmp_path / "eval.tsv").read_text()
+        assert "eval/loss" in body
