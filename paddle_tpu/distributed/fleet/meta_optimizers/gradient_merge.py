@@ -14,6 +14,11 @@ from ....core.tensor import Tensor
 
 class GradientMergeOptimizer:
     def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        if getattr(inner_optimizer, "_fuse_acc", False):
+            raise NotImplementedError(
+                "GradientMergeOptimizer rolls accumulator state back with "
+                "eager writes; wrap an optimizer without "
+                "fuse_accumulators=True")
         self._inner = inner_optimizer
         self._k = int(k_steps)
         self._avg = avg

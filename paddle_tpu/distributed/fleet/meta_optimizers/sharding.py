@@ -19,6 +19,10 @@ def shard_optimizer_state(optimizer, mesh=None, axis=topo_mod.AXIS_SHARD):
         mesh = hcg.mesh if hcg is not None else None
     degree = _axis_degree(mesh, axis)
     count = 0
+    if getattr(optimizer, "_fuse_acc", False):
+        raise NotImplementedError(
+            "optimizer-state sharding annotates per-param accumulator "
+            "tensors; use an optimizer without fuse_accumulators=True")
     for (_slot, _pid), acc in optimizer._accumulators.items():
         spec = shard_spec_for(tuple(acc._value.shape), axis, degree)
         if spec is not None:

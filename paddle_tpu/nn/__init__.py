@@ -33,7 +33,7 @@ from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
-from .layer.rnn import SimpleRNN, LSTM, GRU, RNNCellBase, LSTMCell, GRUCell, SimpleRNNCell  # noqa: F401
+from .layer.rnn import SimpleRNN, LSTM, GRU, RNNCellBase, LSTMCell, GRUCell, SimpleRNNCell, BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .control_flow import (  # noqa: F401
     while_loop, cond, case, switch_case,

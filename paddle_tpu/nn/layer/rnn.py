@@ -10,7 +10,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import call_op
+from ...core.dispatch import call_op, unwrap, wrap
 from ... import ops
 from .. import initializer as I
 from .layers import Layer
@@ -290,3 +290,100 @@ class GRUCell(RNNCellBase):
         h = call_op(_cell, inputs, states, self.weight_ih, self.weight_hh,
                     self.bias_ih, self.bias_hh, op_name="gru_cell")
         return h, h
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding over an RNN cell (reference:
+    fluid/layers/rnn.py BeamSearchDecoder:866). Drives per-step topk beam
+    expansion; `dynamic_decode` runs the loop and backtraces with
+    gather_tree. States are kept flattened [batch*beam, ...]."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(unwrap(s), self.beam_size, axis=0),
+            initial_cell_states)
+        first = states if not isinstance(states, (list, tuple)) else states[0]
+        bb = unwrap(first).shape[0]
+        b = bb // self.beam_size
+        log_probs = jnp.full((b, self.beam_size), -1e9, jnp.float32)
+        log_probs = log_probs.at[:, 0].set(0.0)
+        finished = jnp.zeros((b, self.beam_size), bool)
+        tokens = jnp.full((bb,), self.start_token, jnp.int32)
+        return tokens, states, log_probs, finished
+
+    def step(self, tokens, cell_states, log_probs, finished):
+        """One beam expansion; returns (next ...) plus this step's
+        (token_ids, parent_ids) [B, beam]."""
+        beam = self.beam_size
+        inputs = (self.embedding_fn(wrap(tokens)) if self.embedding_fn
+                  else wrap(tokens))
+        out, next_states = self.cell(inputs, cell_states)
+        logits = self.output_fn(out) if self.output_fn else out
+        v = unwrap(logits).shape[-1]
+        step_lp = jax.nn.log_softmax(
+            unwrap(logits).astype(jnp.float32), axis=-1)
+        step_lp = step_lp.reshape(-1, beam, v)
+        b = step_lp.shape[0]
+        # finished beams may only emit end_token, at no cost
+        end_only = jnp.full((v,), -jnp.inf).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], end_only[None, None, :],
+                            step_lp)
+        scores = (log_probs[..., None] + step_lp).reshape(b, beam * v)
+        top_lp, top_idx = jax.lax.top_k(scores, beam)
+        parents = (top_idx // v).astype(jnp.int32)       # [B, beam]
+        tokens2 = (top_idx % v).astype(jnp.int32)
+        # gather beam-major state by parent
+        flat_parent = (parents
+                       + jnp.arange(b)[:, None] * beam).reshape(-1)
+        next_states = jax.tree_util.tree_map(
+            lambda s: jnp.take(unwrap(s), flat_parent, axis=0), next_states)
+        finished2 = (jnp.take_along_axis(finished, parents, axis=1)
+                     | (tokens2 == self.end_token))
+        return (tokens2.reshape(-1), next_states, top_lp, finished2,
+                tokens2, parents)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=64,
+                   output_time_major=False, **kwargs):
+    """Run a Decoder until every beam finishes or max_step_num (reference:
+    fluid/layers/rnn.py dynamic_decode:1584). Returns
+    ((predicted_ids, final_scores), final_states, sequence_lengths);
+    predicted_ids [B, T, beam] (or [T, B, beam] time-major), backtraced
+    with gather_tree. sequence_lengths follow each surviving beam through
+    its parent chain and count the end-emitting step."""
+    from ...ops.sequence import gather_tree as _gather_tree
+
+    tokens, states, log_probs, finished = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    lengths = jnp.zeros(finished.shape, jnp.int32)
+    for _ in range(max_step_num):
+        prev_finished = finished
+        (tokens, states, log_probs, finished, ids,
+         parents) = decoder.step(tokens, states, log_probs, finished)
+        step_ids.append(ids)
+        step_parents.append(parents)
+        # each beam slot now continues its PARENT's sequence; count this
+        # step (incl. the end-emitting one) unless the parent had already
+        # finished
+        lengths = jnp.take_along_axis(lengths, parents, axis=1)
+        parent_done = jnp.take_along_axis(prev_finished, parents, axis=1)
+        lengths = lengths + (~parent_done).astype(jnp.int32)
+        if bool(jnp.all(finished)):
+            break
+    ids_tb = jnp.stack(step_ids)          # [T, B, beam]
+    parents_tb = jnp.stack(step_parents)
+    traced = unwrap(_gather_tree(wrap(ids_tb), wrap(parents_tb)))
+    if not output_time_major:
+        traced = jnp.transpose(traced, (1, 0, 2))  # [B, T, beam]
+    states = jax.tree_util.tree_map(
+        lambda s: s if hasattr(s, "numpy") else wrap(s), states)
+    return ((wrap(traced), wrap(log_probs)), states, wrap(lengths))

@@ -215,3 +215,79 @@ class TestReviewFixes:
                                       mode="nearest",
                                       data_format="NCDHW").numpy())
         assert o3.shape == (1, 3, 4, 8, 8), o3.shape
+
+    def test_beam_search_decoder_optimal_path(self):
+        V = 4
+        trans = np.log(np.array([
+            [.05, .55, .4, 0.0],
+            [.01, .01, .08, .9],
+            [.01, .01, .01, .97],
+            [1e-9, 1e-9, 1e-9, 1.0],
+        ], np.float32) + 1e-12)
+
+        class ToyCell:
+            def __call__(self, inputs, states):
+                tok = np.asarray(inputs.numpy()).astype(int)
+                return Tensor(trans[tok]), states
+
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=3,
+                                   beam_size=3)
+        init = Tensor(np.zeros((2, 1), np.float32))
+        (ids, scores), _, lens = nn.dynamic_decode(dec, init, max_step_num=6)
+        # brute-force optimum from token 0 is path (1, 3)
+        np.testing.assert_array_equal(ids.numpy()[0, :2, 0], [1, 3])
+        np.testing.assert_allclose(scores.numpy()[0, 0],
+                                   trans[0, 1] + trans[1, 3], rtol=1e-5)
+
+    def test_beam_search_with_gru_cell(self):
+        paddle.seed(0)
+        cell = nn.GRUCell(8, 16)
+        emb = nn.Embedding(12, 8)
+        proj = nn.Linear(16, 12)
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                   beam_size=4, embedding_fn=emb,
+                                   output_fn=proj)
+        init = Tensor(np.zeros((3, 16), np.float32))
+        (ids, scores), states, lens = nn.dynamic_decode(dec, init,
+                                                        max_step_num=5)
+        assert ids.shape[0] == 3 and ids.shape[2] == 4
+        assert np.isfinite(scores.numpy()).all()
+        # scores sorted descending per batch row
+        s = scores.numpy()
+        assert (np.diff(s, axis=1) <= 1e-5).all()
+
+    def test_beam_search_lengths_follow_parents(self):
+        # beams reorder across steps; lengths must track each surviving
+        # beam's parent chain and count the end-emitting step
+        V = 3  # {a=0, b=1, END=2}
+        step_logits = [
+            np.log(np.array([[.6, .39, .01]] * 2, np.float32)),
+            np.log(np.array([[.1, .1, .8],    # from beam following a
+                             [.45, .45, .1]] , np.float32)),
+            np.log(np.array([[.1, .1, .8]] * 2, np.float32)),
+            np.log(np.array([[.05, .05, .9]] * 2, np.float32)),
+        ]
+
+        class SeqCell:
+            def __init__(self):
+                self.t = 0
+
+            def __call__(self, inputs, states):
+                tok = np.asarray(inputs.numpy()).astype(int) % 2
+                out = step_logits[min(self.t, 3)][tok]
+                self.t += 1
+                return Tensor(out), states
+
+        dec = nn.BeamSearchDecoder(SeqCell(), start_token=0, end_token=2,
+                                   beam_size=2)
+        init = Tensor(np.zeros((1, 1), np.float32))
+        (ids, scores), _, lens = nn.dynamic_decode(dec, init, max_step_num=4)
+        idv = ids.numpy()[0]          # [T, beam]
+        lnv = lens.numpy()[0]         # [beam]
+        # every beam's reported length equals its actual token count
+        # through (and including) the first END in the backtraced path
+        for b in range(2):
+            path = idv[:, b]
+            end_pos = np.where(path == 2)[0]
+            true_len = (end_pos[0] + 1) if len(end_pos) else len(path)
+            assert lnv[b] == true_len, (path, lnv[b], true_len)
