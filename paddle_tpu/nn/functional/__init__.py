@@ -25,7 +25,7 @@ from .loss import (  # noqa: F401
     triplet_margin_loss, square_error_cost, sigmoid_focal_loss, ctc_loss,
     rank_loss, margin_rank_loss, huber_loss, log_loss, bpr_loss, npair_loss,
     center_loss, nce, sampled_softmax_with_cross_entropy, hsigmoid_loss,
-    teacher_student_sigmoid_loss,
+    teacher_student_sigmoid_loss, hinge_loss,
 )
 from .attention import scaled_dot_product_attention  # noqa: F401
 from .vision import (  # noqa: F401

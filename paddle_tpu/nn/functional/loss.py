@@ -574,3 +574,11 @@ def teacher_student_sigmoid_loss(input, label,  # noqa: A002
                       jnp.where(lab < 1.0, bce0 + soft, bce1 + soft)))
 
     return call_op(_ts, input, label, op_name="teacher_student_sigmoid_loss")
+
+
+def hinge_loss(input, label):  # noqa: A002
+    """reference: operators/hinge_loss_op.h — loss = max(0, 1 - (2y-1)*x)
+    with y in {0, 1}."""
+    def _h(x, y):
+        return jnp.maximum(0.0, 1.0 - (2.0 * y - 1.0) * x)
+    return call_op(_h, input, label, op_name="hinge_loss")

@@ -19,7 +19,7 @@ __all__ = [
     "nanmean", "nansum", "deg2rad", "rad2deg", "gcd", "lcm", "heaviside",
     "digamma", "lgamma", "conj", "real", "imag", "mv", "dist", "increment",
     "unbind", "broadcast_tensors", "multiplex", "crop", "squared_l2_norm",
-    "cvm", "data_norm", "fsp_matrix",
+    "cvm", "data_norm", "fsp_matrix", "partial_concat", "partial_sum",
 ]
 
 
@@ -445,3 +445,31 @@ def fsp_matrix(x, y):
         return jnp.einsum("nihw,njhw->nij", a, b) / (h * w)
 
     return call_op(_fsp, x, y, op_name="fsp_matrix")
+
+
+def partial_concat(xs, start_index=0, length=-1):
+    """Concat a column slice of each input (reference:
+    operators/partial_concat_op.cc): take [start, start+length) of axis 1
+    from every [N, D] input and concatenate."""
+    def _pc(*vals):
+        outs = []
+        for v in vals:
+            st = start_index + v.shape[1] if start_index < 0 else start_index
+            end = v.shape[1] if length < 0 else st + length
+            outs.append(v[:, st:end])
+        return jnp.concatenate(outs, axis=1)
+    return call_op(_pc, *xs, op_name="partial_concat")
+
+
+def partial_sum(xs, start_index=0, length=-1):
+    """Sum a column slice of each input (reference:
+    operators/partial_sum_op.cc)."""
+    def _ps(*vals):
+        acc = None
+        for v in vals:
+            st = start_index + v.shape[1] if start_index < 0 else start_index
+            end = v.shape[1] if length < 0 else st + length
+            sl = v[:, st:end]
+            acc = sl if acc is None else acc + sl
+        return acc
+    return call_op(_ps, *xs, op_name="partial_sum")

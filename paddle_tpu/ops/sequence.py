@@ -7,6 +7,7 @@ everything is padded + length-masked static shapes, which is what XLA needs.
 vector, with host converters both ways. The sequence_* functional ops work
 on (data, lengths) pairs.
 """
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -358,3 +359,81 @@ def row_conv(input, weight):  # noqa: A002
         return out
 
     return call_op(_rc, input, weight, op_name="row_conv")
+
+
+def sequence_conv(x, filter, context_length, context_start=None,  # noqa: A002
+                  lengths=None, padding_value=0.0):
+    """Context-window convolution over time (reference:
+    operators/sequence_ops/sequence_conv_op.cc): each step concatenates
+    its [context_start, context_start+context_length) window and applies
+    one projection. x: [B, T, D]; filter: [context_length*D, out]."""
+    start = (-((context_length - 1) // 2) if context_start is None
+             else context_start)
+    lens = None if lengths is None else unwrap(lengths)
+
+    def _sc(v, w):
+        B, T, D = v.shape
+        pre = max(0, -start)
+        post = max(0, start + context_length - 1)
+        pad = jnp.pad(v, ((0, 0), (pre, post), (0, 0)),
+                      constant_values=padding_value)
+        if lens is not None:  # zero beyond each sequence's length
+            pos = jnp.arange(T + pre + post) - pre
+            valid = (pos[None, :] >= 0) & (pos[None, :] < lens[:, None])
+            pad = jnp.where(valid[..., None], pad, padding_value)
+        # window element i covers input time t + start + i; with `pre`
+        # left-padding that is pad index t + (start + i + pre)
+        cols = jnp.concatenate(
+            [pad[:, start + i + pre:start + i + pre + T]
+             for i in range(context_length)], axis=-1)
+        return cols @ w
+
+    return call_op(_sc, x, filter, op_name="sequence_conv")
+
+
+def sequence_reshape(x, new_dim):
+    """reference: operators/sequence_ops/sequence_reshape_op.cc — refold
+    the feature dim: [B, T, D] -> [B, T*D/new_dim, new_dim]."""
+
+    def _sr(v):
+        B, T, D = v.shape
+        return v.reshape(B, T * D // new_dim, new_dim)
+
+    return call_op(_sr, x, op_name="sequence_reshape")
+
+
+def sequence_scatter(x, index, updates):
+    """Add updates at per-sequence positions (reference:
+    operators/sequence_ops/sequence_scatter_op.cc). x: [B, T];
+    index/updates: [B, K]."""
+    idx = unwrap(index).astype("int32")
+
+    def _ss(v, u):
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v.at[rows, idx].add(u)
+
+    return call_op(_ss, x, updates, op_name="sequence_scatter")
+
+
+def im2sequence(x, filter_size, stride=1, padding=0):
+    """Sliding-window patch extraction (reference:
+    operators/im2sequence_op.cc): [N, C, H, W] ->
+    [N * oh * ow, C * kh * kw] row-major over output positions."""
+    kh, kw = ((filter_size, filter_size) if isinstance(filter_size, int)
+              else tuple(filter_size))
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+
+    def _i2s(v):
+        # one conv_general_dilated_patches via the shared im2col (unfold):
+        # [N, C*kh*kw, oh*ow] with (C, kh, kw)-major columns — the same
+        # row layout the reference emits
+        N = v.shape[0]
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=(kh, kw), window_strides=(sh, sw),
+            padding=[(ph, ph), (pw, pw)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        ckk = patches.shape[1]
+        return patches.reshape(N, ckk, -1).transpose(0, 2, 1)                       .reshape(-1, ckk)
+
+    return call_op(_i2s, x, op_name="im2sequence")

@@ -547,3 +547,68 @@ class TestEvalCallbacks:
         assert "loss" in out
         body = (tmp_path / "eval.tsv").read_text()
         assert "eval/loss" in body
+
+
+class TestSequenceTail2:
+    def test_hinge_loss(self):
+        out = F.hinge_loss(t(np.array([0.5, -0.5, 2.0], np.float32)),
+                           t(np.array([1.0, 0.0, 1.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [0.5, 0.5, 0.0])
+
+    def test_sequence_conv_matches_manual(self):
+        x = rng.rand(1, 4, 2).astype(np.float32)
+        w = rng.rand(6, 3).astype(np.float32)  # ctx=3 * D=2
+        out = seq.sequence_conv(t(x), t(w), 3).numpy()
+        # manual: window [t-1, t, t+1] zero-padded
+        pad = np.concatenate([np.zeros((1, 1, 2)), x, np.zeros((1, 1, 2))],
+                             axis=1)
+        cols = np.concatenate([pad[:, i:i + 4] for i in range(3)], axis=-1)
+        np.testing.assert_allclose(out, cols @ w, rtol=1e-5)
+
+    def test_sequence_reshape_scatter_im2sequence(self):
+        x = t(np.arange(12, dtype=np.float32).reshape(1, 2, 6))
+        r = seq.sequence_reshape(x, 4)
+        assert r.shape == [1, 3, 4]
+        np.testing.assert_allclose(r.numpy().ravel(), np.arange(12))
+        sx = seq.sequence_scatter(
+            t(np.zeros((2, 6), np.float32)),
+            t(np.array([[1, 2], [0, 5]])), t(np.ones((2, 2), np.float32)))
+        assert sx.numpy()[0, 1] == 1 and sx.numpy()[1, 5] == 1
+        patches = seq.im2sequence(
+            t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)), 2, 2)
+        assert patches.shape == [4, 4]
+        np.testing.assert_allclose(patches.numpy()[0], [0, 1, 4, 5])
+
+    def test_partial_concat_sum(self):
+        a = t(np.array([[1.0, 2, 3, 4]], np.float32))
+        b = t(np.array([[10.0, 20, 30, 40]], np.float32))
+        np.testing.assert_allclose(
+            paddle.partial_concat([a, b], 1, 2).numpy(), [[2, 3, 20, 30]])
+        np.testing.assert_allclose(
+            paddle.partial_sum([a, b], 1, 2).numpy(), [[22, 33]])
+
+    def test_prroi_pool(self):
+        from paddle_tpu.vision.ops import prroi_pool
+        feat = t(np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6))
+        out = prroi_pool(feat, t(np.array([[0, 0, 5, 5]], np.float32)),
+                         t(np.array([1], np.int32)), 2)
+        assert out.shape == [1, 1, 2, 2]
+        # integral-average of a linear ramp: bin centers
+        v = out.numpy()[0, 0]
+        assert v[0, 0] < v[0, 1] < v[1, 1]
+
+    def test_sequence_conv_positive_context_start(self):
+        # look-ahead window: out[t] = x[t+1] (ctx=1, start=1)
+        x = np.arange(8, dtype=np.float32).reshape(1, 4, 2)
+        w = np.eye(2, dtype=np.float32)
+        out = seq.sequence_conv(t(x), t(w), 1, context_start=1).numpy()
+        want = np.concatenate([x[:, 1:], np.zeros((1, 1, 2))], axis=1)
+        np.testing.assert_allclose(out, want)
+
+    def test_partial_ops_negative_start(self):
+        a = t(np.array([[1.0, 2, 3, 4]], np.float32))
+        b = t(np.array([[10.0, 20, 30, 40]], np.float32))
+        np.testing.assert_allclose(
+            paddle.partial_concat([a, b], -1, 1).numpy(), [[4, 40]])
+        np.testing.assert_allclose(
+            paddle.partial_sum([a, b], -2, 2).numpy(), [[33, 44]])
