@@ -434,6 +434,7 @@ def im2sequence(x, filter_size, stride=1, padding=0):
             padding=[(ph, ph), (pw, pw)],
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
         ckk = patches.shape[1]
-        return patches.reshape(N, ckk, -1).transpose(0, 2, 1)                       .reshape(-1, ckk)
+        cols = patches.reshape(N, ckk, -1).transpose(0, 2, 1)
+        return cols.reshape(-1, ckk)
 
     return call_op(_i2s, x, op_name="im2sequence")

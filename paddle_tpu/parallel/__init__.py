@@ -4,6 +4,7 @@ Long-context (ring/Ulysses attention) and in-XLA pipelining; the
 building blocks under paddle_tpu.distributed's reference-shaped API.
 """
 from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .moe import moe_ffn, switch_route  # noqa: F401
 from .pipeline import (  # noqa: F401
     spmd_pipeline, spmd_pipeline_1f1b, ring_buffer_size,
     pipelined_transformer_step,

@@ -1,0 +1,100 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis.
+
+Beyond the reference's capability bar (the snapshot has no MoE /
+global_scatter-gather, SURVEY.md §1 L3) but first-class here per the
+TPU-native design: experts shard over the 'ep' mesh axis and tokens move
+through ONE all_to_all each way over ICI — the XLA-collective form of the
+later reference releases' global_scatter/global_gather op pair.
+
+Switch-style top-1 routing with a static per-expert capacity (XLA needs
+static shapes; overflow tokens fall through with their residual, the
+standard capacity-factor semantics). Everything is differentiable jnp, so
+the same code runs single-device (no mesh) or inside shard_map with the
+'ep' axis bound.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def switch_route(x, gate_w, num_experts, capacity):
+    """Top-1 routing. x: [T, D]; gate_w: [D, E].
+    Returns (dispatch [T] expert ids, pos [T] slot ids (capacity-clipped,
+    -1 = dropped), prob [T] gate prob of the chosen expert,
+    probs [T, E] full routing distribution)."""
+    logits = x @ gate_w                      # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)      # [T]
+    prob = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot per expert
+    pos = jnp.sum(pos, axis=-1) - 1            # [T], 0-based
+    pos = jnp.where(pos < capacity, pos, -1)   # overflow -> dropped
+    return expert, pos, prob, probs
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, axis_name=None, capacity_factor=1.25,
+            activation=jax.nn.gelu):
+    """Switch-FFN layer. x: [T, D] local tokens; experts:
+    w1 [E_local, D, F], w2 [E_local, F, D] (the full expert set when
+    axis_name is None). Returns (y [T, D], aux_loss) where aux_loss is the
+    Switch load-balancing loss (fraction * mean-prob dot product).
+
+    With axis_name bound (inside shard_map), each device owns E_local
+    experts of E = E_local * ep_size and tokens are exchanged with one
+    all_to_all per direction."""
+    T, D = x.shape
+    e_local = w1.shape[0]
+    if axis_name is None:
+        ep = 1
+        my = 0
+    else:
+        ep = jax.lax.psum(1, axis_name)
+        my = jax.lax.axis_index(axis_name)
+    E = e_local * ep
+    # per-expert capacity for the LOCAL token batch
+    cap = max(1, int(capacity_factor * T / E))
+
+    expert, pos, prob, probs_f = switch_route(x, gate_w, E, cap)
+
+    # Switch aux loss: E * sum_e fraction_e * mean_prob_e, with the
+    # routing statistics averaged over the ep group first so every device
+    # sees the same GLOBAL load-balance objective (pmean of per-device aux
+    # would optimize local balance only)
+    frac = jnp.mean(jax.nn.one_hot(expert, E, dtype=jnp.float32), axis=0)
+    mean_p = jnp.mean(probs_f, axis=0)
+    if axis_name is not None:
+        frac = jax.lax.pmean(frac, axis_name)
+        mean_p = jax.lax.pmean(mean_p, axis_name)
+    aux = E * jnp.sum(frac * mean_p)
+
+    # dispatch: [E, cap, D], dropped tokens scatter nowhere
+    keep = pos >= 0
+    slot = jnp.where(keep, pos, cap)  # out-of-range -> dropped by mode
+    disp = jnp.zeros((E, cap + 1, D), x.dtype)
+    disp = disp.at[expert, slot].set(x, mode="drop")[:, :cap]
+
+    if axis_name is not None:
+        # [E, cap, D] -> [ep, E_local, cap, D]; all_to_all swaps the ep
+        # shard axis for the peer axis: afterwards each device holds its
+        # E_local experts' slots from EVERY peer -> [E_local, ep*cap, D]
+        disp = disp.reshape(ep, e_local, cap, D)
+        disp = jax.lax.all_to_all(disp, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        disp = jnp.swapaxes(disp, 0, 1).reshape(e_local, ep * cap, D)
+    else:
+        disp = disp.reshape(e_local, cap, D)
+
+    # expert FFN, batched over local experts
+    h = activation(jnp.einsum("ecd,edf->ecf", disp, w1) + b1[:, None, :])
+    y = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+
+    if axis_name is not None:
+        y = jnp.swapaxes(y.reshape(e_local, ep, cap, D), 0, 1)
+        y = jax.lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                               tiled=False)
+        y = y.reshape(E, cap, D)
+    # gather back to token order; dropped tokens get 0 (residual passes x)
+    safe_slot = jnp.where(keep, pos, 0)
+    out = y[expert, safe_slot]
+    out = jnp.where(keep[:, None], out, 0.0)
+    return out * prob[:, None].astype(out.dtype), aux
