@@ -40,6 +40,23 @@ def main():
         model[2].weight.pspec = P("mp", None)
         model[2].bias.pspec = P()
         model = fleet.distributed_model(model)
+    elif mode == "hybrid" and world > 1:
+        # multi-host hybrid: dp axis spans the PROCESS boundary (the DCN
+        # analog), mp shards megatron-style within each process (ICI)
+        import jax as _jax
+        procs = _jax.process_count()
+        mp = world // procs
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": procs, "mp_degree": mp,
+                                   "pp_degree": 1, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        model[0].weight.pspec = P(None, "mp")
+        model[0].bias.pspec = P("mp")
+        model[2].weight.pspec = P("mp", None)
+        model[2].bias.pspec = P()
+        model = fleet.distributed_model(model)
     else:
         model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
                               nn.Linear(32, 4))
@@ -61,8 +78,10 @@ def main():
         return loss
 
     sfn = paddle.jit.to_static(step)
-    if world > 1 and mode != "mp":
-        sfn._arg_pspecs = [P("dp"), P("dp")]  # mp: batch stays replicated
+    if world > 1 and mode == "dp":
+        sfn._arg_pspecs = [P("dp"), P("dp")]
+    elif world > 1 and mode == "hybrid":
+        sfn._arg_pspecs = [P("dp"), P("dp")]  # batch over dp, mp replicated
 
     rng = np.random.RandomState(7)
     for i in range(5):

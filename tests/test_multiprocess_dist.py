@@ -45,13 +45,14 @@ def _run_single():
     return _losses(r.stdout)
 
 
-def _run_launcher(nproc, log_dir, mode="dp", port="19850"):
+def _run_launcher(nproc, log_dir, mode="dp", port="19850", host_devices=1):
     env = _clean_env()
     env["DIST_FIXTURE_MODE"] = mode
     r = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", str(nproc), "--started_port", port,
-         "--host_devices", "1", "--log_dir", str(log_dir), FIXTURE],
+         "--host_devices", str(host_devices), "--log_dir", str(log_dir),
+         FIXTURE],
         capture_output=True, text=True, env=env, timeout=600, cwd=REPO)
     assert r.returncode == 0, (r.stderr[-2000:] or "") + _tail_logs(log_dir)
     with open(os.path.join(log_dir, "workerlog.0")) as f:
@@ -87,6 +88,17 @@ class TestDistLossParity:
         mp2 = _run_launcher(2, str(tmp_path), mode="mp", port="19890")
         assert len(mp2) == 5
         np.testing.assert_allclose(single, mp2, rtol=1e-4, atol=1e-6)
+
+    def test_two_proc_four_dev_hybrid_matches_single(self, tmp_path):
+        """Multi-host hybrid mesh: 2 processes x 4 virtual devices = 8
+        global devices, dp across the process boundary (DCN analog) and
+        megatron mp within each process (ICI analog). Loss parity vs one
+        process, one device."""
+        single = _run_single()
+        hyb = _run_launcher(2, str(tmp_path), mode="hybrid", port="19930",
+                            host_devices=4)
+        assert len(hyb) == 5
+        np.testing.assert_allclose(single, hyb, rtol=1e-4, atol=1e-6)
 
 
 def _spawn_worker(scale):
