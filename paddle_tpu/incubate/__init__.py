@@ -6,6 +6,7 @@ ring attention (context parallelism) lives in paddle_tpu.parallel.
 from ..nn.functional.activation import softmax  # noqa: F401
 from . import auto_checkpoint  # noqa: F401
 from .custom_op import load_custom_op  # noqa: F401
+from . import moe  # noqa: F401
 from ..optimizer.averaging import (  # noqa: F401
     ModelAverage, LookAhead,
 )

@@ -89,3 +89,37 @@ def test_switch_route_slots_unique():
     pairs = set(zip(e[kept].tolist(), p[kept].tolist()))
     assert len(pairs) == kept.sum()  # no slot collisions
     assert (np.asarray(prob) > 0).all()
+
+
+class TestMoELayer:
+    def test_moe_layer_trains(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.moe import MoELayer
+        paddle.seed(0)
+        layer = MoELayer(16, 32, 4)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 8, 16).astype("float32"))
+        opt = paddle.optimizer.AdamW(parameters=layer.parameters(),
+                                     learning_rate=1e-3)
+
+        @paddle.jit.to_static
+        def step(v):
+            out = layer(v)
+            loss = out.square().mean() + 0.01 * layer.aux_loss
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        l0 = float(step(x).numpy())
+        for _ in range(5):
+            l1 = float(step(x).numpy())
+        assert l1 < l0
+        assert float(layer.aux_loss.numpy()) > 0
+
+    def test_moe_layer_shard_experts_annotates(self):
+        from paddle_tpu.incubate.moe import MoELayer
+        from jax.sharding import PartitionSpec as P
+        layer = MoELayer(8, 16, 4).shard_experts("ep")
+        assert layer.w1.pspec == P("ep")
+        assert layer.gate_weight.pspec is None  # gate stays replicated
