@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
-from .dtype import is_floating
+from .dtype import is_inexact
 
 __all__ = ["call_op", "call_op_nograd", "wrap", "unwrap", "_STATIC_HOOK",
            "add_observer", "remove_observer", "OpCapture", "capture_ops"]
@@ -214,11 +214,11 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
     diff_positions, diff_tensors = [], []
     if autograd.grad_enabled():
         for i, a in enumerate(args):
-            if _is_tensor(a) and not a.stop_gradient and is_floating(a.dtype):
+            if _is_tensor(a) and not a.stop_gradient and is_inexact(a.dtype):
                 diff_positions.append(("a", i))
                 diff_tensors.append(a)
         for k, v in kwargs.items():
-            if _is_tensor(v) and not v.stop_gradient and is_floating(v.dtype):
+            if _is_tensor(v) and not v.stop_gradient and is_inexact(v.dtype):
                 diff_positions.append(("k", k))
                 diff_tensors.append(v)
 

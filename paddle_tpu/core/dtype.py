@@ -61,6 +61,13 @@ def is_floating(dtype):
     return jnp.issubdtype(d, jnp.floating)
 
 
+def is_inexact(dtype):
+    """floating OR complex — the differentiable dtypes (the reference has
+    grad kernels for complex ops too: real_grad/imag_grad/conj_grad)."""
+    d = np.dtype(dtype)
+    return jnp.issubdtype(d, jnp.inexact)
+
+
 def is_integer(dtype):
     d = np.dtype(dtype)
     return jnp.issubdtype(d, jnp.integer) or d == np.bool_

@@ -1,11 +1,13 @@
 """Dygraph MoE layer over parallel.moe (name-compatible with the later
 reference releases' paddle.incubate.distributed.models.moe.MoELayer; this
 snapshot has no MoE — see COMPONENTS.md 'Beyond the reference')."""
+import zlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.dispatch import call_op, unwrap
+from ..core.dispatch import call_op, unwrap, wrap
 from ..nn.layer.layers import Layer
 from ..parallel.moe import moe_ffn
 
@@ -27,7 +29,10 @@ class MoELayer(Layer):
         self.capacity_factor = capacity_factor
         self._act = activation
         k = 1.0 / np.sqrt(d_model)
-        rng = np.random.RandomState(hash(name) % (2 ** 31) if name else 0)
+        # stable across processes/ranks (python hash() is salted per process
+        # and would desync replicated inits in multi-process dp)
+        rng = np.random.RandomState(
+            zlib.crc32(name.encode()) % (2 ** 31) if name else 0)
         self.gate_weight = self.create_parameter(
             [d_model, num_experts],
             default_initializer=lambda s, d: jnp.asarray(
@@ -46,7 +51,10 @@ class MoELayer(Layer):
         self.b2 = self.create_parameter(
             [num_experts, d_model],
             default_initializer=lambda s, d: jnp.zeros(s, d))
-        self.aux_loss = None
+        # registered buffer: assignment during a @to_static trace threads
+        # through the compiled step instead of stranding a tracer
+        self.register_buffer("aux_loss", wrap(jnp.zeros((), jnp.float32)),
+                             persistable=False)
 
     def shard_experts(self, axis="ep"):
         """Annotate expert params for expert parallelism over `axis`."""

@@ -13,6 +13,28 @@ from ...core.tensor import Parameter, Tensor
 from .. import initializer as I
 
 
+def _check_trace_stash(layer_name, attr_name, value):
+    """Reject stashing a traced Tensor on a plain Layer attribute.
+
+    Inside a @to_static trace, a Tensor assigned to an unregistered
+    attribute would hold a dead tracer after compilation (the value is
+    never threaded through the compiled program). Registered buffers ARE
+    threaded — point the user there."""
+    import jax
+
+    if not isinstance(getattr(value, "_value", None), jax.core.Tracer):
+        return
+    from ...jit.to_static import in_tracing
+    if in_tracing():
+        raise RuntimeError(
+            f"cannot assign a traced Tensor to plain attribute "
+            f"'{layer_name}.{attr_name}' inside a @to_static trace: the "
+            f"value would be a dead tracer after compilation. Register it "
+            f"first (self.register_buffer({attr_name!r}, paddle.zeros(...), "
+            f"persistable=False) in __init__) so assignments thread "
+            f"through the compiled step, or return it from forward().")
+
+
 class ParamAttr:
     """Mirror of `paddle.ParamAttr` — name/initializer/trainable/regularizer."""
 
@@ -71,9 +93,28 @@ class Layer:
             buffers = self.__dict__.get("_buffers")
             if buffers is not None and name in buffers:
                 if isinstance(value, Tensor):
+                    cur = buffers[name]
+                    if cur is not None and cur is not value:
+                        # in-place update keeps the registered state entry
+                        # alive so writes inside a @to_static trace thread
+                        # through the compiled program (the Scope-Variable
+                        # in-place semantics of the reference); replacing
+                        # the object would strand a tracer after the trace.
+                        # Tape linkage must follow wholesale or gradients
+                        # through the buffer are silently dropped/misseeded.
+                        cur._value = value._value
+                        cur._tape_node = value._tape_node
+                        cur._tape_index = value._tape_index
+                        cur.stop_gradient = value.stop_gradient
+                        return
+                    if cur is None:
+                        _check_trace_stash(type(self).__name__, name, value)
+                        value._mark_stateful()
                     buffers[name] = value
                     return
                 del buffers[name]
+            if isinstance(value, Tensor):
+                _check_trace_stash(type(self).__name__, name, value)
             object.__setattr__(self, name, value)
 
     def __getattr__(self, name):

@@ -287,18 +287,18 @@ def lgamma(x):
 
 
 def conj(x):
-    """reference: operators/conj_op.cc."""
-    return call_op_nograd(lambda v: jnp.conj(v), x, op_name="conj")
+    """reference: operators/conj_op.cc (has conj_grad kernel)."""
+    return call_op(lambda v: jnp.conj(v), x, op_name="conj")
 
 
 def real(x):
-    """reference: operators/real_op.cc."""
-    return call_op_nograd(lambda v: jnp.real(v), x, op_name="real")
+    """reference: operators/real_op.cc (has real_grad kernel)."""
+    return call_op(lambda v: jnp.real(v), x, op_name="real")
 
 
 def imag(x):
-    """reference: operators/imag_op.cc."""
-    return call_op_nograd(lambda v: jnp.imag(v), x, op_name="imag")
+    """reference: operators/imag_op.cc (has imag_grad kernel)."""
+    return call_op(lambda v: jnp.imag(v), x, op_name="imag")
 
 
 def mv(x, vec):

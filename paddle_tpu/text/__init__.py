@@ -378,11 +378,19 @@ def crf_decoding(input, transition, label=None, length=None):  # noqa: A002
         _, path_rev = jax.lax.scan(back, last, backptrs, reverse=True)
         path = jnp.concatenate(
             [jnp.moveaxis(path_rev, 0, 1), last[:, None]], axis=1)
-        # padded slots report tag at the sequence end (consistent carry)
-        return path
+        # reference crf_decoding_op.h forces 0 past each sequence length
+        # (the scan carry would otherwise report the end tag there)
+        return jnp.where(jnp.arange(T)[None, :] < ln[:, None], path, 0)
 
     path = _dec(emis)
     if label is not None:
         lab = unwrap(label).astype(path.dtype)
-        return wrap((path == lab).astype(jnp.int64))
+        ok = (path == lab)
+        if lens is not None:
+            # reference crf_decoding_op.h:63-70 forces 0 past each
+            # sequence length; the carried end-tag can coincide with a
+            # padded label otherwise
+            T = path.shape[1]
+            ok = jnp.where(jnp.arange(T)[None, :] < lens[:, None], ok, False)
+        return wrap(ok.astype(jnp.int64))
     return wrap(path)

@@ -672,8 +672,10 @@ def anchor_generator(input, anchor_sizes, aspect_ratios, stride,  # noqa: A002
     half_h = jnp.asarray(hs, jnp.float32)
     num = half_w.shape[0]
 
-    cx = (jnp.arange(W, dtype=jnp.float32) * sw + offset * sw)
-    cy = (jnp.arange(H, dtype=jnp.float32) * sh + offset * sh)
+    # reference anchor_generator_op.h:68 centers at w_idx*stride +
+    # offset*(stride-1), not offset*stride
+    cx = (jnp.arange(W, dtype=jnp.float32) * sw + offset * (sw - 1))
+    cy = (jnp.arange(H, dtype=jnp.float32) * sh + offset * (sh - 1))
     anchors = jnp.stack([
         jnp.broadcast_to(cx[None, :, None], (H, W, num)) - half_w,
         jnp.broadcast_to(cy[:, None, None], (H, W, num)) - half_h,

@@ -201,3 +201,24 @@ def test_metrics_auc_precision_recall():
     r = Recall()
     r.update(preds[:, 1], labels[:, 0])
     assert 0.0 <= r.accumulate() <= 1.0
+
+
+def test_crf_decoding_masks_padded_slots():
+    """reference crf_decoding_op.h:63-70 forces 0 beyond each sequence
+    length — both in the decoded path and in label-comparison mode."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import text
+
+    rng = np.random.RandomState(3)
+    B, T, N = 2, 5, 4
+    emis = paddle.to_tensor(rng.rand(B, T, N).astype("float32"))
+    trans = paddle.to_tensor(rng.rand(N + 2, N).astype("float32"))
+    lens = paddle.to_tensor(np.array([3, 5], dtype=np.int64))
+    path = text.crf_decoding(emis, trans, length=lens).numpy()
+    assert (path[0, 3:] == 0).all()
+
+    # label mode: a padded label equal to the carried tag must not score 1
+    lab = paddle.to_tensor(np.zeros((B, T), dtype=np.int64))
+    ok = text.crf_decoding(emis, trans, label=lab, length=lens).numpy()
+    assert (ok[0, 3:] == 0).all()

@@ -238,3 +238,46 @@ def test_spectral_norm_power_iteration_live_under_to_static():
     u2 = sn.weight_u.numpy().copy()
     # converges towards the leading singular vector: keeps moving, bounded
     assert np.isfinite(u2).all() and abs(np.linalg.norm(u2) - 1.0) < 1e-3
+
+
+def test_to_static_rejects_traced_attr_stash():
+    """A traced Tensor stashed on a plain Layer attribute must raise at
+    assignment (it would be a dead tracer after compilation); a registered
+    buffer threads through instead (regression: the MoE aux-loss leak)."""
+    import pytest
+
+    class Stasher(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            y = self.fc(x)
+            self.diag = y.mean()  # plain attribute: must be rejected
+            return y
+
+    m = Stasher()
+    x = paddle.to_tensor(rng.rand(2, 4).astype("float32"))
+    step = paddle.jit.to_static(lambda t: m(t).sum())
+    with pytest.raises(RuntimeError, match="register_buffer"):
+        step(x)
+
+    class Buffered(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.register_buffer("diag", paddle.zeros([1]),
+                                 persistable=False)
+
+        def forward(self, x):
+            y = self.fc(x)
+            self.diag = y.mean().reshape([1])
+            return y
+
+    m2 = Buffered()
+    step2 = paddle.jit.to_static(lambda t: m2(t).sum())
+    step2(x)
+    step2(x)
+    got = float(m2.diag.numpy()[0])
+    want = float(m2.fc(x).mean().numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5)

@@ -166,3 +166,22 @@ class TestStructured:
         h = paddle.histogram(Tensor(np.array([1.0, 2.0], np.float32)),
                              bins=2, min=0, max=3)
         assert "int" in str(h.dtype)
+
+
+def test_complex_ops_have_gradients():
+    """conj/real/imag have grad kernels in the reference (conj_grad etc.);
+    complex dtypes must be selected as differentiable by dispatch."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import ops
+
+    z = paddle.to_tensor(np.array([1 + 2j, 3 - 1j], dtype=np.complex64))
+    z.stop_gradient = False
+    ops.real(z).backward()
+    assert z.grad is not None
+    np.testing.assert_allclose(z.grad.numpy(), [1 + 0j, 1 + 0j])
+
+    z2 = paddle.to_tensor(np.array([1 + 2j, 3 - 1j], dtype=np.complex64))
+    z2.stop_gradient = False
+    ops.conj(z2).backward()
+    assert z2.grad is not None
