@@ -1,0 +1,102 @@
+"""Back-to-back A/B experiments on the flagship bench step (one process,
+same chip state). Each variant rebuilds the model + programs from scratch.
+
+Usage: python benchmarks/ab_mfu.py [variant ...]   (variant: k<N>[_b<N>])
+
+Measured history on the shared v5e (for future rounds — don't re-try losers):
+- pallas flash attention at seq 512 (ours AND jax's tuned tpu kernel):
+  LOSES ~1.5-2x fwd+bwd vs XLA's materializing attention. The >=1024 gate
+  in nn/functional/attention.py stands.
+- batch 32 / 64: lose (HBM working set vs 16).
+- per-layer remat, MLM-head remat: lose ~1.5-3%.
+- monolith WITHOUT barrier == split programs; monolith WITH
+  optimization_barrier over grads beats both (~4%).
+- k-unroll: k8 -> +2%, k16 -> +3.5% over k1; k32 compile >10 min (too slow).
+- pallas fused linear-CE: analyzed, not attempted — the head cluster is
+  already ~80% matmul-bound; chunked backwards add more recompute flops or
+  HBM round-trips than they save.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_step(k=16, batch=16, seq=512):
+    """The flagship program, identical to bench.py: k unrolled training
+    steps, optimization_barrier between backward and AdamW. Returns
+    (step_fn, args, model) with step_fn compiled via to_static."""
+    import jax.lax as lax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import BertConfig, BertForPretraining, \
+        synthetic_mlm_batch
+
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=30720, hidden_dropout=0.0,
+                     attention_dropout=0.0)
+    model = BertForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-4)
+    params = list(model.parameters())
+
+    def one_step(ids, tok, labels, nsp_labels):
+        with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+            logits, nsp = model(ids, tok)
+            loss = model.loss(logits, nsp, labels, nsp_labels)
+        loss.backward()
+        withg = [p for p in params if p._grad is not None]
+        if withg:
+            barred = lax.optimization_barrier(tuple(p._grad for p in withg))
+            for p, v in zip(withg, barred):
+                p._grad = v
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def k_steps(*a):
+        for _ in range(k):
+            loss = one_step(*a)
+        return loss
+
+    step = paddle.jit.to_static(k_steps)
+    ids, tok, labels, nsp = synthetic_mlm_batch(batch, seq,
+                                                vocab_size=cfg.vocab_size)
+    args = tuple(paddle.to_tensor(x) for x in (ids, tok, labels, nsp))
+    return step, args, model
+
+
+def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2):
+    seq = 512
+    step, args, model = build_step(k=k, batch=batch, seq=seq)
+    for _ in range(warmup):
+        loss = step(*args)
+    float(loss.numpy())
+    best = 0.0
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(*args)
+        lv = float(loss.numpy())
+        dt = time.perf_counter() - t0
+        best = max(best, batch * seq * iters * k / dt)
+    mfu = best * model.flops_per_token(seq) / 197e12
+    print(f"{name:14s} tokens/s={best:9.1f} ms/step={batch*seq*1e3/best:6.2f} "
+          f"mfu={mfu:.4f} loss={lv:.3f}", flush=True)
+    return mfu
+
+
+def main():
+    for spec in sys.argv[1:] or ["k16"]:
+        k, batch = 16, 16
+        for part in spec.split("_"):
+            if part.startswith("k"):
+                k = int(part[1:])
+            elif part.startswith("b"):
+                batch = int(part[1:])
+        run_variant(spec, k=k, batch=batch)
+
+
+if __name__ == "__main__":
+    main()

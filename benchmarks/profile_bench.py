@@ -1,0 +1,72 @@
+"""Profile the flagship bench program (the same k-unrolled barrier program
+bench.py runs) on the TPU and print a per-op-category time breakdown from
+the XPlane trace's device 'XLA Ops' line.
+
+Async '-start' events (VMEM prefetch etc.) overlap compute and would
+double-count; only sync events are aggregated.
+"""
+import collections
+import glob
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ab_mfu import build_step  # noqa: E402
+
+
+def parse_xplane(trace_dir, n_steps):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise SystemExit(f"no xplane.pb under {trace_dir}")
+    xs = xplane_pb2.XSpace()
+    xs.ParseFromString(open(sorted(paths)[-1], "rb").read())
+    for plane in xs.planes:
+        if "TPU" not in plane.name and "Device" not in plane.name:
+            continue
+        ev_meta = {m.id: m.name for m in plane.event_metadata.values()}
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            agg = collections.Counter()
+            cnt = collections.Counter()
+            tot = 0
+            for e in line.events:
+                n = ev_meta.get(e.metadata_id, "?")
+                head = n.split(" = ")[0]
+                if "-start" in head:  # async: overlaps compute
+                    continue
+                base = re.sub(r"\.\d+$", "", head.lstrip("%"))
+                agg[base] += e.duration_ps
+                cnt[base] += 1
+                tot += e.duration_ps
+            print(f"device sync busy: {tot/1e12*1e3:.1f} ms over {n_steps} "
+                  f"steps ({tot/1e12/n_steps*1e3:.2f} ms/step)")
+            for n, d in agg.most_common(25):
+                print(f"{d/tot*100:6.2f}% {d/1e12/n_steps*1e3:8.3f} ms/step "
+                      f"x{cnt[n]//n_steps:5d}  {n}")
+
+
+def main():
+    import jax
+
+    k = 16
+    step, args, _ = build_step(k=k)
+    for _ in range(2):
+        loss = step(*args)
+    float(loss.numpy())
+
+    trace_dir = "/tmp/xplane_bench"
+    os.system(f"rm -rf {trace_dir}")
+    with jax.profiler.trace(trace_dir):
+        loss = step(*args)
+        float(loss.numpy())
+    parse_xplane(trace_dir, n_steps=k)
+
+
+if __name__ == "__main__":
+    main()
