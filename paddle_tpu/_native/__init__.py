@@ -14,7 +14,8 @@ import subprocess
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "src", "pt_runtime.cc")
+_SRCS = [os.path.join(_HERE, "src", "pt_runtime.cc"),
+         os.path.join(_HERE, "src", "ps_service.cc")]
 _LIB = os.path.join(_HERE, "libpaddle_tpu_rt.so")
 
 AVAILABLE = False
@@ -25,8 +26,11 @@ _lock = threading.Lock()
 
 def _src_digest():
     import hashlib
-    with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()[:16]
+    h = hashlib.sha256()
+    for src in _SRCS:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
 
 
 def _needs_build():
@@ -52,7 +56,7 @@ def _build():
     try:
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-            "-fvisibility=hidden", "-o", tmp, _SRC, "-lrt",
+            "-fvisibility=hidden", "-o", tmp, *_SRCS, "-lrt",
         ]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.chmod(tmp, 0o755)  # mkstemp creates 0600; the lib must be
@@ -97,6 +101,17 @@ def _bind(lib):
         "pt_ring_free": (None, [VP, I]),
         "pt_ring_used": (LL, [VP]),
         "pt_runtime_version": (I, []),
+        # parameter-server service (ps_service.cc)
+        "pt_ps_reset": (None, []),
+        "pt_ps_add_dense": (None, [c.c_uint32, I, I, c.c_float, c.c_float,
+                                   c.c_float, c.c_float]),
+        "pt_ps_add_sparse": (None, [c.c_uint32, I, I, c.c_float, c.c_float,
+                                    c.c_float, c.c_float, c.c_float,
+                                    c.c_uint64]),
+        "pt_ps_start": (I, [I]),
+        "pt_ps_stop": (None, []),
+        "pt_ps_port": (I, []),
+        "pt_ps_running": (I, []),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)

@@ -1,0 +1,609 @@
+// paddle_tpu native parameter-server service (part of libpaddle_tpu_rt.so)
+//
+// TPU-native equivalent of the reference's brpc parameter-server runtime:
+//   - dense / sparse tables      (reference: paddle/fluid/distributed/table/
+//                                 common_dense_table.cc, common_sparse_table.cc)
+//   - server-side optimizers     (reference: table/depends/dense.h, sparse.h —
+//                                 sum / sgd / adam rules applied on the server)
+//   - TCP service + handlers     (reference: distributed/service/
+//                                 brpc_ps_server.cc; brpc replaced by a
+//                                 length-prefixed binary protocol over
+//                                 loopback/DCN sockets — the TPU pod's compute
+//                                 collectives ride ICI, the PS path is host
+//                                 networking exactly like the reference)
+//   - geo delta application      (reference: service/communicator.h:497
+//                                 GeoCommunicator — workers push param deltas,
+//                                 the server accumulates them)
+//   - table snapshots            (reference: the_one_ps.py:815 save_persistables)
+//
+// Wire format (little-endian):
+//   request : u32 body_len | u8 op | u32 table | u64 n | payload
+//   response: u32 body_len | payload
+// The Python client (paddle_tpu/distributed/ps/client.py) shards sparse keys
+// across servers by key % nservers and dense tables by table % nservers.
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define PT_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+enum Op : uint8_t {
+  kPullDense = 1,
+  kPushDenseGrad = 2,
+  kPullSparse = 3,
+  kPushSparseGrad = 4,
+  kPushSparseDelta = 5,
+  kPushDenseDelta = 6,
+  kBarrier = 7,
+  kSave = 8,
+  kLoad = 9,
+  kStop = 10,
+  kSparseSize = 11,
+  kPullDenseInit = 12,  // pull, initializing from payload if first touch
+};
+
+enum OptKind : int32_t { kOptSum = 0, kOptSgd = 1, kOptAdam = 2 };
+
+struct OptConf {
+  int32_t kind = kOptSgd;
+  float lr = 0.01f;
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+};
+
+// splitmix64: deterministic per-key init so every shard/restart agrees
+inline uint64_t mix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+struct SparseTable {
+  int dim = 0;
+  OptConf opt;
+  float init_range = 0.0f;
+  uint64_t seed = 0;
+  // row layout: param[dim] | m[dim] | v[dim] (m/v only for adam)
+  std::unordered_map<uint64_t, std::vector<float>> rows;
+  std::unordered_map<uint64_t, int64_t> steps;  // adam t per row
+  std::mutex mu;
+
+  int row_len() const { return opt.kind == kOptAdam ? 3 * dim : dim; }
+
+  std::vector<float>& row(uint64_t key) {
+    auto it = rows.find(key);
+    if (it != rows.end()) return it->second;
+    std::vector<float> r(row_len(), 0.0f);
+    if (init_range > 0.0f) {
+      for (int i = 0; i < dim; ++i) {
+        uint64_t h = mix64(seed ^ mix64(key * 1315423911ull + i));
+        float u = (h >> 11) * (1.0f / 9007199254740992.0f);  // [0,1)
+        r[i] = (2.0f * u - 1.0f) * init_range;
+      }
+    }
+    return rows.emplace(key, std::move(r)).first->second;
+  }
+
+  void apply_grad(uint64_t key, const float* g) {
+    std::vector<float>& r = row(key);
+    switch (opt.kind) {
+      case kOptSum:
+        for (int i = 0; i < dim; ++i) r[i] += g[i];
+        break;
+      case kOptSgd:
+        for (int i = 0; i < dim; ++i) r[i] -= opt.lr * g[i];
+        break;
+      case kOptAdam: {
+        int64_t t = ++steps[key];
+        float* p = r.data();
+        float* m = p + dim;
+        float* v = p + 2 * dim;
+        float bc1 = 1.0f - std::pow(opt.beta1, (float)t);
+        float bc2 = 1.0f - std::pow(opt.beta2, (float)t);
+        for (int i = 0; i < dim; ++i) {
+          m[i] = opt.beta1 * m[i] + (1.0f - opt.beta1) * g[i];
+          v[i] = opt.beta2 * v[i] + (1.0f - opt.beta2) * g[i] * g[i];
+          p[i] -= opt.lr * (m[i] / bc1) / (std::sqrt(v[i] / bc2) + opt.eps);
+        }
+        break;
+      }
+    }
+  }
+};
+
+struct DenseTable {
+  int dim = 0;
+  OptConf opt;
+  std::vector<float> param, m, v;
+  int64_t t = 0;
+  bool initialized = false;
+  std::mutex mu;
+
+  void ensure(int n) {
+    if ((int)param.size() != n) param.assign(n, 0.0f);
+    if (opt.kind == kOptAdam && (int)m.size() != n) {
+      m.assign(n, 0.0f);
+      v.assign(n, 0.0f);
+    }
+  }
+
+  void apply_grad(const float* g, int n) {
+    ensure(n);
+    switch (opt.kind) {
+      case kOptSum:
+        for (int i = 0; i < n; ++i) param[i] += g[i];
+        break;
+      case kOptSgd:
+        for (int i = 0; i < n; ++i) param[i] -= opt.lr * g[i];
+        break;
+      case kOptAdam: {
+        ++t;
+        float bc1 = 1.0f - std::pow(opt.beta1, (float)t);
+        float bc2 = 1.0f - std::pow(opt.beta2, (float)t);
+        for (int i = 0; i < n; ++i) {
+          m[i] = opt.beta1 * m[i] + (1.0f - opt.beta1) * g[i];
+          v[i] = opt.beta2 * v[i] + (1.0f - opt.beta2) * g[i] * g[i];
+          param[i] -= opt.lr * (m[i] / bc1) / (std::sqrt(v[i] / bc2) + opt.eps);
+        }
+        break;
+      }
+    }
+  }
+};
+
+struct Barrier {
+  std::mutex mu;
+  std::condition_variable cv;
+  int arrived = 0;
+  int64_t generation = 0;
+};
+
+struct PsServer {
+  std::unordered_map<uint32_t, SparseTable> sparse;
+  std::unordered_map<uint32_t, DenseTable> dense;
+  Barrier barrier;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::mutex conns_mu;
+};
+
+PsServer* g_ps = nullptr;
+std::mutex g_ps_mu;
+
+SparseTable* find_sparse(PsServer* ps, uint32_t table) {
+  auto it = ps->sparse.find(table);  // registration happens before start;
+  return it == ps->sparse.end() ? nullptr : &it->second;  // never insert here
+}
+
+DenseTable* find_dense(PsServer* ps, uint32_t table) {
+  auto it = ps->dense.find(table);
+  return it == ps->dense.end() ? nullptr : &it->second;
+}
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool send_resp(int fd, const void* payload, uint32_t n) {
+  if (!write_all(fd, &n, 4)) return false;
+  return n == 0 || write_all(fd, payload, n);
+}
+
+void save_tables(PsServer* ps, const std::string& path) {
+  FILE* f = fopen(path.c_str(), "wb");
+  if (!f) return;
+  uint32_t nd = ps->dense.size(), nsp = ps->sparse.size();
+  fwrite(&nd, 4, 1, f);
+  fwrite(&nsp, 4, 1, f);
+  for (auto& kv : ps->dense) {
+    DenseTable& t = kv.second;
+    std::lock_guard<std::mutex> lk(t.mu);
+    uint32_t id = kv.first, n = t.param.size();
+    uint32_t has_mv = t.opt.kind == kOptAdam && !t.m.empty();
+    fwrite(&id, 4, 1, f);
+    fwrite(&n, 4, 1, f);
+    fwrite(&has_mv, 4, 1, f);
+    fwrite(&t.t, 8, 1, f);
+    fwrite(t.param.data(), 4, n, f);
+    if (has_mv) {
+      fwrite(t.m.data(), 4, n, f);
+      fwrite(t.v.data(), 4, n, f);
+    }
+  }
+  for (auto& kv : ps->sparse) {
+    SparseTable& t = kv.second;
+    std::lock_guard<std::mutex> lk(t.mu);
+    uint32_t id = kv.first;
+    uint64_t rows = t.rows.size();
+    uint32_t rl = t.row_len();
+    fwrite(&id, 4, 1, f);
+    fwrite(&rows, 8, 1, f);
+    fwrite(&rl, 4, 1, f);
+    for (auto& r : t.rows) {
+      fwrite(&r.first, 8, 1, f);
+      int64_t st = 0;
+      auto it = t.steps.find(r.first);
+      if (it != t.steps.end()) st = it->second;
+      fwrite(&st, 8, 1, f);
+      fwrite(r.second.data(), 4, rl, f);
+    }
+  }
+  fclose(f);
+}
+
+bool load_tables(PsServer* ps, const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return false;
+  bool ok = true;  // any short read marks the load failed (partial state
+                   // must not be reported as success)
+  uint32_t nd = 0, nsp = 0;
+  if (fread(&nd, 4, 1, f) != 1 || fread(&nsp, 4, 1, f) != 1) {
+    fclose(f);
+    return false;
+  }
+  for (uint32_t i = 0; i < nd; ++i) {
+    uint32_t id, n, has_mv;
+    int64_t step;
+    if (fread(&id, 4, 1, f) != 1 || fread(&n, 4, 1, f) != 1 ||
+        fread(&has_mv, 4, 1, f) != 1 || fread(&step, 8, 1, f) != 1) {
+      ok = false;
+      break;
+    }
+    DenseTable& t = ps->dense[id];
+    std::lock_guard<std::mutex> lk(t.mu);
+    t.param.resize(n);
+    t.t = step;
+    t.initialized = true;
+    if (fread(t.param.data(), 4, n, f) != n) { ok = false; break; }
+    if (has_mv) {
+      t.m.resize(n);
+      t.v.resize(n);
+      if (fread(t.m.data(), 4, n, f) != n) { ok = false; break; }
+      if (fread(t.v.data(), 4, n, f) != n) { ok = false; break; }
+    }
+  }
+  for (uint32_t i = 0; i < nsp; ++i) {
+    uint32_t id, rl;
+    uint64_t rows;
+    if (fread(&id, 4, 1, f) != 1 || fread(&rows, 8, 1, f) != 1 ||
+        fread(&rl, 4, 1, f) != 1) {
+      ok = false;
+      break;
+    }
+    SparseTable& t = ps->sparse[id];
+    std::lock_guard<std::mutex> lk(t.mu);
+    t.rows.clear();
+    t.steps.clear();
+    for (uint64_t r = 0; r < rows; ++r) {
+      uint64_t key;
+      int64_t st;
+      if (fread(&key, 8, 1, f) != 1 || fread(&st, 8, 1, f) != 1) {
+        ok = false;
+        break;
+      }
+      std::vector<float> vals(rl);
+      if (fread(vals.data(), 4, rl, f) != rl) { ok = false; break; }
+      t.rows.emplace(key, std::move(vals));
+      if (st) t.steps[key] = st;
+    }
+  }
+  fclose(f);
+  return ok;
+}
+
+void handle_conn(PsServer* ps, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<char> body;
+  std::vector<float> out;
+  while (ps->running.load()) {
+    uint32_t blen;
+    if (!read_all(fd, &blen, 4)) break;
+    body.resize(blen);
+    if (blen && !read_all(fd, body.data(), blen)) break;
+    if (blen < 13) break;
+    uint8_t op = (uint8_t)body[0];
+    uint32_t table;
+    uint64_t n;
+    memcpy(&table, body.data() + 1, 4);
+    memcpy(&n, body.data() + 5, 8);
+    const char* payload = body.data() + 13;
+    size_t psize = blen - 13;
+
+    if (op == kStop) {
+      uint32_t ok = 1;
+      send_resp(fd, &ok, 4);
+      ps->running.store(false);
+      // connect to self to unblock accept()
+      int s = socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in a{};
+      a.sin_family = AF_INET;
+      a.sin_port = htons((uint16_t)ps->port);
+      a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+      connect(s, (sockaddr*)&a, sizeof(a));
+      close(s);
+      break;
+    }
+
+    switch (op) {
+      case kPullDense:
+      case kPullDenseInit: {
+        DenseTable* tp = find_dense(ps, table);
+        if (!tp) { send_resp(fd, nullptr, 0); break; }
+        DenseTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        if (op == kPullDenseInit && !t.initialized) {
+          t.param.assign((const float*)payload,
+                         (const float*)payload + psize / 4);
+          t.initialized = true;
+        }
+        t.ensure(t.param.size());
+        send_resp(fd, t.param.data(), t.param.size() * 4);
+        break;
+      }
+      case kPushDenseGrad:
+      case kPushDenseDelta: {
+        DenseTable* tp = find_dense(ps, table);
+        if (!tp) { uint32_t ok = 0; send_resp(fd, &ok, 4); break; }
+        DenseTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        size_t cnt = psize / 4;
+        if (op == kPushDenseDelta) {
+          t.ensure(cnt);
+          const float* d = (const float*)payload;
+          for (size_t i = 0; i < cnt; ++i) t.param[i] += d[i];
+        } else {
+          t.apply_grad((const float*)payload, cnt);
+        }
+        uint32_t ok = 1;
+        send_resp(fd, &ok, 4);
+        break;
+      }
+      case kPullSparse: {
+        SparseTable* tp = find_sparse(ps, table);
+        if (!tp) { uint32_t ok = 0; send_resp(fd, &ok, 4); break; }
+        SparseTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        const uint64_t* keys = (const uint64_t*)payload;
+        out.resize(n * t.dim);
+        for (uint64_t i = 0; i < n; ++i) {
+          std::vector<float>& r = t.row(keys[i]);
+          memcpy(out.data() + i * t.dim, r.data(), t.dim * 4);
+        }
+        send_resp(fd, out.data(), out.size() * 4);
+        break;
+      }
+      case kPushSparseGrad: {
+        SparseTable* tp = find_sparse(ps, table);
+        if (!tp) { uint32_t ok = 0; send_resp(fd, &ok, 4); break; }
+        SparseTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        const uint64_t* keys = (const uint64_t*)payload;
+        const float* g = (const float*)(payload + n * 8);
+        for (uint64_t i = 0; i < n; ++i)
+          t.apply_grad(keys[i], g + i * t.dim);
+        uint32_t ok = 1;
+        send_resp(fd, &ok, 4);
+        break;
+      }
+      case kPushSparseDelta: {
+        SparseTable* tp = find_sparse(ps, table);
+        if (!tp) { uint32_t ok = 0; send_resp(fd, &ok, 4); break; }
+        SparseTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        const uint64_t* keys = (const uint64_t*)payload;
+        const float* d = (const float*)(payload + n * 8);
+        for (uint64_t i = 0; i < n; ++i) {
+          std::vector<float>& r = t.row(keys[i]);
+          for (int j = 0; j < t.dim; ++j) r[j] += d[i * t.dim + j];
+        }
+        uint32_t ok = 1;
+        send_resp(fd, &ok, 4);
+        break;
+      }
+      case kBarrier: {
+        Barrier& b = ps->barrier;
+        std::unique_lock<std::mutex> lk(b.mu);
+        int64_t gen = b.generation;
+        if (++b.arrived >= (int)n) {
+          b.arrived = 0;
+          ++b.generation;
+          b.cv.notify_all();
+        } else {
+          b.cv.wait(lk, [&] { return b.generation != gen || !ps->running; });
+        }
+        uint32_t ok = 1;
+        send_resp(fd, &ok, 4);
+        break;
+      }
+      case kSave: {
+        save_tables(ps, std::string(payload, psize));
+        uint32_t ok = 1;
+        send_resp(fd, &ok, 4);
+        break;
+      }
+      case kLoad: {
+        uint32_t ok = load_tables(ps, std::string(payload, psize)) ? 1 : 0;
+        send_resp(fd, &ok, 4);
+        break;
+      }
+      case kSparseSize: {
+        SparseTable* tp = find_sparse(ps, table);
+        if (!tp) { uint64_t z = 0; send_resp(fd, &z, 8); break; }
+        SparseTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        uint64_t sz = t.rows.size();
+        send_resp(fd, &sz, 8);
+        break;
+      }
+      default: {
+        uint32_t ok = 0;
+        send_resp(fd, &ok, 4);
+        break;
+      }
+    }
+  }
+  close(fd);
+}
+
+void accept_loop(PsServer* ps) {
+  while (ps->running.load()) {
+    sockaddr_in cli{};
+    socklen_t len = sizeof(cli);
+    int fd = accept(ps->listen_fd, (sockaddr*)&cli, &len);
+    if (fd < 0) continue;
+    if (!ps->running.load()) {
+      close(fd);
+      break;
+    }
+    std::lock_guard<std::mutex> lk(ps->conns_mu);
+    ps->conns.emplace_back(handle_conn, ps, fd);
+  }
+  // wake any barrier waiters so their conns can exit
+  {
+    std::lock_guard<std::mutex> lk(ps->barrier.mu);
+    ps->barrier.cv.notify_all();
+  }
+}
+
+}  // namespace
+
+PT_API void pt_ps_stop();
+
+PT_API void pt_ps_reset() {
+  pt_ps_stop();  // idempotent; joins any leftover threads
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  if (g_ps && g_ps->running.load()) return;  // still live: refuse
+  delete g_ps;
+  g_ps = new PsServer();
+}
+
+PT_API void pt_ps_add_dense(uint32_t table, int32_t dim, int32_t opt_kind,
+                            float lr, float beta1, float beta2, float eps) {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  if (!g_ps) g_ps = new PsServer();
+  DenseTable& t = g_ps->dense[table];
+  t.dim = dim;
+  t.opt = {opt_kind, lr, beta1, beta2, eps};
+}
+
+PT_API void pt_ps_add_sparse(uint32_t table, int32_t dim, int32_t opt_kind,
+                             float lr, float beta1, float beta2, float eps,
+                             float init_range, uint64_t seed) {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  if (!g_ps) g_ps = new PsServer();
+  SparseTable& t = g_ps->sparse[table];
+  t.dim = dim;
+  t.opt = {opt_kind, lr, beta1, beta2, eps};
+  t.init_range = init_range;
+  t.seed = seed;
+}
+
+// returns the bound port (pass 0 for an ephemeral port), or -1 on error
+PT_API int32_t pt_ps_start(int32_t port) {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  if (!g_ps) g_ps = new PsServer();
+  PsServer* ps = g_ps;
+  if (ps->running.load()) return ps->port;
+  ps->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (ps->listen_fd < 0) return -1;
+  int one = 1;
+  setsockopt(ps->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(ps->listen_fd, (sockaddr*)&addr, sizeof(addr)) < 0) {
+    close(ps->listen_fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(ps->listen_fd, (sockaddr*)&addr, &alen);
+  ps->port = ntohs(addr.sin_port);
+  if (listen(ps->listen_fd, 64) < 0) {
+    close(ps->listen_fd);
+    return -1;
+  }
+  ps->running.store(true);
+  ps->accept_thread = std::thread(accept_loop, ps);
+  return ps->port;
+}
+
+PT_API void pt_ps_stop() {
+  PsServer* ps;
+  {
+    std::lock_guard<std::mutex> lk(g_ps_mu);
+    ps = g_ps;
+  }
+  if (!ps || ps->listen_fd < 0) return;
+  // Threads must be joined even when a client STOP already cleared
+  // `running` (the handler thread cannot join itself); deleting a
+  // PsServer with joinable std::threads would std::terminate.
+  if (ps->running.exchange(false)) {
+    // self-connect to unblock accept()
+    int s = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_port = htons((uint16_t)ps->port);
+    a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    connect(s, (sockaddr*)&a, sizeof(a));
+    close(s);
+  }
+  if (ps->accept_thread.joinable()) ps->accept_thread.join();
+  close(ps->listen_fd);
+  ps->listen_fd = -1;
+  {
+    std::lock_guard<std::mutex> lk(ps->conns_mu);
+    for (auto& t : ps->conns)
+      if (t.joinable()) t.join();
+    ps->conns.clear();
+  }
+}
+
+PT_API int32_t pt_ps_port() {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  return g_ps ? g_ps->port : -1;
+}
+
+PT_API int32_t pt_ps_running() {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  return g_ps && g_ps->running.load() ? 1 : 0;
+}

@@ -29,3 +29,13 @@ worker_num = _fb.worker_num
 is_first_worker = _fb.is_first_worker
 barrier_worker = _fb.barrier_worker
 stop_worker = _fb.stop_worker
+# parameter-server mode (reference: fleet PS entry points)
+is_server = _fb.is_server
+is_worker = _fb.is_worker
+init_server = _fb.init_server
+run_server = _fb.run_server
+init_worker = _fb.init_worker
+ps_step = _fb.ps_step
+ps_runtime = _fb.ps_runtime
+save_persistables = _fb.save_persistables
+shutdown_servers = _fb.shutdown_servers

@@ -17,12 +17,20 @@ from .topology import (
 
 _role_maker = None
 _strategy = None
+_ps_runtime = None
 
 
 def init(role_maker=None, is_collective=True, strategy=None):
-    global _role_maker, _strategy
+    global _role_maker, _strategy, _ps_runtime
     _role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
     _strategy = strategy or DistributedStrategy()
+    if not getattr(_role_maker, "_is_collective", is_collective):
+        # parameter-server mode (reference: fleet.init with a non-collective
+        # role → TheOnePSRuntime); no device mesh is built
+        from ...ps import PsRuntime
+        _ps_runtime = PsRuntime(_role_maker, _strategy)
+        return _ps_runtime
+    _ps_runtime = None  # collective re-init must drop a stale PS runtime
     hcg = HybridCommunicateGroup(strategy=_strategy)
     set_hybrid_communicate_group(hcg)
     return hcg
@@ -40,12 +48,56 @@ def is_first_worker():
     return worker_index() == 0
 
 
+def is_server():
+    return _role_maker is not None and _role_maker.is_server()
+
+
+def is_worker():
+    return _role_maker is None or _role_maker.is_worker()
+
+
 def barrier_worker():
-    pass  # single-controller: no-op
+    if _ps_runtime is not None and _ps_runtime.client is not None:
+        _ps_runtime.client.barrier(_role_maker.worker_num())
+    # collective single-controller: no-op
 
 
 def stop_worker():
-    pass
+    if _ps_runtime is not None:
+        _ps_runtime.stop_worker()
+
+
+# -- parameter-server entry points (reference: fleet_base.py init_server
+# :1080 / run_server / init_worker / save_persistables over TheOnePSRuntime)
+def init_server(model=None, port=None):
+    return _ps_runtime.init_server(model=model, port=port)
+
+
+def run_server():
+    _ps_runtime.run_server()
+
+
+def init_worker(model=None):
+    return _ps_runtime.init_worker(model=model)
+
+
+def ps_step(optimizer=None):
+    """Post-backward communicator step for PS workers."""
+    _ps_runtime.step(optimizer)
+
+
+def ps_runtime():
+    return _ps_runtime
+
+
+def save_persistables(executor=None, dirname=None, main_program=None):
+    if _ps_runtime is not None and dirname is not None:
+        _ps_runtime.save_persistables(dirname)
+
+
+def shutdown_servers():
+    if _ps_runtime is not None:
+        _ps_runtime.shutdown_servers()
 
 
 def distributed_model(model):

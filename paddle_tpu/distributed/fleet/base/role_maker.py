@@ -37,11 +37,29 @@ class RoleMakerBase:
         eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
         return eps.split(",") if eps else ["127.0.0.1:0"]
 
+    # -- parameter-server roles (reference: role_maker.py TRAINING_ROLE /
+    # PADDLE_PSERVER_ENDPOINTS contract) ----------------------------------
+    def get_pserver_endpoints(self):
+        eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    def server_index(self):
+        return int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+
+    def server_num(self):
+        return len(self.get_pserver_endpoints())
+
 
 class PaddleCloudRoleMaker(RoleMakerBase):
     def __init__(self, is_collective=True, **kwargs):
         super().__init__()
         self._is_collective = is_collective
+
+    def is_server(self):
+        return os.environ.get("TRAINING_ROLE", "TRAINER") == "PSERVER"
+
+    def is_worker(self):
+        return not self.is_server()
 
     def worker_index(self):
         if "PADDLE_TRAINER_ID" in os.environ:
@@ -61,11 +79,26 @@ class UserDefinedRoleMaker(RoleMakerBase):
     def __init__(self, is_collective=True, current_id=0, role=Role.WORKER,
                  worker_num=1, server_endpoints=None, **kwargs):
         super().__init__()
+        self._is_collective = is_collective
         self._current_id = current_id
+        self._role = role
         self._worker_num = worker_num
+        self._server_endpoints = list(server_endpoints or [])
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_worker(self):
+        return self._role == Role.WORKER
 
     def worker_index(self):
         return self._current_id
 
+    def server_index(self):
+        return self._current_id
+
     def worker_num(self):
         return self._worker_num
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)

@@ -1,0 +1,197 @@
+"""PS client: sockets + sharding (reference: `distributed/service/
+brpc_ps_client.cc` + `ps_client.h`).
+
+Sparse keys shard across servers by `key % nservers` (reference shards by
+key hash, `common_sparse_table.cc` block partition); dense tables live on
+`table_id % nservers`. The wire protocol is the length-prefixed binary
+format of `_native/src/ps_service.cc`.
+"""
+import socket
+import struct
+import threading
+
+import numpy as np
+
+OP_PULL_DENSE = 1
+OP_PUSH_DENSE_GRAD = 2
+OP_PULL_SPARSE = 3
+OP_PUSH_SPARSE_GRAD = 4
+OP_PUSH_SPARSE_DELTA = 5
+OP_PUSH_DENSE_DELTA = 6
+OP_BARRIER = 7
+OP_SAVE = 8
+OP_LOAD = 9
+OP_STOP = 10
+OP_SPARSE_SIZE = 11
+OP_PULL_DENSE_INIT = 12
+
+
+class PsClient:
+    """One client per worker process; thread-safe per-server sockets."""
+
+    def __init__(self, endpoints):
+        self.endpoints = list(endpoints)
+        self._socks = [None] * len(self.endpoints)
+        self._locks = [threading.Lock() for _ in self.endpoints]
+        self._sparse_dim = {}
+        self._dense_dim = {}
+
+    # -- table metadata (client-side reshape info) ------------------------
+    def register_sparse(self, table, dim):
+        self._sparse_dim[table] = dim
+
+    def register_dense(self, table, dim):
+        self._dense_dim[table] = dim
+
+    @property
+    def n_servers(self):
+        return len(self.endpoints)
+
+    # -- transport --------------------------------------------------------
+    def _sock(self, i):
+        if self._socks[i] is None:
+            host, port = self.endpoints[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=120)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _call(self, server, op, table, n, payload=b""):
+        body = struct.pack("<BIQ", op, table, n) + payload
+        msg = struct.pack("<I", len(body)) + body
+        with self._locks[server]:
+            s = self._sock(server)
+            s.sendall(msg)
+            hdr = self._recv_exact(s, 4)
+            (rlen,) = struct.unpack("<I", hdr)
+            return self._recv_exact(s, rlen) if rlen else b""
+
+    @staticmethod
+    def _recv_exact(s, n):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ps server closed connection")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    # -- dense ------------------------------------------------------------
+    def _dense_server(self, table):
+        return table % self.n_servers
+
+    def pull_dense(self, table):
+        raw = self._call(self._dense_server(table), OP_PULL_DENSE, table, 0)
+        return np.frombuffer(raw, np.float32).copy()
+
+    def pull_dense_init(self, table, init_values):
+        """Pull; server adopts `init_values` if the table is untouched
+        (worker-0 initialization handoff, reference: communicator init)."""
+        payload = np.ascontiguousarray(init_values, np.float32).tobytes()
+        raw = self._call(self._dense_server(table), OP_PULL_DENSE_INIT,
+                         table, 0, payload)
+        return np.frombuffer(raw, np.float32).copy()
+
+    def push_dense_grad(self, table, grad):
+        payload = np.ascontiguousarray(grad, np.float32).tobytes()
+        self._check_ok(self._call(self._dense_server(table),
+                                  OP_PUSH_DENSE_GRAD, table, 0, payload),
+                       table)
+
+    def push_dense_delta(self, table, delta):
+        payload = np.ascontiguousarray(delta, np.float32).tobytes()
+        self._check_ok(self._call(self._dense_server(table),
+                                  OP_PUSH_DENSE_DELTA, table, 0, payload),
+                       table)
+
+    @staticmethod
+    def _check_ok(raw, table):
+        if len(raw) != 4 or struct.unpack("<I", raw)[0] != 1:
+            raise RuntimeError(
+                f"ps server rejected push for table {table} (not "
+                f"registered on the server, or snapshot load failed?)")
+
+    # -- sparse -----------------------------------------------------------
+    def pull_sparse(self, table, keys):
+        dim = self._sparse_dim[table]
+        keys = np.ascontiguousarray(keys, np.uint64).ravel()
+        out = np.empty((keys.size, dim), np.float32)
+        for srv, idx in self._shard(keys):
+            raw = self._call(srv, OP_PULL_SPARSE, table, idx.size,
+                             keys[idx].tobytes())
+            if len(raw) != idx.size * dim * 4:
+                raise RuntimeError(
+                    f"sparse table {table} pull returned {len(raw)} bytes, "
+                    f"expected {idx.size * dim * 4} — table not registered "
+                    f"on server {srv}?")
+            out[idx] = np.frombuffer(raw, np.float32).reshape(idx.size, dim)
+        return out
+
+    def push_sparse_grad(self, table, keys, grads):
+        self._push_sparse(OP_PUSH_SPARSE_GRAD, table, keys, grads)
+
+    def push_sparse_delta(self, table, keys, deltas):
+        self._push_sparse(OP_PUSH_SPARSE_DELTA, table, keys, deltas)
+
+    def _push_sparse(self, op, table, keys, vals):
+        dim = self._sparse_dim[table]
+        keys = np.ascontiguousarray(keys, np.uint64).ravel()
+        vals = np.ascontiguousarray(vals, np.float32).reshape(keys.size, dim)
+        # merge duplicate ids before pushing (reference: merge_add in
+        # communicator.cc MergeVars) — one server-side update per id
+        uniq, inv = np.unique(keys, return_inverse=True)
+        merged = np.zeros((uniq.size, dim), np.float32)
+        np.add.at(merged, inv, vals)
+        for srv, idx in self._shard(uniq):
+            payload = uniq[idx].tobytes() + merged[idx].tobytes()
+            self._check_ok(self._call(srv, op, table, idx.size, payload),
+                           table)
+
+    def _shard(self, keys):
+        if self.n_servers == 1:
+            yield 0, np.arange(keys.size)
+            return
+        srv = (keys % np.uint64(self.n_servers)).astype(np.int64)
+        for i in range(self.n_servers):
+            idx = np.nonzero(srv == i)[0]
+            if idx.size:
+                yield i, idx
+
+    # -- control ----------------------------------------------------------
+    def barrier(self, n_workers):
+        """Global worker barrier via server 0 (reference: fetch_barrier)."""
+        self._call(0, OP_BARRIER, 0, n_workers)
+
+    def save(self, path_prefix):
+        for i in range(self.n_servers):
+            self._call(i, OP_SAVE, 0, 0,
+                       f"{path_prefix}.{i}".encode())
+
+    def load(self, path_prefix):
+        for i in range(self.n_servers):
+            raw = self._call(i, OP_LOAD, 0, 0,
+                             f"{path_prefix}.{i}".encode())
+            if struct.unpack("<I", raw)[0] != 1:
+                raise RuntimeError(
+                    f"ps server {i} failed to load snapshot "
+                    f"{path_prefix}.{i}")
+
+    def sparse_size(self, table):
+        total = 0
+        for i in range(self.n_servers):
+            raw = self._call(i, OP_SPARSE_SIZE, table, 0)
+            total += struct.unpack("<Q", raw)[0]
+        return total
+
+    def stop_servers(self):
+        for i in range(self.n_servers):
+            try:
+                self._call(i, OP_STOP, 0, 0)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            if s is not None:
+                s.close()
+        self._socks = [None] * len(self.endpoints)

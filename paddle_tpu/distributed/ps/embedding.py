@@ -1,0 +1,111 @@
+"""PS-routed sparse embedding (reference: `operators/pscore/
+distributed_lookup_table_op.cc` + the `paddle.static.nn.sparse_embedding`
+front-end).
+
+Forward pulls the touched rows from the sparse table, computes the gather
+locally (differentiable wrt the pulled slice), and records the slice so the
+communicator can push its gradient after `loss.backward()` — the eager
+analog of the reference's lookup-op + send-op pair.
+"""
+import numpy as np
+
+from ...core.dispatch import call_op, unwrap, wrap
+from ...nn.layer.layers import Layer
+
+
+def distributed_lookup_table(ids, table_id, communicator):
+    """Functional lookup: returns [.., dim] embeddings for int ids."""
+    import jax.numpy as jnp
+
+    ids_np = np.asarray(unwrap(ids)).astype(np.int64)
+    shape = ids_np.shape
+    flat = ids_np.ravel()
+    uniq, inv = np.unique(flat, return_inverse=True)
+    vals = communicator.client.pull_sparse(table_id, uniq.astype(np.uint64))
+
+    slice_t = wrap(jnp.asarray(vals), stop_gradient=False)
+
+    def _gather(rows):
+        return rows[jnp.asarray(inv)].reshape(shape + (vals.shape[1],))
+
+    out = call_op(_gather, slice_t, op_name="distributed_lookup_table")
+    communicator._pending_slices.append((table_id, uniq, slice_t))
+    return out
+
+
+def flush_sparse_grads(communicator):
+    """Collect grads of this step's pulled slices into the communicator
+    (called by the DistributedOptimizer step, after backward)."""
+    for table_id, keys, slice_t in communicator._pending_slices:
+        if slice_t._grad is not None:
+            g = np.asarray(slice_t._grad, np.float32)
+            communicator.record_sparse_grad(table_id,
+                                            keys.astype(np.uint64), g)
+    communicator._pending_slices = []
+
+
+class SparseEmbedding(Layer):
+    """Embedding whose table lives on the parameter servers."""
+
+    _next_table_id = 1000  # sparse tables: 1000+; dense vars: 0..999
+
+    def __init__(self, size, table_id=None, init_range=0.1, name=None):
+        super().__init__()
+        num, dim = size
+        self.num_embeddings = num
+        self.embedding_dim = dim
+        self.init_range = init_range
+        if table_id is None:
+            table_id = SparseEmbedding._next_table_id
+            SparseEmbedding._next_table_id += 1
+        self.table_id = table_id
+        _sparse_registry.append(self)
+        self._communicator = None
+
+    def bind(self, communicator):
+        self._communicator = communicator
+        communicator.client.register_sparse(self.table_id,
+                                            self.embedding_dim)
+
+    def forward(self, ids):
+        if self._communicator is None:
+            raise RuntimeError(
+                "SparseEmbedding is not bound to a communicator — call "
+                "fleet.init_worker() (or .bind(communicator)) first")
+        return distributed_lookup_table(ids, self.table_id,
+                                        self._communicator)
+
+
+_sparse_registry = []  # all SparseEmbedding layers constructed this process
+
+
+def sparse_tables():
+    return list(_sparse_registry)
+
+
+def reset_registry():
+    _sparse_registry.clear()
+    SparseEmbedding._next_table_id = 1000
+
+
+def deterministic_init(seed, keys, dim, init_range):
+    """Python mirror of the server's per-key splitmix64 row initializer
+    (ps_service.cc mix64) — lets local/parity tests reproduce server-side
+    embedding initialization exactly."""
+    def mix64(x):
+        x = (x + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        x = ((x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) \
+            & np.uint64(0xFFFFFFFFFFFFFFFF)
+        x = ((x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) \
+            & np.uint64(0xFFFFFFFFFFFFFFFF)
+        return x ^ (x >> np.uint64(31))
+
+    keys = np.asarray(keys, np.uint64).ravel()
+    out = np.empty((keys.size, dim), np.float32)
+    with np.errstate(over="ignore"):
+        for i in range(dim):
+            h = mix64(np.uint64(seed) ^ mix64(
+                keys * np.uint64(1315423911) + np.uint64(i)))
+            u = (h >> np.uint64(11)).astype(np.float64) / 9007199254740992.0
+            out[:, i] = (2.0 * u - 1.0) * init_range
+    return out

@@ -1,0 +1,74 @@
+"""PS server wrapper over the native service (reference:
+`distributed/service/brpc_ps_server.cc` + `fleet/runtime/the_one_ps.py:486`
+init_server/run_server)."""
+import time
+
+import numpy as np
+
+from ... import _native
+
+OPT_SUM = 0
+OPT_SGD = 1
+OPT_ADAM = 2
+
+_OPT_BY_NAME = {"sum": OPT_SUM, "sgd": OPT_SGD, "adam": OPT_ADAM}
+
+
+class TableConfig:
+    """One PS table (reference: ps.proto TableParameter)."""
+
+    def __init__(self, table_id, kind, dim, optimizer="sgd", lr=0.01,
+                 beta1=0.9, beta2=0.999, eps=1e-8, init_range=0.0, seed=0):
+        assert kind in ("dense", "sparse")
+        self.table_id = table_id
+        self.kind = kind
+        self.dim = dim
+        self.optimizer = optimizer
+        self.lr = lr
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.eps = eps
+        self.init_range = init_range
+        self.seed = seed
+
+
+class PsServer:
+    """In-process native PS server. One per process."""
+
+    def __init__(self, tables, port=0):
+        self.tables = list(tables)
+        self.port = port
+        self._started = False
+
+    def start(self):
+        lib = _native.lib()
+        if lib is None:
+            raise RuntimeError(
+                "native runtime unavailable — the PS server requires the "
+                f"C++ build ({_native._build_err})")
+        lib.pt_ps_reset()
+        for t in self.tables:
+            opt = _OPT_BY_NAME[t.optimizer]
+            if t.kind == "dense":
+                lib.pt_ps_add_dense(t.table_id, t.dim, opt, t.lr, t.beta1,
+                                    t.beta2, t.eps)
+            else:
+                lib.pt_ps_add_sparse(t.table_id, t.dim, opt, t.lr, t.beta1,
+                                     t.beta2, t.eps, t.init_range, t.seed)
+        port = lib.pt_ps_start(self.port)
+        if port < 0:
+            raise RuntimeError(f"ps server failed to bind port {self.port}")
+        self.port = port
+        self._started = True
+        return port
+
+    def run(self):
+        """Block until a client sends STOP (reference: run_server)."""
+        lib = _native.lib()
+        while lib.pt_ps_running():
+            time.sleep(0.2)
+
+    def stop(self):
+        if self._started:
+            _native.lib().pt_ps_stop()
+            self._started = False

@@ -1,0 +1,154 @@
+"""CTR-style PS training fixture (reference model: the dist_ctr /
+dist_fleet_ctr test fixtures of `test_dist_base.py` — a sparse-embedding
+model trained against 1 server + N workers on localhost).
+
+Modes via env:
+  PS_ROLE=server|worker|local
+  PS_MODE=sync|async|geo
+  PS_ENDPOINTS, PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_PSERVER_ID
+Prints "LOSS <step> <value>" lines; local mode emulates geo k=1 exactly.
+"""
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed import ps
+from paddle_tpu.distributed.fleet.base.role_maker import PaddleCloudRoleMaker
+
+VOCAB, DIM, SLOTS, BATCH, STEPS = 100, 8, 3, 64, 200
+LR = 0.2
+
+
+_ID_WEIGHTS = np.random.RandomState(42).randn(VOCAB).astype(np.float32)
+
+
+def synth_batch(step, worker_id, n_workers):
+    """Deterministic synthetic CTR batch. The label is an additive
+    function of per-id weights — exactly the structure a sparse-embedding
+    + linear model can learn (memorize per-id scores)."""
+    rng = np.random.RandomState(1234 + step * 17 + worker_id)
+    ids = rng.randint(0, VOCAB, (BATCH, SLOTS)).astype(np.int64)
+    # label keyed on the first slot's id alone: each embedding row can
+    # directly memorize its label, so a few epochs converge decisively
+    label = _ID_WEIGHTS[ids[:, 0]] > 0.0
+    return ids, label.astype(np.float32).reshape(-1, 1)
+
+
+class CtrModel(nn.Layer):
+    def __init__(self, sparse=True):
+        super().__init__()
+        if sparse:
+            self.emb = ps.SparseEmbedding([VOCAB, DIM], init_range=0.1)
+        else:
+            self.emb = None
+        self.fc1 = nn.Linear(SLOTS * DIM, 16)
+        self.fc2 = nn.Linear(16, 1)
+
+    def forward(self, ids, emb_out=None):
+        if self.emb is not None:
+            e = self.emb(ids)
+        else:
+            e = emb_out
+        h = paddle.ops.reshape(e, [e.shape[0], SLOTS * DIM])
+        h = paddle.nn.functional.relu(self.fc1(h))
+        return self.fc2(h)
+
+
+def loss_fn(logits, label):
+    return paddle.nn.functional.binary_cross_entropy_with_logits(
+        logits, paddle.to_tensor(label))
+
+
+def run_server():
+    role = PaddleCloudRoleMaker(is_collective=False)
+    strategy = make_strategy()
+    fleet.init(role, strategy=strategy)
+    paddle.seed(0)
+    model = CtrModel()  # registers the sparse table + dense shapes
+    fleet.init_server(model)
+    print("SERVER_READY", flush=True)
+    fleet.run_server()
+
+
+def make_strategy():
+    s = fleet.DistributedStrategy()
+    mode = os.environ.get("PS_MODE", "sync")
+    s.a_sync = mode != "sync"
+    s.a_sync_configs = {"learning_rate": LR}
+    if mode == "geo":
+        s.a_sync_configs["k_steps"] = int(os.environ.get("PS_K_STEPS", "1"))
+    return s
+
+
+def run_worker():
+    role = PaddleCloudRoleMaker(is_collective=False)
+    strategy = make_strategy()
+    fleet.init(role, strategy=strategy)
+    paddle.seed(0)
+    model = CtrModel()
+    fleet.init_worker(model)
+    mode = os.environ.get("PS_MODE", "sync")
+    wid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    nw = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    opt = (paddle.optimizer.SGD(parameters=model.parameters(),
+                                learning_rate=LR)
+           if mode == "geo" else None)
+    for step in range(STEPS):
+        ids, label = synth_batch(step, wid, nw)
+        logits = model(paddle.to_tensor(ids))
+        loss = loss_fn(logits, label)
+        loss.backward()
+        fleet.ps_step(opt)
+        print(f"LOSS {step} {float(loss.numpy()):.6f}", flush=True)
+    fleet.barrier_worker()  # all workers done training
+    if wid == 0 and os.environ.get("PS_SAVE"):
+        fleet.save_persistables(dirname=os.environ["PS_SAVE"])
+        size = fleet.ps_runtime().client.sparse_size(model.emb.table_id)
+        print(f"SPARSE_SIZE {size}", flush=True)
+    fleet.barrier_worker()  # save complete before anyone tears down
+    fleet.stop_worker()
+    if wid == 0:
+        fleet.shutdown_servers()
+
+
+def run_local():
+    """Pure-local emulation of geo k=1: full embedding matrix initialized
+    with the server's deterministic per-key rule, plain SGD."""
+    from paddle_tpu.distributed.ps.embedding import deterministic_init
+
+    paddle.seed(0)
+    model = CtrModel(sparse=False)
+    table = paddle.to_tensor(
+        deterministic_init(1000, np.arange(VOCAB, dtype=np.uint64), DIM, 0.1))
+    table.stop_gradient = False
+    params = list(model.parameters())
+    opt = paddle.optimizer.SGD(parameters=params, learning_rate=LR)
+    for step in range(STEPS):
+        ids, label = synth_batch(step, 0, 1)
+        emb = paddle.ops.gather(table, paddle.to_tensor(ids.ravel()))
+        emb = paddle.ops.reshape(emb, [BATCH, SLOTS, DIM])
+        logits = model(None, emb_out=emb)
+        loss = loss_fn(logits, label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        # manual SGD on the embedding table leaf
+        if table._grad is not None:
+            import jax.numpy as jnp
+            table._value = table._value - LR * jnp.asarray(table._grad)
+            table._grad = None
+        print(f"LOSS {step} {float(loss.numpy()):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    role = os.environ.get("PS_ROLE", "local")
+    if role == "server":
+        run_server()
+    elif role == "worker":
+        run_worker()
+    else:
+        run_local()

@@ -1,0 +1,253 @@
+"""Parameter-server stack tests (reference: `test_dist_base.py:744/867` —
+pserver subprocesses + trainer subprocesses on localhost, loss parity
+against local runs; plus table-level unit tests).
+"""
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "ps_ctr_runner.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _clean_env():
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("PADDLE_", "JAX_", "PS_")) or k == "XLA_FLAGS":
+            env.pop(k)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _losses(text):
+    return [float(m.group(2)) for m in
+            re.finditer(r"LOSS (\d+) ([\d.eE+-]+)", text)]
+
+
+def _spawn(role, mode, ports, wid=0, n_workers=1, extra=None):
+    env = _clean_env()
+    if isinstance(ports, int):
+        ports = [ports]
+    env.update({
+        "PS_ROLE": role,
+        "PS_MODE": mode,
+        "TRAINING_ROLE": "PSERVER" if role == "server" else "TRAINER",
+        "PADDLE_PSERVER_ENDPOINTS": ",".join(
+            f"127.0.0.1:{p}" for p in ports),
+        "PADDLE_PSERVER_ID": str(wid if role == "server" else 0),
+        "PADDLE_TRAINER_ID": str(wid),
+        "PADDLE_TRAINERS_NUM": str(n_workers),
+    })
+    if extra:
+        env.update(extra)
+    script = ("import jax; jax.config.update('jax_platforms','cpu');"
+              "import runpy; runpy.run_path(%r, run_name='__main__')"
+              % FIXTURE)
+    return subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env, cwd=REPO)
+
+
+def _run_cluster(mode, n_workers, n_servers=1, extra=None, timeout=420):
+    ports = [_free_port() for _ in range(n_servers)]
+    servers = [_spawn("server", mode, ports, wid=i, extra=extra)
+               for i in range(n_servers)]
+    for srv in servers:  # wait for SERVER_READY before starting workers
+        line = srv.stdout.readline()
+        assert "SERVER_READY" in line, line + srv.stderr.read()[-2000:]
+    workers = [_spawn("worker", mode, ports, wid=i, n_workers=n_workers,
+                      extra=extra)
+               for i in range(n_workers)]
+    outs = []
+    try:
+        for w in workers:
+            out, err = w.communicate(timeout=timeout)
+            assert w.returncode == 0, f"worker failed:\n{err[-4000:]}"
+            outs.append(out)
+        for srv in servers:
+            srv.wait(timeout=60)
+    finally:
+        for p in workers + servers:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def _run_local(extra=None):
+    env = _clean_env()
+    env["PS_ROLE"] = "local"
+    if extra:
+        env.update(extra)
+    script = ("import jax; jax.config.update('jax_platforms','cpu');"
+              "import runpy; runpy.run_path(%r, run_name='__main__')"
+              % FIXTURE)
+    r = subprocess.run([sys.executable, "-c", script], capture_output=True,
+                       text=True, env=env, cwd=REPO, timeout=420)
+    assert r.returncode == 0, r.stderr[-4000:]
+    return _losses(r.stdout)
+
+
+# ---------------------------------------------------------------- unit level
+
+class TestNativeTableService:
+    """In-process client/server against the native table store."""
+
+    def _start(self, tables):
+        from paddle_tpu.distributed.ps import PsClient, PsServer
+        srv = PsServer(tables, port=0)
+        port = srv.start()
+        cli = PsClient([f"127.0.0.1:{port}"])
+        return srv, cli
+
+    def test_sparse_pull_init_matches_python_mirror(self):
+        from paddle_tpu.distributed.ps import TableConfig
+        from paddle_tpu.distributed.ps.embedding import deterministic_init
+        srv, cli = self._start(
+            [TableConfig(7, "sparse", 4, "sgd", lr=0.5, init_range=0.2,
+                         seed=7)])
+        try:
+            cli.register_sparse(7, 4)
+            keys = np.array([3, 99, 12345], np.uint64)
+            got = cli.pull_sparse(7, keys)
+            want = deterministic_init(7, keys, 4, 0.2)
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+            # sgd push applies -lr*g server-side
+            g = np.ones((3, 4), np.float32)
+            cli.push_sparse_grad(7, keys, g)
+            got2 = cli.pull_sparse(7, keys)
+            np.testing.assert_allclose(got2, want - 0.5, rtol=1e-5)
+            assert cli.sparse_size(7) == 3
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
+    def test_sparse_adam_matches_numpy(self):
+        from paddle_tpu.distributed.ps import TableConfig
+        srv, cli = self._start(
+            [TableConfig(1, "sparse", 3, "adam", lr=0.1, init_range=0.0)])
+        try:
+            cli.register_sparse(1, 3)
+            keys = np.array([5], np.uint64)
+            p = np.zeros(3); m = np.zeros(3); v = np.zeros(3)
+            for t in range(1, 4):
+                g = np.full(3, float(t), np.float32)
+                cli.push_sparse_grad(1, keys, g.reshape(1, 3))
+                m = 0.9 * m + 0.1 * g
+                v = 0.999 * v + 0.001 * g * g
+                mh = m / (1 - 0.9 ** t)
+                vh = v / (1 - 0.999 ** t)
+                p -= 0.1 * mh / (np.sqrt(vh) + 1e-8)
+            got = cli.pull_sparse(1, keys)[0]
+            np.testing.assert_allclose(got, p, rtol=1e-5)
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
+    def test_dense_init_push_pull_and_delta(self):
+        from paddle_tpu.distributed.ps import TableConfig
+        srv, cli = self._start(
+            [TableConfig(0, "dense", 0, "sgd", lr=0.1)])
+        try:
+            cli.register_dense(0, 4)
+            init = np.arange(4, dtype=np.float32)
+            got = cli.pull_dense_init(0, init)
+            np.testing.assert_allclose(got, init)
+            # second init is ignored (table already initialized)
+            got = cli.pull_dense_init(0, np.zeros(4, np.float32))
+            np.testing.assert_allclose(got, init)
+            cli.push_dense_grad(0, np.ones(4, np.float32))
+            np.testing.assert_allclose(cli.pull_dense(0), init - 0.1)
+            cli.push_dense_delta(0, np.full(4, 0.5, np.float32))
+            np.testing.assert_allclose(cli.pull_dense(0), init + 0.4)
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
+    def test_save_load_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.ps import PsClient, PsServer, TableConfig
+        tables = [TableConfig(0, "dense", 0, "sgd", lr=0.1),
+                  TableConfig(9, "sparse", 2, "adam", lr=0.05,
+                              init_range=0.3, seed=9)]
+        srv, cli = self._start(tables)
+        keys = np.array([11, 22], np.uint64)
+        try:
+            cli.register_dense(0, 3)
+            cli.register_sparse(9, 2)
+            cli.pull_dense_init(0, np.array([1, 2, 3], np.float32))
+            cli.push_sparse_grad(9, keys, np.ones((2, 2), np.float32))
+            want_sparse = cli.pull_sparse(9, keys)
+            want_dense = cli.pull_dense(0)
+            cli.save(str(tmp_path / "snap"))
+        finally:
+            cli.stop_servers()
+            srv.stop()
+        # fresh server, load the snapshot, state must match (incl. adam t:
+        # one more identical push must give identical results server-restart
+        # or not)
+        srv2, cli2 = self._start(tables)
+        try:
+            cli2.register_dense(0, 3)
+            cli2.register_sparse(9, 2)
+            cli2.load(str(tmp_path / "snap"))
+            np.testing.assert_allclose(cli2.pull_sparse(9, keys), want_sparse)
+            np.testing.assert_allclose(cli2.pull_dense(0), want_dense)
+        finally:
+            cli2.stop_servers()
+            srv2.stop()
+
+
+# ------------------------------------------------------------ cluster level
+
+class TestPsCluster:
+    def test_geo_single_worker_matches_local(self):
+        """geo k=1, one worker: server state mirrors local SGD exactly
+        (the reference's geo-delta semantics)."""
+        outs = _run_cluster("geo", 1, extra={"PS_K_STEPS": "1"})
+        ps_losses = _losses(outs[0])
+        local_losses = _run_local()
+        assert len(ps_losses) == len(local_losses) > 0
+        np.testing.assert_allclose(ps_losses, local_losses, rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_sync_two_workers_train(self):
+        outs = _run_cluster("sync", 2)
+        for out in outs:
+            ls = _losses(out)
+            assert len(ls) == 200
+            assert np.mean(ls[-10:]) < 0.35 < np.mean(ls[:5])
+
+    def test_async_two_workers_train_and_save(self, tmp_path):
+        snap = str(tmp_path / "ps_snap")
+        outs = _run_cluster("async", 2, extra={"PS_SAVE": snap})
+        for out in outs:
+            ls = _losses(out)
+            assert len(ls) == 200
+            assert np.mean(ls[-10:]) < 0.35 < np.mean(ls[:5])
+        assert os.path.exists(snap + ".0")
+        m = re.search(r"SPARSE_SIZE (\d+)", outs[0])
+        assert m and int(m.group(1)) > 0
+
+    def test_sync_two_workers_two_servers_sharded(self):
+        """Sparse keys shard across 2 server processes (key % nservers);
+        training still converges and every server holds a partition."""
+        outs = _run_cluster("sync", 2, n_servers=2)
+        for out in outs:
+            ls = _losses(out)
+            assert len(ls) == 200
+            assert np.mean(ls[-10:]) < 0.35 < np.mean(ls[:5])
