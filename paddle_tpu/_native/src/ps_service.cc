@@ -227,9 +227,9 @@ bool send_resp(int fd, const void* payload, uint32_t n) {
   return n == 0 || write_all(fd, payload, n);
 }
 
-void save_tables(PsServer* ps, const std::string& path) {
+bool save_tables(PsServer* ps, const std::string& path) {
   FILE* f = fopen(path.c_str(), "wb");
-  if (!f) return;
+  if (!f) return false;
   uint32_t nd = ps->dense.size(), nsp = ps->sparse.size();
   fwrite(&nd, 4, 1, f);
   fwrite(&nsp, 4, 1, f);
@@ -266,7 +266,9 @@ void save_tables(PsServer* ps, const std::string& path) {
       fwrite(r.second.data(), 4, rl, f);
     }
   }
-  fclose(f);
+  bool ok = ferror(f) == 0;
+  ok = (fclose(f) == 0) && ok;
+  return ok;
 }
 
 bool load_tables(PsServer* ps, const std::string& path) {
@@ -455,8 +457,7 @@ void handle_conn(PsServer* ps, int fd) {
         break;
       }
       case kSave: {
-        save_tables(ps, std::string(payload, psize));
-        uint32_t ok = 1;
+        uint32_t ok = save_tables(ps, std::string(payload, psize)) ? 1 : 0;
         send_resp(fd, &ok, 4);
         break;
       }

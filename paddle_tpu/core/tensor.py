@@ -181,6 +181,18 @@ class Tensor:
     def __int__(self):
         return int(self._value)
 
+    def __index__(self):
+        # lets `range(t)` / indexing accept INTEGER tensors; a traced
+        # value raises TracerIntegerConversionError, which @to_static
+        # catches to engage the dy2static AST fallback
+        import numpy as _np
+        if not (_np.issubdtype(_np.dtype(self._value.dtype), _np.integer)
+                or self._value.dtype == _np.bool_):
+            raise TypeError(
+                f"only integer tensors can be used as an index, got "
+                f"{self._value.dtype}")
+        return int(self._value)
+
     def __float__(self):
         return float(self._value)
 

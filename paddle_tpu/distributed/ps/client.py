@@ -164,8 +164,12 @@ class PsClient:
 
     def save(self, path_prefix):
         for i in range(self.n_servers):
-            self._call(i, OP_SAVE, 0, 0,
-                       f"{path_prefix}.{i}".encode())
+            raw = self._call(i, OP_SAVE, 0, 0,
+                             f"{path_prefix}.{i}".encode())
+            if struct.unpack("<I", raw)[0] != 1:
+                raise RuntimeError(
+                    f"ps server {i} failed to write snapshot "
+                    f"{path_prefix}.{i}")
 
     def load(self, path_prefix):
         for i in range(self.n_servers):

@@ -95,6 +95,7 @@ class AsyncCommunicator(_Base):
         self.pull_every = pull_every
         self._q = queue.Queue(maxsize=64)
         self._stop = threading.Event()
+        self._error = None  # first send failure; re-raised on the caller
         self._thread = threading.Thread(target=self._send_loop, daemon=True)
         self._thread.start()
         self._steps = 0
@@ -106,14 +107,20 @@ class AsyncCommunicator(_Base):
             except queue.Empty:
                 continue
             try:
-                if kind == "sparse":
-                    self.client.push_sparse_grad(table_id, a, b)
-                else:
-                    self.client.push_dense_grad(table_id, a)
+                if self._error is None:
+                    if kind == "sparse":
+                        self.client.push_sparse_grad(table_id, a, b)
+                    else:
+                        self.client.push_dense_grad(table_id, a)
+            except Exception as e:  # keep draining so _q.join() never hangs
+                self._error = e
             finally:
                 self._q.task_done()
 
     def step(self, optimizer=None):
+        if self._error is not None:
+            raise RuntimeError(
+                "async PS send thread failed") from self._error
         for table_id, keys, grads in self._sparse_push:
             self._q.put(("sparse", table_id, keys, grads))
         self._sparse_push.clear()

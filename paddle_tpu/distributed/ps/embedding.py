@@ -29,7 +29,11 @@ def distributed_lookup_table(ids, table_id, communicator):
         return rows[jnp.asarray(inv)].reshape(shape + (vals.shape[1],))
 
     out = call_op(_gather, slice_t, op_name="distributed_lookup_table")
-    communicator._pending_slices.append((table_id, uniq, slice_t))
+    from ...core import autograd as _ag
+    if _ag.grad_enabled():
+        # forward-only loops (eval/serving under no_grad) must not grow
+        # the pending list — nothing will ever flush it
+        communicator._pending_slices.append((table_id, uniq, slice_t))
     return out
 
 

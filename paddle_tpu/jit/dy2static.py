@@ -1,0 +1,551 @@
+"""AST transformation of data-dependent Python control flow for @to_static.
+
+Reference: `fluid/dygraph/dygraph_to_static/` — `ifelse_transformer.py`,
+`loop_transformer.py`, `convert_call_func.py`, driven by
+`program_translator.py:759`. The reference ALWAYS rewrites the function's
+AST before building a ProgramDesc; here the plain trace is the fast path
+and this module is the fallback: when tracing hits a data-dependent
+`if tensor:` / `while tensor:` (TracerBoolConversionError),
+`StaticFunction` re-traces with the transformed function, whose rewritten
+control flow lowers through `nn.control_flow.cond` / `while_loop` onto
+`lax.cond` / `lax.while_loop`.
+
+Rewrites (semantics preserved for concrete predicates — the runtime
+helpers fall back to plain Python dispatch when nothing is traced):
+
+    if t: A else: B       ->  tuple-assigned convert_if(t, true_fn, false_fn)
+    while t: B            ->  convert_while(test_fn, body_fn, loop_vars)
+    for i in range(t): B  ->  the while form with an injected counter
+    a and b / or / not    ->  convert_bool_op / convert_not (traced-aware)
+    f(x)                  ->  convert_call(f)(x)   (recurses into user code)
+
+`return` inside `if` branches is lowered by moving the post-if statements
+into the non-returning branch (the reference return_transformer's
+flattening). Not transformed (left as plain Python; traced predicates
+there still fail loudly): loops containing `break`/`continue`/`return`,
+`for` over tensors. The reference's break_continue transformer is the
+model for extending it.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import numpy as np
+
+__all__ = ["convert_to_static", "jst"]
+
+_SKIP_MODULE_PREFIXES = (
+    "paddle_tpu", "jax", "numpy", "builtins", "torch", "flax", "optax",
+    "_pytest", "unittest",
+)
+
+
+def _is_traced(v):
+    import jax
+
+    from ..core.tensor import Tensor
+    if isinstance(v, Tensor):
+        v = v._value
+    return isinstance(v, jax.core.Tracer)
+
+
+class _Undef:
+    """Placeholder for a name unbound before a transformed branch assigns
+    it (reference: dygraph_to_static UndefinedVar)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable is only assigned in one branch of a transformed "
+            "if/while and was used where it may be undefined")
+
+
+UNDEF = _Undef()
+
+
+class _Jst:
+    """Runtime namespace injected into transformed functions as `_jst`."""
+
+    UNDEF = UNDEF
+
+    @staticmethod
+    def local(mapping, name):
+        return mapping.get(name, UNDEF)
+
+    @staticmethod
+    def convert_if(pred, true_fn, false_fn, args):
+        from ..core.tensor import Tensor
+        pv = pred.detach() if isinstance(pred, Tensor) else pred
+        if not _is_traced(pv):
+            return true_fn(*args) if _to_bool(pv) else false_fn(*args)
+        from ..nn.control_flow import cond
+        return cond(pred, lambda: true_fn(*args), lambda: false_fn(*args))
+
+    @staticmethod
+    def convert_while(test_fn, body_fn, args):
+        first = test_fn(*args)
+        if not _is_traced(first):
+            vals = tuple(args)
+            while _to_bool(test_fn(*vals)):
+                vals = tuple(body_fn(*vals))
+            return vals
+        from ..nn.control_flow import while_loop
+        # names unbound at loop entry are per-iteration temps (python
+        # would NameError on a genuine read-before-write): exclude them
+        # from the XLA carry and recreate them inside each iteration
+        live = [i for i, v in enumerate(args) if v is not UNDEF]
+
+        def reinsert(vals):
+            full = [UNDEF] * len(args)
+            for i, v in zip(live, vals):
+                full[i] = v
+            return full
+
+        out = while_loop(
+            lambda *vs: test_fn(*reinsert(vs)),
+            lambda *vs: tuple(body_fn(*reinsert(vs))[i] for i in live),
+            [args[i] for i in live])
+        return tuple(reinsert(out))
+
+    @staticmethod
+    def convert_bool_op(op, lhs, rhs_thunk):
+        """`a and b` / `a or b`. Short-circuits for concrete lhs; strict
+        logical_and/or for traced operands (reference:
+        convert_operators.py convert_logical_and)."""
+        if not _is_traced(lhs):
+            lv = _to_bool(lhs)
+            if op == "and":
+                return rhs_thunk() if lv else lhs
+            return lhs if lv else rhs_thunk()
+        import jax.numpy as jnp
+
+        from ..core.dispatch import unwrap, wrap
+        rhs = rhs_thunk()
+        lv, rv = unwrap(lhs), unwrap(rhs)
+        fn = jnp.logical_and if op == "and" else jnp.logical_or
+        return wrap(fn(jnp.asarray(lv, bool).reshape(()),
+                       jnp.asarray(rv, bool).reshape(())))
+
+    @staticmethod
+    def convert_not(v):
+        if not _is_traced(v):
+            return not _to_bool(v)
+        import jax.numpy as jnp
+
+        from ..core.dispatch import unwrap, wrap
+        return wrap(jnp.logical_not(jnp.asarray(unwrap(v), bool).reshape(())))
+
+    @staticmethod
+    def convert_call(f):
+        return _convert_callee(f)
+
+    @staticmethod
+    def convert_range_cont(i, stop, step):
+        """Continuation test for a lowered `for ... in range(...)`:
+        respects the step sign; rejects step == 0 like Python."""
+        if not (_is_traced(i) or _is_traced(stop) or _is_traced(step)):
+            sv = int(step) if not hasattr(step, "numpy") else int(step)
+            if sv == 0:
+                raise ValueError("range() arg 3 must not be zero")
+            return i < stop if sv > 0 else i > stop
+        import jax.numpy as jnp
+
+        from ..core.dispatch import unwrap, wrap
+        iv, st, sp = (jnp.asarray(unwrap(v)) for v in (i, stop, step))
+        return wrap(jnp.where(sp > 0, iv < st, iv > st))
+
+
+def _to_bool(v):
+    from ..core.tensor import Tensor
+    if isinstance(v, Tensor):
+        v = v._value
+    return bool(np.asarray(v).reshape(()))
+
+
+jst = _Jst()
+
+
+# ---------------------------------------------------------------------------
+# callee conversion (reference: convert_call_func.py convert_call)
+# ---------------------------------------------------------------------------
+
+_fn_cache = {}  # code object id -> transformed function factory
+
+
+def _convert_callee(f):
+    """Return a control-flow-transformed version of a user callable; pass
+    framework/stdlib callables through untouched."""
+    from ..nn.layer.layers import Layer
+
+    if isinstance(f, Layer):
+        if not getattr(f, "_jst_forward_converted", False):
+            try:
+                fwd = f.forward
+                if isinstance(fwd, types.MethodType):
+                    conv = convert_to_static(fwd.__func__)
+                    f.forward = types.MethodType(conv, f)
+            except Exception:
+                pass
+            object.__setattr__(f, "_jst_forward_converted", True)
+        return f
+    if isinstance(f, types.MethodType):
+        conv = _convert_function(f.__func__)
+        return types.MethodType(conv, f.__self__) if conv is not None else f
+    if isinstance(f, types.FunctionType):
+        conv = _convert_function(f)
+        return conv if conv is not None else f
+    return f
+
+
+def _convert_function(fn):
+    mod = getattr(fn, "__module__", "") or ""
+    if mod.split(".")[0] in [p.split(".")[0] for p in _SKIP_MODULE_PREFIXES] \
+            or any(mod.startswith(p) for p in _SKIP_MODULE_PREFIXES):
+        return None
+    key = id(fn.__code__)
+    if key in _fn_cache:
+        return _fn_cache[key]
+    try:
+        conv = convert_to_static(fn)
+    except (OSError, TypeError, SyntaxError):
+        conv = None
+    _fn_cache[key] = conv
+    return conv
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+def _assigned_names(nodes):
+    """Local names assigned anywhere in `nodes` (not descending into
+    nested function/class definitions)."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass  # nested scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            pass
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                if node.id not in names:
+                    names.append(node.id)
+
+    for n in nodes:
+        V().visit(n)
+    return names
+
+
+def _contains(nodes, kinds):
+    """True if any node of `kinds` appears at this loop/branch level (not
+    inside a nested function or nested loop for Break/Continue)."""
+    hit = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def generic_visit(self, node):
+            if isinstance(node, kinds):
+                hit.append(node)
+            if isinstance(node, (ast.For, ast.While)) and \
+                    kinds != (ast.Return,):
+                return  # break/continue bind to the nested loop
+            super().generic_visit(node)
+
+    for n in nodes:
+        V().visit(n)
+    return bool(hit)
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _tuple(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+def _jst_attr(attr):
+    return ast.Attribute(value=_name("_jst"), attr=attr, ctx=ast.Load())
+
+
+def _make_fdef(name, args, body):
+    """ast.FunctionDef with every required field (incl. py3.12
+    type_params) populated."""
+    fd = ast.FunctionDef(name=name, args=args, body=body,
+                         decorator_list=[], returns=None,
+                         type_comment=None)
+    if "type_params" in ast.FunctionDef._fields:
+        fd.type_params = []
+    return fd
+
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- calls ------------------------------------------------------------
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        # _jst.* helpers and super() stay as-is
+        if isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "_jst":
+            return node
+        if isinstance(node.func, ast.Name) and node.func.id in (
+                "super", "locals", "globals", "range", "len", "isinstance",
+                "print"):
+            return node
+        node.func = ast.Call(func=_jst_attr("convert_call"),
+                             args=[node.func], keywords=[])
+        return node
+
+    # -- boolean operators ------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "and" if isinstance(node.op, ast.And) else "or"
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=rhs)
+            expr = ast.Call(func=_jst_attr("convert_bool_op"),
+                            args=[ast.Constant(op), expr, thunk],
+                            keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_jst_attr("convert_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+    # -- statement lists (return-aware) -----------------------------------
+    def process_body(self, stmts):
+        """Transform a statement list. An `if` containing `return` is
+        lowered by moving the statements AFTER it into the non-returning
+        branch (continuation), so both branches become expressions of one
+        convert_if — the reference's return_transformer flattening."""
+        res = []
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.If) and \
+                    _contains(st.body + st.orelse, (ast.Return,)):
+                res.extend(self._lower_return_if(st, stmts[i + 1:]))
+                return res
+            v = self.visit(st)
+            res.extend(v if isinstance(v, list) else [v])
+        return res
+
+    def _lower_return_if(self, node, suffix):
+        def ends_with_return(body):
+            return bool(body) and isinstance(body[-1], ast.Return)
+
+        import copy as _copy
+        t_body = list(node.body)
+        if not ends_with_return(t_body):
+            # deep-copy: the same suffix must not be transformed twice in
+            # place when it lands in both branch bodies
+            t_body = t_body + _copy.deepcopy(suffix)
+        f_body = list(node.orelse)
+        if not ends_with_return(f_body):
+            f_body = f_body + _copy.deepcopy(suffix)
+        test = self.visit(node.test)
+        t_body = self.process_body(t_body) or [ast.Pass()]
+        f_body = self.process_body(f_body) or [ast.Pass()]
+        names = _assigned_names(t_body + f_body)
+        uid = self._uid()
+        t_name, f_name = f"_jst_rett_{uid}", f"_jst_retf_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        t_def = _make_fdef(t_name, args, t_body)
+        f_def = _make_fdef(f_name, args, f_body)
+        prologue = [self._bind_undef(n) for n in names]
+        call = ast.Call(
+            func=_jst_attr("convert_if"),
+            args=[test, _name(t_name), _name(f_name), _tuple(names)],
+            keywords=[])
+        return prologue + [t_def, f_def, ast.Return(value=call)]
+
+    def visit_FunctionDef(self, node):
+        node.body = self.process_body(node.body)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains(node.body + node.orelse, (ast.Return,)):
+            return node  # unreachable via process_body; safety net
+        names = _assigned_names(node.body + node.orelse)
+        uid = self._uid()
+        t_name, f_name = f"_jst_true_{uid}", f"_jst_false_{uid}"
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=_tuple(names))
+        t_def = _make_fdef(t_name, args, (node.body or [ast.Pass()]) + [ret])
+        f_def = _make_fdef(f_name, args,
+                           (node.orelse or [ast.Pass()]) + [ret])
+        prologue = [self._bind_undef(n) for n in names]
+        call = ast.Call(
+            func=_jst_attr("convert_if"),
+            args=[node.test, _name(t_name), _name(f_name), _tuple(names)],
+            keywords=[])
+        assign = (ast.Assign(targets=[_tuple(names, ast.Store())],
+                             value=call)
+                  if names else ast.Expr(value=call))
+        return prologue + [t_def, f_def, assign]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains(node.body, (ast.Break, ast.Continue)) \
+                or _contains(node.body, (ast.Return,)):
+            return node  # v1 scope: leave as plain python
+        names = _assigned_names(node.body)
+        # names read by the test that are assigned in the body are already
+        # included; other test names are loop-invariant closures
+        if not names:
+            return node
+        uid = self._uid()
+        test_name, body_name = f"_jst_test_{uid}", f"_jst_body_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        test_def = _make_fdef(test_name, args,
+                              [ast.Return(value=node.test)])
+        body_def = _make_fdef(body_name, args,
+                              node.body + [ast.Return(value=_tuple(names))])
+        prologue = [self._bind_undef(n) for n in names]
+        call = ast.Call(
+            func=_jst_attr("convert_while"),
+            args=[_name(test_name), _name(body_name), _tuple(names)],
+            keywords=[])
+        assign = ast.Assign(targets=[_tuple(names, ast.Store())], value=call)
+        return prologue + [test_def, body_def, assign]
+
+    # -- for over range(...) ----------------------------------------------
+    def visit_For(self, node):
+        if (not node.orelse
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and isinstance(node.target, ast.Name)
+                and not _contains(node.body, (ast.Break, ast.Continue))
+                and not _contains(node.body, (ast.Return,))):
+            uid = self._uid()
+            i = node.target.id
+            rargs = node.iter.args
+            if len(rargs) == 1:
+                start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+            elif len(rargs) == 2:
+                start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+            else:
+                start, stop, step = rargs
+            stop_name = f"_jst_stop_{uid}"
+            step_name = f"_jst_step_{uid}"
+            it_name = f"_jst_it_{uid}"
+            init = [ast.Assign(targets=[_name(it_name, ast.Store())],
+                               value=start),
+                    ast.Assign(targets=[_name(stop_name, ast.Store())],
+                               value=stop),
+                    ast.Assign(targets=[_name(step_name, ast.Store())],
+                               value=step)]
+            test = ast.Call(func=_jst_attr("convert_range_cont"),
+                            args=[_name(it_name), _name(stop_name),
+                                  _name(step_name)],
+                            keywords=[])
+            # `i = _it` first, `_it += step` last: after the loop the
+            # target holds the last yielded value, exactly like Python
+            bind = ast.Assign(targets=[_name(i, ast.Store())],
+                              value=_name(it_name))
+            inc = ast.AugAssign(target=_name(it_name, ast.Store()),
+                                op=ast.Add(), value=_name(step_name))
+            loop = ast.While(test=test, body=[bind] + node.body + [inc],
+                             orelse=[])
+            out = []
+            for stmt in init:
+                out.append(stmt)
+            res = self.visit_While(loop)
+            out.extend(res if isinstance(res, list) else [res])
+            return out
+        self.generic_visit(node)
+        return node
+
+    @staticmethod
+    def _bind_undef(n):
+        # a = _jst.local(locals(), 'a')  — UNDEF when unbound so far
+        return ast.Assign(
+            targets=[_name(n, ast.Store())],
+            value=ast.Call(
+                func=_jst_attr("local"),
+                args=[ast.Call(func=_name("locals"), args=[], keywords=[]),
+                      ast.Constant(n)],
+                keywords=[]))
+
+
+def convert_to_static(fn):
+    """AST-transform `fn` (a plain function) so its data-dependent control
+    flow lowers through nn.control_flow when traced. Returns a new
+    function with the same signature and closure environment."""
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise TypeError(f"cannot transform {fn!r}")
+    fdef.decorator_list = []  # avoid re-applying @to_static etc.
+    tr = _Transformer()
+    fdef.body = tr.process_body(fdef.body)
+    new_tree = tree
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, f"<dy2static {fn.__qualname__}>", "exec")
+
+    # rebuild closure: the transformed code must see the same free
+    # variables; compiling standalone turns them into globals, so inject
+    # the closure cells' current values into the globals namespace
+    glb = dict(fn.__globals__)
+    glb["_jst"] = jst
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    loc = {}
+    exec(code, glb, loc)
+    out = loc[fdef.name]
+    out = functools.wraps(fn)(out)
+    out.__globals__["_jst"] = jst
+    if fn.__defaults__ is not None:
+        out.__defaults__ = fn.__defaults__
+    out._jst_transformed = True
+    return out

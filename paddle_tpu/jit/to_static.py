@@ -28,6 +28,20 @@ from ..core.tensor import Tensor
 _is_tracing = False
 
 
+def _data_dependent_errors():
+    import jax
+    errs = []
+    for name in ("TracerBoolConversionError", "ConcretizationTypeError",
+                 "TracerIntegerConversionError"):
+        e = getattr(jax.errors, name, None)
+        if e is not None:
+            errs.append(e)
+    return tuple(errs)
+
+
+_DATA_DEPENDENT_ERRORS = _data_dependent_errors()
+
+
 def in_tracing():
     return _is_tracing
 
@@ -150,7 +164,15 @@ class StaticFunction:
                mesh is not None)
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._build(treedef, leaves, dyn_idx, state_items)
+            try:
+                entry = self._build(treedef, leaves, dyn_idx, state_items)
+            except _DATA_DEPENDENT_ERRORS as e:
+                # data-dependent python control flow: fall back to the AST
+                # transformation (reference: program_translator.py always
+                # AST-transforms; here the plain trace is the fast path)
+                if not self._try_ast_fallback(e):
+                    raise
+                entry = self._build(treedef, leaves, dyn_idx, state_items)
             self._cache[key] = entry
         compiled, out_wrap = entry
 
@@ -353,6 +375,29 @@ class StaticFunction:
             return jax.tree_util.tree_unflatten(out_template["treedef"], wrapped)
 
         return compiled, out_wrap
+
+    def _try_ast_fallback(self, cause):
+        """Swap self._fn for its dy2static-transformed version once."""
+        import types as _types
+
+        if getattr(self._fn, "_jst_transformed", False):
+            return False
+        from .dy2static import convert_to_static
+        try:
+            fn = self._fn
+            if isinstance(fn, _types.MethodType):
+                conv = convert_to_static(fn.__func__)
+                self._fn = _types.MethodType(conv, fn.__self__)
+            else:
+                self._fn = convert_to_static(fn)
+        except (OSError, TypeError, SyntaxError) as e:
+            raise RuntimeError(
+                "tracing hit data-dependent python control flow "
+                f"({cause!s:.200}) and the AST fallback could not transform "
+                f"{self._fn!r} ({e}). Rewrite the condition with "
+                "paddle_tpu.nn.control_flow (cond/while_loop), or decorate "
+                "a plain `def` (lambdas cannot be AST-transformed).")
+        return True
 
     # paddle API compat
     @property
