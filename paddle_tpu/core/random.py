@@ -86,4 +86,47 @@ def set_rng_state(state):
 
 
 def next_key():
-    return _default().next_key()
+    k = _scoped_next()
+    return k if k is not None else _default().next_key()
+
+
+# ---------------------------------------------------------------------------
+# Scoped deterministic keys (RNG replay)
+# ---------------------------------------------------------------------------
+# Inside a `scoped_key(base)` block, next_key() derives keys DETERMINISTICALLY
+# from `base` by call order (fold_in(base, counter)) instead of consuming the
+# global generator. Running the same code twice under the same base key draws
+# the same masks — the TPU analog of the reference's RNG-state replay in
+# recompute (`fleet/utils/recompute.py:63`) and the mechanism the fused 1F1B
+# backward uses to recompute dropout forwards exactly.
+
+_scoped_stack = []
+
+
+class _Scope:
+    __slots__ = ("base", "i")
+
+    def __init__(self, base):
+        self.base = base
+        self.i = 0
+
+
+class scoped_key:
+    def __init__(self, base_key):
+        self._base = base_key
+
+    def __enter__(self):
+        _scoped_stack.append(_Scope(self._base))
+        return self
+
+    def __exit__(self, *exc):
+        _scoped_stack.pop()
+        return False
+
+
+def _scoped_next():
+    if not _scoped_stack:
+        return None
+    s = _scoped_stack[-1]
+    s.i += 1
+    return jax.random.fold_in(s.base, s.i)

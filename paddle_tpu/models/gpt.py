@@ -175,13 +175,11 @@ def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
     from ..parallel import spmd_pipeline_1f1b
 
     cfg = model.config
-    if model.training and (cfg.hidden_dropout > 0
-                           or cfg.attention_dropout > 0):
-        raise ValueError(
-            "build_gpt_1f1b_step needs model.eval() or zero dropout: the "
-            "1F1B backward recomputes the forward, and a train-mode dropout "
-            "would draw a different mask in the recompute (silently wrong "
-            "gradients)")
+    # train-mode dropout: per-microbatch threefry keys thread through the
+    # pipeline so the recompute-based backward replays the forward's masks
+    # exactly (reference: fleet/utils/recompute.py:63 RNG-state replay)
+    use_rng = model.training and (cfg.hidden_dropout > 0
+                                  or cfg.attention_dropout > 0)
     pp = mesh.shape[axis_pp]
     L = cfg.num_layers
     if L % pp != 0:
@@ -211,17 +209,41 @@ def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
 
     stacked, first_params, last_params = snapshot_params()
 
-    def stage_fn(params, x):
-        def body(h, leaves):
-            with bind_values(leaf_tensors, list(leaves)), _ag.no_grad():
-                out = template(Tensor(h))
+    from ..core import random as core_random
+
+    def stage_fn(params, x, key=None):
+        def body(h, xs):
+            if key is None:
+                leaves = xs
+                with bind_values(leaf_tensors, list(leaves)), _ag.no_grad():
+                    out = template(Tensor(h))
+            else:
+                leaves, idx = xs[:-1], xs[-1]
+                # distinct key per layer position: masks must not repeat
+                # across the stage's layers (the scan body traces once)
+                with core_random.scoped_key(jax.random.fold_in(key, idx)), \
+                        bind_values(leaf_tensors, list(leaves)), \
+                        _ag.no_grad():
+                    out = template(Tensor(h))
             return unwrap(out), None
-        h, _ = lax.scan(body, x, params)
+
+        xs = params if key is None else tuple(params) + (
+            jnp.arange(per, dtype=jnp.int32),)
+        h, _ = lax.scan(body, x, xs)
         return h
 
-    def first_fn(fp, ids):
+    def first_fn(fp, ids, key=None):
         wte, wpe = fp
-        return wte[ids] + wpe[jnp.arange(ids.shape[-1])]
+        emb = wte[ids] + wpe[jnp.arange(ids.shape[-1])]
+        if key is not None and cfg.hidden_dropout > 0:
+            # the model's post-embedding dropout (model.gpt.drop) replayed
+            # through the ONE dropout implementation via a scoped key
+            from ..nn import functional as F
+            with core_random.scoped_key(jax.random.fold_in(key, 997)), \
+                    _ag.no_grad():
+                emb = unwrap(F.dropout(Tensor(emb), p=cfg.hidden_dropout,
+                                       training=True))
+        return emb
 
     # the head/loss re-runs the model's own code (ln_f + tied matmul +
     # GPTForCausalLM.loss) with values bound, so the pipelined path cannot
@@ -229,7 +251,7 @@ def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
     head_tensors = [model.gpt.ln_f.weight, model.gpt.ln_f.bias,
                     model.gpt.wte.weight]
 
-    def last_fn(lp, h, labels):
+    def last_fn(lp, h, labels, key=None):
         with bind_values(head_tensors, list(lp)), _ag.no_grad():
             norm = model.gpt.ln_f(Tensor(h))
             from .. import ops as _ops
@@ -238,10 +260,19 @@ def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
             loss = model.loss(logits, Tensor(labels))
             return unwrap(loss)
 
-    def inner(sp, fp, lp, ids, labels):
+    def inner(sp, fp, lp, ids, labels, rng_keys=None):
+        if rng_keys is not None and axis_dp is not None:
+            # decorrelate dropout across data-parallel replicas: each dp
+            # rank processes different samples and must draw different
+            # masks (reference: per-data-rank seed offsets)
+            di = jax.lax.axis_index(axis_dp)
+            rng_keys = jax.vmap(lambda kd: jax.random.key_data(
+                jax.random.fold_in(jax.random.wrap_key_data(kd), di)))(
+                    rng_keys)
         loss, gP, gF, gL = spmd_pipeline_1f1b(
             stage_fn, last_fn, sp, lp, ids, labels,
-            first_fn=first_fn, first_params=fp, axis_name=axis_pp)
+            first_fn=first_fn, first_params=fp, axis_name=axis_pp,
+            rng_keys=rng_keys)
         if axis_dp is not None:
             loss = jax.lax.pmean(loss, axis_dp)
             gP = jax.tree_util.tree_map(
@@ -256,18 +287,26 @@ def build_gpt_1f1b_step(model, mesh, axis_pp="pp", axis_dp=None):
     pp_tree = jax.tree_util.tree_map(lambda _: P(axis_pp), stacked)
     rep = jax.tree_util.tree_map(lambda _: P(), first_params)
     rep_l = jax.tree_util.tree_map(lambda _: P(), last_params)
+    in_specs = (pp_tree, rep, rep_l, batch_spec, batch_spec) + (
+        (P(None),) if use_rng else ())
     step = jax.jit(jax.shard_map(
-        inner, mesh=mesh,
-        in_specs=(pp_tree, rep, rep_l, batch_spec, batch_spec),
+        inner, mesh=mesh, in_specs=in_specs,
         out_specs=(P(), (pp_tree, rep, rep_l))))
 
-    def run(ids_micro, labels_micro, params=None):
+    def run(ids_micro, labels_micro, params=None, rng_key=None):
         """params: (stacked, first, last) from run.snapshot_params(); the
         build-time snapshot is used when omitted (fine for a single step or
-        eval, NOT for a training loop — snapshot after each update)."""
+        eval, NOT for a training loop — snapshot after each update).
+        In train mode with dropout, per-microbatch keys are split from
+        `rng_key` (or the framework generator when omitted)."""
         sp, fp, lp = params if params is not None else (
             stacked, first_params, last_params)
-        return step(sp, fp, lp, ids_micro, labels_micro)
+        if not use_rng:
+            return step(sp, fp, lp, ids_micro, labels_micro)
+        base = rng_key if rng_key is not None else core_random.next_key()
+        keys = jax.random.key_data(
+            jax.random.split(base, ids_micro.shape[0]))
+        return step(sp, fp, lp, ids_micro, labels_micro, keys)
 
     run.snapshot_params = snapshot_params
     return run, (stacked, first_params, last_params, leaf_names)

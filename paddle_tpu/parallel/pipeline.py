@@ -75,7 +75,7 @@ def ring_buffer_size(n_stages, n_micro):
 
 def spmd_pipeline_1f1b(stage_fn, last_fn, stacked_params, last_params,
                        microbatches, labels, first_fn=None, first_params=None,
-                       axis_name="pp"):
+                       axis_name="pp", rng_keys=None):
     """One fused 1F1B fwd+bwd pipeline step. Run inside shard_map with
     `axis_name` bound.
 
@@ -91,6 +91,12 @@ def spmd_pipeline_1f1b(stage_fn, last_fn, stacked_params, last_params,
     first_fn(first_params, raw_microbatch) -> hidden  (stage 0 only; lifts
         the uniform restriction: embedding lives inside the pipeline)
     last_fn(last_params, hidden, label) -> scalar loss  (stage S-1 only)
+    rng_keys: optional [M, 2] uint32 threefry key data (replicated), one
+        key per microbatch. When given, every fn takes a trailing PRNG-key
+        argument derived per (microbatch, stage): the SAME key reaches the
+        forward and its recompute-based backward, so train-mode dropout
+        draws identical masks in both (the reference's RNG-state replay,
+        `fleet/utils/recompute.py:63`, as stateless key threading).
     stacked_params: leading axis n_stages, sharded over axis_name outside.
     microbatches: [M, ...raw] replicated; labels: [M, ...] replicated.
 
@@ -115,11 +121,24 @@ def spmd_pipeline_1f1b(stage_fn, last_fn, stacked_params, last_params,
     bwd_perm = [(i, (i - 1) % S_static) for i in range(S_static)]
 
     if first_fn is None:
-        first_fn = lambda _, x: x
+        first_fn = lambda _, x, *rest: x
         first_params = jnp.zeros((), jnp.float32)
 
+    if rng_keys is None:
+        key_of = lambda m_c: None
+        call_first = lambda fp, raw, k: first_fn(fp, raw)
+        call_stage = lambda p, x, k: stage_fn(p, x)
+        call_last = lambda lp, y, lab, k: last_fn(lp, y, lab)
+    else:
+        def key_of(m_c):
+            base = jax.random.wrap_key_data(rng_keys[m_c])
+            return jax.random.fold_in(base, stage)
+
+        call_first, call_stage, call_last = first_fn, stage_fn, last_fn
+
     def _hidden_of(raw):
-        return first_fn(first_params, raw)
+        return call_first(first_params, raw,
+                          key_of(jnp.asarray(0, jnp.int32)))
 
     hidden_struct = jax.eval_shape(_hidden_of, microbatches[0])
     # device-varying cast: cond branches must agree on varying-ness even when
@@ -151,25 +170,27 @@ def spmd_pipeline_1f1b(stage_fn, last_fn, stacked_params, last_params,
     first_params = jax.tree_util.tree_map(_v, first_params)
     last_params = jax.tree_util.tree_map(_v, last_params)
 
-    def stage_in(raw_in, hidden_in):
+    def stage_in(raw_in, hidden_in, k):
         # stage 0 computes its input from the raw microbatch (embed);
         # other stages consume the wire buffer
         return lax.cond(is_first,
-                        lambda: _v(first_fn(first_params, raw_in).astype(
+                        lambda: _v(call_first(first_params, raw_in, k).astype(
                             hidden_struct.dtype)),
                         lambda: hidden_in)
 
-    def bwd_scalar(p, fp, lp, raw_in, hidden_in, label, cot):
+    def bwd_scalar(p, fp, lp, raw_in, hidden_in, label, cot, k):
         """Scalar whose gradient is the stage's VJP: the loss itself on the
-        last stage, <y, cot> elsewhere (vdot trick = seeded VJP)."""
+        last stage, <y, cot> elsewhere (vdot trick = seeded VJP). `k` is
+        the SAME per-(microbatch, stage) key the forward used — dropout
+        masks replay exactly in this recompute."""
         x = lax.cond(
             is_first,
-            lambda: _v(first_fn(fp, raw_in).astype(hidden_struct.dtype)),
+            lambda: _v(call_first(fp, raw_in, k).astype(hidden_struct.dtype)),
             lambda: hidden_in)
-        y = stage_fn(p, x)
+        y = call_stage(p, x, k)
         return lax.cond(
             is_last,
-            lambda: _v(last_fn(lp, y, label).astype(jnp.float32)),
+            lambda: _v(call_last(lp, y, label, k).astype(jnp.float32)),
             lambda: _v(jnp.vdot(y.astype(jnp.float32),
                                 cot.astype(jnp.float32))))
 
@@ -183,12 +204,13 @@ def spmd_pipeline_1f1b(stage_fn, last_fn, stacked_params, last_params,
         do_fwd = (mf >= 0) & (mf < M)
         mf_c = jnp.clip(mf, 0, M - 1)
         raw_f = microbatches[mf_c]
-        x = stage_in(raw_f, fwd_recv)
-        y = stage_fn(params, x)
+        kf = key_of(mf_c)
+        x = stage_in(raw_f, fwd_recv, kf)
+        y = call_stage(params, x, kf)
         loss_f = lax.cond(
             is_last,
-            lambda: _v(last_fn(last_params, y,
-                               labels[mf_c]).astype(jnp.float32)),
+            lambda: _v(call_last(last_params, y,
+                                 labels[mf_c], kf).astype(jnp.float32)),
             lambda: _v(jnp.float32(0)))
         slot_f = mf_c % B
         act_buf = act_buf.at[slot_f].set(
@@ -203,7 +225,7 @@ def spmd_pipeline_1f1b(stage_fn, last_fn, stacked_params, last_params,
         x_saved = act_buf[mb_c % B]
         g_p, g_f, g_l, dx = bwd_grads(params, first_params, last_params,
                                       microbatches[mb_c], x_saved,
-                                      labels[mb_c], bwd_recv)
+                                      labels[mb_c], bwd_recv, key_of(mb_c))
         # where, not mask-multiply: out-of-window bwd runs on garbage inputs
         # and 0 * NaN would poison the accumulators (e.g. log(0) in a
         # cross-entropy last_fn during warmup steps)
