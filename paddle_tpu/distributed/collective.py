@@ -10,9 +10,56 @@ state the ops degenerate to their mathematical identities.
 """
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.dispatch import call_op, unwrap
 from ..core.tensor import Tensor
+
+_barrier_count = 0
+
+
+def _process_gather(value):
+    """REAL cross-process allgather for the eager path: one value per
+    process, stacked [nprocs, ...] on every host (jax coordination service
+    + CPU/TPU collectives underneath — the Gloo analog)."""
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(value))
+
+
+def _check_subgroup_in_trace(group, ax):
+    """Inside shard_map a Group is a FULL mesh axis; a proper-subset rank
+    list cannot be expressed as a named-axis collective — reject loudly
+    (reference new_group builds a real sub-communicator, collective.py:209)."""
+    if group is None or group.ranks is None:
+        return
+    try:
+        axis_size = int(jax.lax.psum(1, ax))
+    except Exception:
+        return
+    if len(group.ranks) != axis_size:
+        raise NotImplementedError(
+            f"Group(ranks={group.ranks}) is a proper subset of mesh axis "
+            f"'{ax}' (size {axis_size}): named-axis collectives always span "
+            "the full axis. Build the mesh so the subgroup IS an axis "
+            "(e.g. reshape devices into [outer, inner] and collect over "
+            "one), or run the subgroup collective eagerly.")
+
+
+def _eager_subgroup(group):
+    """(member?, ranks) for the eager multi-process path. Ranks are
+    TRAINER (process) ranks — the reference's one-device-per-trainer
+    model; with multi-device processes the process/device rank spaces
+    diverge and a subgroup would be ambiguous, so reject loudly."""
+    if group is None or group.ranks is None:
+        return True, None
+    if jax.device_count() != jax.process_count():
+        raise NotImplementedError(
+            f"eager subgroup collectives need one device per process "
+            f"(trainer ranks == device ranks); this job has "
+            f"{jax.process_count()} processes x "
+            f"{jax.local_device_count()} devices. Run the subgroup "
+            "collective inside shard_map over a mesh axis instead.")
+    return jax.process_index() in group.ranks, list(group.ranks)
 
 
 class ReduceOp:
@@ -75,14 +122,35 @@ def _axis(group):
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
+        _check_subgroup_in_trace(group, ax)
         fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
                ReduceOp.MIN: jax.lax.pmin,
-               ReduceOp.AVG: jax.lax.pmean}
+               ReduceOp.AVG: jax.lax.pmean,
+               # no lax.pprod: product via gather+reduce
+               ReduceOp.PROD: lambda v, a: jnp.prod(
+                   jax.lax.all_gather(v, a), axis=0)}
         out = call_op(lambda v: fns[op](v, ax), tensor, op_name="c_allreduce")
         tensor._value = out._value
         tensor._tape_node = out._tape_node
         tensor._tape_index = out._tape_index
         tensor.stop_gradient = out.stop_gradient
+        return tensor
+    if jax.process_count() > 1:
+        # REAL eager cross-process allreduce (was a silent identity —
+        # 2-process eager users would train on unsynced state)
+        member, ranks = _eager_subgroup(group)
+        # the underlying allgather is a GLOBAL collective: every process
+        # must issue it (a skipping non-member would cross-match the next
+        # collective on the wire); non-members just discard the result
+        gathered = _process_gather(unwrap(tensor))
+        if not member:
+            return tensor
+        if ranks is not None:
+            gathered = gathered[ranks]
+        red = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
+               ReduceOp.MIN: np.min, ReduceOp.PROD: np.prod,
+               ReduceOp.AVG: np.mean}[op](gathered, axis=0)
+        tensor.set_value(red)  # set_value casts to the tensor's dtype
         return tensor
     return tensor  # replicated: allreduce(sum over 1 copy) == identity
 
@@ -90,11 +158,21 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
+        _check_subgroup_in_trace(group, ax)
         out = call_op(
             lambda v: jax.lax.all_gather(v, ax), tensor, op_name="c_allgather")
         n = out.shape[0]
         for i in range(n):
             tensor_list.append(out[i])
+        return tensor_list
+    if jax.process_count() > 1:
+        member, ranks = _eager_subgroup(group)
+        gathered = _process_gather(unwrap(tensor))  # global: all processes
+        if not member:
+            return tensor_list
+        idxs = ranks if ranks is not None else range(gathered.shape[0])
+        for i in idxs:
+            tensor_list.append(Tensor(gathered[i]))
         return tensor_list
     tensor_list.append(tensor)
     return tensor_list
@@ -107,6 +185,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
+        _check_subgroup_in_trace(group, ax)
+
         def _bcast(v):
             # mask + psum: every rank contributes 0 except src, so only one
             # copy crosses the wire (vs all_gather+index which materialises
@@ -120,12 +200,25 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         tensor._tape_node = out._tape_node
         tensor._tape_index = out._tape_index
         return tensor
+    if jax.process_count() > 1:
+        member, ranks = _eager_subgroup(group)
+        gathered = _process_gather(unwrap(tensor))  # global: all processes
+        # validate AFTER the gather: raising before it on members only
+        # would leave non-members blocked inside the global collective
+        if member and ranks is not None and src not in ranks:
+            raise ValueError(f"broadcast src {src} not in group {ranks}")
+        if not member:
+            return tensor
+        tensor.set_value(gathered[src])
+        return tensor
     return tensor
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
+        _check_subgroup_in_trace(group, ax)
+
         def _scatter(v):
             idx = jax.lax.axis_index(ax)
             stacked = jnp.stack([unwrap(t) for t in tensor_list])
@@ -133,6 +226,10 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         out = call_op(_scatter, tensor, op_name="c_scatter")
         tensor._value = out._value
         return tensor
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "eager multi-process scatter() is not supported; run it inside "
+            "shard_map with the group's mesh axis bound")
     if tensor_list:
         tensor.set_value(unwrap(tensor_list[src]))
     return tensor
@@ -141,12 +238,17 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
+        _check_subgroup_in_trace(group, ax)
         stacked = jnp.stack([unwrap(t) for t in in_tensor_list])
         out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
                                  tiled=False)
         for i in range(out.shape[0]):
             out_tensor_list.append(Tensor(out[i]))
         return out_tensor_list
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "eager multi-process alltoall() is not supported; run it inside "
+            "shard_map with the group's mesh axis bound")
     out_tensor_list.extend(in_tensor_list)
     return out_tensor_list
 
@@ -206,8 +308,22 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
+    if group is not None and group.ranks is not None and \
+            len(group.ranks) < jax.process_count():
+        raise NotImplementedError(
+            "group-scoped barrier over a proper subset of processes is not "
+            "supported (the global rendezvous would deadlock); use the "
+            "full-world barrier or a PS-side barrier")
+    if jax.process_count() > 1:
+        # REAL cross-process rendezvous (was a no-op across processes)
+        global _barrier_count
+        _barrier_count += 1
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(
+            f"paddle_tpu_barrier_{_barrier_count}")
+        return None
     for d in jax.devices():
-        pass  # single-controller: dispatch order is the barrier
+        pass  # single-process: dispatch order is the barrier
     return None
 
 

@@ -1,0 +1,45 @@
+"""Eager multi-process collective fixture: every op here used to be a
+silent identity across processes (round-2 weakness) — now they are REAL
+cross-process collectives or loud errors. Run under the launcher with 2
+processes; prints CHECK lines the parent asserts on."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+rank = dist.get_rank()
+world = dist.get_world_size()
+assert world == 2, world
+
+# -- all_reduce: ranks hold different values; both must see the sum -------
+t = paddle.to_tensor(np.full((3,), float(rank + 1), np.float32))
+dist.all_reduce(t)
+print(f"CHECK allreduce {t.numpy().tolist()}", flush=True)
+
+# -- broadcast from rank 1 ------------------------------------------------
+b = paddle.to_tensor(np.full((2,), float(rank * 10), np.float32))
+dist.broadcast(b, src=1)
+print(f"CHECK broadcast {b.numpy().tolist()}", flush=True)
+
+# -- all_gather -----------------------------------------------------------
+lst = []
+dist.all_gather(lst, paddle.to_tensor(np.float32(rank + 5)))
+print(f"CHECK allgather {[float(x.numpy()) for x in lst]}", flush=True)
+
+# -- subgroup: ranks=[0] — member reduces over itself, non-member no-op ---
+g = dist.new_group(ranks=[0])
+s = paddle.to_tensor(np.float32(rank + 1))
+dist.all_reduce(s, group=g)
+print(f"CHECK subgroup {float(s.numpy())}", flush=True)
+
+# -- barrier is a real rendezvous ----------------------------------------
+dist.barrier()
+print("CHECK barrier done", flush=True)
+
+# -- send/recv still raise loudly eagerly --------------------------------
+try:
+    dist.send(t, dst=1)
+    print("CHECK send no-error", flush=True)
+except NotImplementedError:
+    print("CHECK send raises", flush=True)

@@ -333,3 +333,28 @@ def test_topology_ranks():
     r = topo.get_rank(data=1, pipe=0, sharding=0, model=1)
     coord = topo.get_coord(r)
     assert coord["data"] == 1 and coord["model"] == 1
+
+
+def test_subgroup_ranks_rejected_in_shard_map():
+    """Group(ranks=subset) inside shard_map cannot ride a full named-axis
+    collective — must raise, not silently span the whole axis."""
+    import jax
+    import numpy as np
+    import pytest
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    mesh = dist.make_mesh({"x": 4})
+    g = dist.new_group(ranks=[0, 1], axis_name="x")
+
+    def f(v):
+        t = paddle.to_tensor(v)
+        dist.all_reduce(t, group=g)
+        return t._value if hasattr(t, "_value") else t
+
+    with pytest.raises(NotImplementedError, match="proper subset"):
+        jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P("x"), out_specs=P("x")))(
+                np.ones((4,), np.float32))

@@ -182,3 +182,37 @@ class TestElasticAcrossProcesses:
         assert sorted([dead_rank, initial_rank]) == [0, 1]
         assert n_live == 1
         assert final_rank == 0  # survivor re-ranked to 0
+
+
+class TestEagerCollectives:
+    """Eager (non-shard_map) collectives across REAL processes: formerly
+    silent identities, now true cross-process ops (reference:
+    collective.py broadcast:348/all_reduce:415 work eagerly in dygraph)."""
+
+    def test_two_proc_eager_collectives(self, tmp_path):
+        env = _clean_env()
+        env["JAX_PLATFORMS"] = "cpu"
+        fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                               "eager_collective_fixture.py")
+        log_dir = str(tmp_path)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--started_port", "19970",
+             "--log_dir", log_dir, fixture],
+            capture_output=True, text=True, env=env, timeout=600, cwd=REPO)
+        assert r.returncode == 0, (r.stderr[-2000:] or "") + _tail_logs(log_dir)
+        outs = []
+        for i in (0, 1):
+            with open(os.path.join(log_dir, f"workerlog.{i}")) as f:
+                outs.append(f.read())
+        for i, out in enumerate(outs):
+            # sum over ranks: (1) + (2) = 3 on BOTH ranks
+            assert "CHECK allreduce [3.0, 3.0, 3.0]" in out, out[-1500:]
+            # broadcast from rank 1: value 10 everywhere
+            assert "CHECK broadcast [10.0, 10.0]" in out, out[-1500:]
+            assert "CHECK allgather [5.0, 6.0]" in out, out[-1500:]
+            # subgroup [0]: rank0 reduces over itself (1.0), rank1 untouched
+            want = 1.0 if i == 0 else 2.0
+            assert f"CHECK subgroup {want}" in out, out[-1500:]
+            assert "CHECK barrier done" in out
+            assert "CHECK send raises" in out
