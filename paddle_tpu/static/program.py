@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.dispatch import _STATIC_HOOK
+from ..core.dispatch import _STATIC_HOOK, unwrap
 from ..core.tensor import Parameter, Tensor
 
 
@@ -136,7 +136,12 @@ class Program:
         return tuple(out_tensors)
 
     # -- replay -----------------------------------------------------------
-    def _replay(self, env):
+    def _replay(self, env, post_write=None):
+        """Replay the op records into `env`. `post_write` maps slot ->
+        fn(value) applied right after the producing op writes the slot —
+        the seam that lets gradients() treat an INTERMEDIATE activation as
+        an independent input (substitute the traced source value) or as a
+        constant (stop_gradient for no_grad_set)."""
         for op in self.ops:
             args = [env[a.idx] if isinstance(a, _Slot) else a
                     for a in op.arg_slots]
@@ -145,6 +150,8 @@ class Program:
             out = op.fn(*args, **kwargs)
             outs = out if isinstance(out, tuple) else (out,)
             for slot, o in zip(op.out_slots, outs):
+                if post_write is not None and slot in post_write:
+                    o = post_write[slot](o)
                 env[slot] = o
 
     def _pure(self, feed_slots, fetch_slots, param_slots, train=False):
@@ -355,39 +362,89 @@ class Executor:
         """Fetch-list contains X@GRAD handles: compile
         value_and_grad(replay-to-target) wrt the sources (reference:
         fetching append_backward/gradients vars from exe.run)."""
-        from ..core.enforce import (InvalidArgumentError,
-                                    UnimplementedError, enforce)
-        tslots = {prog._slot_of(g.target, create=False)
-                  for _, g in grad_fetches}
-        enforce(len(tslots) == 1 and None not in tslots,
+        from ..core.enforce import InvalidArgumentError, enforce
+        sigs = {(tuple(prog._slot_of(t, create=False) for t in g.targets),
+                 frozenset(prog._slot_of(v, create=False)
+                           for v in g.no_grad),
+                 None if g.target_gradients is None
+                 else tuple(id(t) for t in g.target_gradients))
+                for _, g in grad_fetches}
+        enforce(len(sigs) == 1,
                 "all fetched @GRAD vars in one run must share the same "
-                "target recorded in this program; got target slots "
-                f"{sorted(tslots, key=str)}", InvalidArgumentError)
-        tslot = next(iter(tslots))
-        src_slots = [prog._slot_of(g.source, create=False)
-                     for _, g in grad_fetches]
+                "targets/no_grad_set/target_gradients recorded in this "
+                f"program; got {sorted(sigs, key=str)}",
+                InvalidArgumentError)
+        tslots_sig, ng_sig, _tg_sig = next(iter(sigs))
+        enforce(None not in tslots_sig,
+                "gradients() target was not recorded in this program",
+                InvalidArgumentError)
+        g0 = grad_fetches[0][1]
+        # per-target cotangent seeds; None entries -> ones (summed target).
+        # seed VALUES are jit arguments (not closed-over constants): the
+        # cache key only carries the None-pattern, so re-running with new
+        # seeds must not replay the old ones
+        tgrads = g0.target_gradients
+        tg_pattern = None
+        tg_args = []
+        if tgrads is not None:
+            tg_pattern = tuple(t is not None for t in tgrads)
+            tg_args = [jnp.asarray(unwrap(t)) for t in tgrads
+                       if t is not None]
+        ng_slots = set(ng_sig)
+        ng_slots.discard(None)
+        src_all = [prog._slot_of(g.source, create=False)
+                   for _, g in grad_fetches]
+        for (_, g), slot in zip(grad_fetches, src_all):
+            enforce(slot is not None,
+                    f"gradients() source {g.source!r} was never used by "
+                    "any op recorded in this program", InvalidArgumentError)
+        # duplicate sources collapse to ONE diff variable (last-wins dict
+        # zip would silently zero the earlier handle's grad)
+        src_slots = list(dict.fromkeys(src_all))
         pos_in_feed = {s: i for i, s in enumerate(feed_slots)}
         pos_in_param = {s: i for i, s in enumerate(param_slots)}
-        for s in src_slots:
-            enforce(s in pos_in_feed or s in pos_in_param,
-                    "gradients() sources must be feed placeholders or "
-                    "parameters (intermediate-activation grads are not "
-                    "recorded in the op-list IR)", UnimplementedError)
+        # intermediate sources: substituted right after their producing op
+        # writes them (replay post_write seam) — d(target)/d(activation)
+        inter_src = [s for s in src_slots
+                     if s not in pos_in_feed and s not in pos_in_param]
 
-        def pure(fvals, pvals):
+        def pure(fvals, pvals, tgvals):
+            base_env = {}
+            for s, v in zip(feed_slots, fvals):
+                base_env[s] = v
+            for s, v in zip(param_slots, pvals):
+                base_env[s] = v
+            if inter_src:
+                env0 = dict(base_env)
+                prog._replay(env0)  # linearization point for intermediates
             src0 = [fvals[pos_in_feed[s]] if s in pos_in_feed
-                    else pvals[pos_in_param[s]] for s in src_slots]
+                    else pvals[pos_in_param[s]] if s in pos_in_param
+                    else env0[s] for s in src_slots]
 
             def loss_fn(src_vals):
-                env = {}
-                for s, v in zip(feed_slots, fvals):
-                    env[s] = v
-                for s, v in zip(param_slots, pvals):
-                    env[s] = v
-                for s, v in zip(src_slots, src_vals):
-                    env[s] = v
-                prog._replay(env)
-                tgt = jnp.sum(env[tslot])  # scalarize (reference sums)
+                env = dict(base_env)
+                subst = dict(zip(src_slots, src_vals))
+                for s in src_slots:
+                    if s in pos_in_feed or s in pos_in_param:
+                        env[s] = subst[s]
+                post = {s: (lambda _o, _s=s: subst[_s]) for s in inter_src}
+                for s in ng_slots:
+                    if s in env:  # feed/param constants
+                        env[s] = jax.lax.stop_gradient(env[s])
+                    elif s not in post:  # intermediate constants
+                        post[s] = jax.lax.stop_gradient
+                prog._replay(env, post_write=post or None)
+                parts = []
+                it_tg = iter(tgvals)
+                for j, ts in enumerate(tslots_sig):
+                    tv = env[ts]
+                    if tg_pattern is not None and tg_pattern[j]:
+                        parts.append(jnp.vdot(
+                            tv.astype(jnp.float32),
+                            next(it_tg).astype(jnp.float32)))
+                    else:
+                        parts.append(jnp.sum(tv).astype(jnp.float32))
+                tgt = sum(parts)  # multiple targets sum (reference :1972)
                 return tgt, [env[s] for s in fetch_slots]
 
             (_, normals), gs = jax.value_and_grad(
@@ -396,17 +453,19 @@ class Executor:
 
         key = ("grads", tuple(feed_slots),
                tuple(np.shape(v) for v in feed_vals),
-               tuple(fetch_slots), tuple(src_slots), tslot)
+               tuple(fetch_slots), tuple(src_slots), tslots_sig,
+               tuple(sorted(ng_slots)), tg_pattern)
         compiled = prog._compiled.get(key)
         if compiled is None:
             compiled = jax.jit(pure)
             prog._compiled[key] = compiled
-        normals, gs = compiled(feed_vals, param_vals)
+        normals, gs = compiled(feed_vals, param_vals, tg_args)
+        grad_by_slot = dict(zip(src_slots, gs))
         out = [None] * n_total
         for (i, _), v in zip(norm_fetches, normals):
             out[i] = v
-        for (i, _), g in zip(grad_fetches, gs):
-            out[i] = g
+        for (i, _), slot in zip(grad_fetches, src_all):
+            out[i] = grad_by_slot[slot]
         return out
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
@@ -555,15 +614,23 @@ class Block:
 
 
 class _GradVar:
-    """Fetchable d(target)/d(source) handle — the X@GRAD var that
+    """Fetchable d(targets)/d(source) handle — the X@GRAD var that
     append_backward/gradients create in the reference (backward.py:1377,
     :1972). Pass it in Executor.run fetch_list; slots resolve against the
-    program being run."""
+    program being run. `targets` is a tuple (multiple targets sum);
+    `target_gradients` optionally seeds each target's cotangent;
+    `no_grad` vars are held constant through the backward."""
 
-    def __init__(self, source, target):
+    def __init__(self, source, target, target_gradients=None, no_grad=()):
         self.source = source
-        self.target = target
+        self.targets = target if isinstance(target, tuple) else (target,)
+        self.target_gradients = target_gradients
+        self.no_grad = tuple(no_grad)
         self.name = f"{source.name}@GRAD"
+
+    @property
+    def target(self):  # back-compat single-target view
+        return self.targets[0]
 
     def __repr__(self):
         return f"_GradVar({self.name})"
@@ -585,18 +652,20 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """d(targets)/d(inputs) as fetchable vars (reference:
-    backward.py gradients:1972). `targets` must reduce to one scalar slot;
-    inputs must be feed placeholders or parameters."""
-    from ..core.enforce import UnimplementedError
-    if target_gradients is not None:
-        raise UnimplementedError(
-            "gradients(target_gradients=...) (custom output cotangents) is "
-            "not supported; the executor seeds with ones over the summed "
-            "target")
-    if no_grad_set:
-        raise UnimplementedError(
-            "gradients(no_grad_set=...) is not supported; grads are taken "
-            "only w.r.t. the explicit `inputs`")
-    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    backward.py gradients:1972). Multiple targets sum; target_gradients
+    seed per-target cotangents (None entries default to ones); inputs may
+    be feeds, parameters, OR intermediate activations; no_grad_set vars
+    are treated as constants."""
+    tgts = tuple(targets) if isinstance(targets, (list, tuple)) else (targets,)
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    return [_GradVar(v, t) for v in ins]
+    if target_gradients is not None:
+        tg = (tuple(target_gradients)
+              if isinstance(target_gradients, (list, tuple))
+              else (target_gradients,))
+        if len(tg) != len(tgts):
+            raise ValueError(
+                f"target_gradients length {len(tg)} != targets {len(tgts)}")
+    else:
+        tg = None
+    ng = tuple(no_grad_set) if no_grad_set else ()
+    return [_GradVar(v, tgts, target_gradients=tg, no_grad=ng) for v in ins]

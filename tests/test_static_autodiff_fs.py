@@ -67,13 +67,71 @@ class TestStaticGradients:
             exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
                     fetch_list=[loss, pairs[0][1]])
 
-    def test_unsupported_gradients_args_raise(self):
-        from paddle_tpu.core.enforce import UnimplementedError
+    def test_intermediate_activation_source(self):
+        """d(loss)/d(out) for an INTERMEDIATE var (reference backward.py
+        gradients:1972 allows any var as input)."""
         prog, x, w, out, loss = self._build()
-        with pytest.raises(UnimplementedError, match="cotangent"):
-            static.gradients(loss, [w], target_gradients=[out])
-        with pytest.raises(UnimplementedError, match="no_grad_set"):
-            static.gradients(loss, [w], no_grad_set=[x])
+        (g_out,) = static.gradients(loss, [out])
+        exe = static.Executor()
+        feed = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+        (gv,) = exe.run(prog, feed={"x": feed}, fetch_list=[g_out])
+        xw = feed @ w.numpy()
+        np.testing.assert_allclose(np.asarray(gv), 2 * xw / xw.size,
+                                   rtol=1e-5)
+
+    def test_target_gradients_seeding(self):
+        """Custom output cotangent: grad of <out, seed> wrt w == x^T seed."""
+        prog, x, w, out, loss = self._build()
+        seed = np.random.RandomState(2).rand(2, 3).astype(np.float32)
+        (gw,) = static.gradients([out], [w], target_gradients=[seed])
+        exe = static.Executor()
+        feed = np.random.RandomState(3).rand(2, 4).astype(np.float32)
+        (gv,) = exe.run(prog, feed={"x": feed}, fetch_list=[gw])
+        np.testing.assert_allclose(np.asarray(gv), feed.T @ seed, rtol=1e-5)
+
+    def test_multiple_targets_sum(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            w = static.create_parameter([4, 3], "float32")
+            out = paddle.matmul(x, w)
+            t1 = paddle.mean(out)
+            t2 = paddle.mean(out * out)
+        (gw_both,) = static.gradients([t1, t2], [w])
+        (gw_1,) = static.gradients([t1], [w])
+        (gw_2,) = static.gradients([t2], [w])
+        exe = static.Executor()
+        feed = np.random.RandomState(4).rand(2, 4).astype(np.float32)
+        (v_both,) = exe.run(prog, feed={"x": feed}, fetch_list=[gw_both])
+        (v_1,) = exe.run(prog, feed={"x": feed}, fetch_list=[gw_1])
+        (v_2,) = exe.run(prog, feed={"x": feed}, fetch_list=[gw_2])
+        np.testing.assert_allclose(np.asarray(v_both),
+                                   np.asarray(v_1) + np.asarray(v_2),
+                                   rtol=1e-5)
+
+    def test_no_grad_set_blocks_path(self):
+        """A var in no_grad_set is a constant: grads through it vanish."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            w = static.create_parameter([4, 4], "float32")
+            h = paddle.matmul(x, w)     # path 1 through w
+            y = paddle.matmul(h, w)     # path 2 through w again
+            loss = paddle.mean(y)
+        (g_blocked,) = static.gradients(loss, [w], no_grad_set=[h])
+        (g_full,) = static.gradients(loss, [w])
+        exe = static.Executor()
+        feed = np.random.RandomState(5).rand(2, 4).astype(np.float32)
+        (vb,) = exe.run(prog, feed={"x": feed}, fetch_list=[g_blocked])
+        (vf,) = exe.run(prog, feed={"x": feed}, fetch_list=[g_full])
+        # blocking h removes the first-matmul contribution: d(mean(h w))/dw
+        # with h constant == h^T ones / n
+        h_v = feed @ w.numpy()
+        n = h_v.shape[0] * w.numpy().shape[1]
+        np.testing.assert_allclose(np.asarray(vb),
+                                   h_v.T @ np.ones((2, 4), np.float32) / n,
+                                   rtol=1e-5)
+        assert not np.allclose(np.asarray(vb), np.asarray(vf))
 
     def test_mixed_targets_rejected(self):
         prog, x, w, out, loss = self._build()
@@ -83,6 +141,8 @@ class TestStaticGradients:
         exe = static.Executor()
         from paddle_tpu.core.enforce import InvalidArgumentError
         with pytest.raises(InvalidArgumentError, match="same target"):
+            # note: multi-target in ONE gradients() call is supported; what
+            # stays rejected is MIXING handles with different target sigs
             exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
                     fetch_list=[g1, g2])
 
@@ -231,3 +291,54 @@ class TestOnnxGate:
         m = nn.Sequential(nn.Linear(4, 2))
         with pytest.raises(UnavailableError, match="jit.save"):
             ponnx.export(m, "/tmp/x")
+
+
+class TestStaticGradientsEdge:
+    """Regressions from review: fresh seeds must not hit a stale jit cache;
+    duplicate sources must both receive real grads."""
+
+    def test_fresh_target_gradients_not_cached(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            w = static.create_parameter([4, 3], "float32")
+            out = paddle.matmul(x, w)
+        feed = np.random.RandomState(6).rand(2, 4).astype(np.float32)
+        exe = static.Executor()
+        sa = np.ones((2, 3), np.float32)
+        sb = np.full((2, 3), 2.0, np.float32)
+        (ga,) = static.gradients([out], [w], target_gradients=[sa])
+        (va,) = exe.run(prog, feed={"x": feed}, fetch_list=[ga])
+        (gb,) = static.gradients([out], [w], target_gradients=[sb])
+        (vb,) = exe.run(prog, feed={"x": feed}, fetch_list=[gb])
+        np.testing.assert_allclose(np.asarray(vb), 2 * np.asarray(va),
+                                   rtol=1e-5)
+
+    def test_duplicate_sources_both_real(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            w = static.create_parameter([4, 3], "float32")
+            out = paddle.matmul(x, w)
+            loss = paddle.mean(out * out)
+        (g1,) = static.gradients(loss, [out])
+        (g2,) = static.gradients(loss, [out])
+        exe = static.Executor()
+        feed = np.random.RandomState(7).rand(2, 4).astype(np.float32)
+        v1, v2 = exe.run(prog, feed={"x": feed}, fetch_list=[g1, g2])
+        assert np.abs(np.asarray(v1)).sum() > 0
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2))
+
+    def test_unrecorded_source_clear_error(self):
+        from paddle_tpu.core.enforce import InvalidArgumentError
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            w = static.create_parameter([4, 3], "float32")
+            loss = paddle.mean(paddle.matmul(x, w))
+        stray = paddle.ones([4])
+        (g,) = static.gradients(loss, [stray])
+        exe = static.Executor()
+        with pytest.raises(InvalidArgumentError, match="never used"):
+            exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[g])
