@@ -8,11 +8,15 @@ replace etcd leases; an etcd-shaped client can be passed instead. Membership
 changes re-rank hosts deterministically (sorted endpoints) and invoke the
 relaunch callback, matching the reference's scale-in/scale-out semantics.
 """
+import json
 import os
+import socket
+import socketserver
 import threading
 import time
 
-__all__ = ["FileKVStore", "ElasticManager", "ElasticStatus"]
+__all__ = ["FileKVStore", "TcpKVStore", "KVServer", "start_kv_server",
+           "ElasticManager", "ElasticStatus"]
 
 
 class ElasticStatus:
@@ -78,6 +82,130 @@ class FileKVStore:
         return out
 
 
+class KVServer(socketserver.ThreadingTCPServer):
+    """Cross-host KV service — the in-framework etcd analog the reference
+    points PADDLE_ELASTIC_ETCD_SERVICE_HOST at (`fleet/elastic.py:118`).
+    JSON-lines protocol over TCP; leases are refresh timestamps, `list`
+    filters by TTL. Run one per job (any host) via start_kv_server()."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr=("0.0.0.0", 0)):
+        self._kv = {}     # key -> value
+        self._t = {}      # key -> last refresh time
+        self._mu = threading.Lock()
+        super().__init__(addr, _KVHandler)
+
+    def handle_req(self, req):
+        op = req.get("op")
+        key = req.get("key")
+        with self._mu:
+            if op == "put":
+                self._kv[key] = req.get("value", "")
+                self._t[key] = time.time()
+                return {"ok": True}
+            if op == "refresh":
+                if key in self._kv:
+                    self._t[key] = time.time()
+                    return {"ok": True}
+                return {"ok": False}
+            if op == "get":
+                return {"ok": True, "value": self._kv.get(key)}
+            if op == "delete":
+                self._kv.pop(key, None)
+                self._t.pop(key, None)
+                return {"ok": True}
+            if op == "list":
+                pre = req.get("prefix", "")
+                ttl = req.get("ttl")
+                now = time.time()
+                out = {k: v for k, v in self._kv.items()
+                       if k.startswith(pre)
+                       and (ttl is None or now - self._t[k] <= ttl)}
+                return {"ok": True, "items": out}
+        return {"ok": False, "error": f"bad op {op!r}"}
+
+
+class _KVHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                resp = self.server.handle_req(json.loads(line))
+            except Exception as e:  # malformed request: answer, keep serving
+                resp = {"ok": False, "error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+def start_kv_server(port=0, host="0.0.0.0"):
+    """Start a KVServer on a daemon thread; returns (server, bound_port)."""
+    srv = KVServer((host, port))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]
+
+
+class TcpKVStore:
+    """Client for KVServer with the FileKVStore interface — membership
+    works across hosts with no shared filesystem."""
+
+    def __init__(self, endpoint):
+        if isinstance(endpoint, str):
+            host, port = endpoint.rsplit(":", 1)
+            endpoint = (host, int(port))
+        self.endpoint = endpoint
+        self._sock = None
+        self._mu = threading.Lock()
+
+    def _call(self, **req):
+        with self._mu:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(self.endpoint,
+                                                          timeout=30)
+                    self._f = self._sock.makefile("rwb")
+                self._f.write((json.dumps(req) + "\n").encode())
+                self._f.flush()
+                line = self._f.readline()
+                if not line:
+                    raise ConnectionError("kv server closed connection")
+                return json.loads(line)
+            except (OSError, ConnectionError):
+                # drop the broken socket so the next call reconnects
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise
+
+    def put(self, key, value):
+        self._call(op="put", key=key, value=value)
+
+    def refresh(self, key):
+        return self._call(op="refresh", key=key)["ok"]
+
+    def get(self, key):
+        return self._call(op="get", key=key)["value"]
+
+    def delete(self, key):
+        self._call(op="delete", key=key)
+
+    def list(self, prefix, ttl=None):
+        return self._call(op="list", prefix=prefix, ttl=ttl)["items"]
+
+    def close(self):
+        with self._mu:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+
 class ElasticManager:
     """Membership + fault watch + re-rank (reference: elastic.py:99).
 
@@ -92,9 +220,16 @@ class ElasticManager:
         self.np = int(np or os.environ.get("PADDLE_ELASTIC_NP", "1"))
         self.job_id = job_id or os.environ.get("PADDLE_ELASTIC_JOB_ID",
                                                "default")
-        root = os.environ.get("PADDLE_ELASTIC_STORE_DIR",
-                              "/tmp/paddle_tpu_elastic")
-        self.store = store or FileKVStore(os.path.join(root, self.job_id))
+        if store is None:
+            # etcd-analog endpoint wins (cross-host); else shared-dir store
+            kv_ep = os.environ.get("PADDLE_ELASTIC_KV_ENDPOINT")
+            if kv_ep:
+                store = TcpKVStore(kv_ep)
+            else:
+                root = os.environ.get("PADDLE_ELASTIC_STORE_DIR",
+                                      "/tmp/paddle_tpu_elastic")
+                store = FileKVStore(os.path.join(root, self.job_id))
+        self.store = store
         self.ttl = ttl
         self.hb_interval = heartbeat_interval
         self._stop = threading.Event()
@@ -110,8 +245,14 @@ class ElasticManager:
 
     def _heartbeat(self):
         while not self._stop.wait(self.hb_interval):
-            if not self.store.refresh(self._key):
-                self.store.put(self._key, self.endpoint)
+            try:
+                if not self.store.refresh(self._key):
+                    self.store.put(self._key, self.endpoint)
+            except (OSError, ConnectionError):
+                # transient KV failure (TcpKVStore raises, FileKVStore
+                # returns False): keep beating — dying here would expire
+                # the lease and split-brain the ranks while we still train
+                continue
 
     def live_nodes(self):
         return sorted(self.store.list("nodes/", ttl=self.ttl).values())

@@ -401,6 +401,9 @@ class Executor:
         # duplicate sources collapse to ONE diff variable (last-wins dict
         # zip would silently zero the earlier handle's grad)
         src_slots = list(dict.fromkeys(src_all))
+        enforce(not (set(src_slots) & ng_slots),
+                "a gradients() source cannot also be in no_grad_set",
+                InvalidArgumentError)
         pos_in_feed = {s: i for i, s in enumerate(feed_slots)}
         pos_in_param = {s: i for i, s in enumerate(param_slots)}
         # intermediate sources: substituted right after their producing op

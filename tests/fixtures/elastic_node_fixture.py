@@ -1,0 +1,76 @@
+"""Elastic trainer node for the fault-injection test (reference flow:
+`fleet/elastic.py` watch:316 — nodes register in the job KV, train with
+auto-checkpoint, and on membership change re-rank + relaunch + resume).
+
+env: ELASTIC_ENDPOINT, PADDLE_ELASTIC_KV_ENDPOINT, PADDLE_ELASTIC_NP,
+PADDLE_AUTO_CHECKPOINT_DIR, PADDLE_JOB_ID, VICTIM_EPOCH (die mid-epoch).
+Prints: RANK r nodes=n | EPOCH e | INTERRUPTED | RESUME_FROM e | DONE
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+from paddle_tpu.incubate.auto_checkpoint import TrainEpochRange
+
+ENDPOINT = os.environ["ELASTIC_ENDPOINT"]
+NP = int(os.environ.get("PADDLE_ELASTIC_NP", "2"))
+VICTIM_EPOCH = int(os.environ.get("VICTIM_EPOCH", "-1"))
+MAX_EPOCH = 10
+
+em = ElasticManager(ENDPOINT, np=NP, ttl=3, heartbeat_interval=0.5)
+em.register()
+assert em.wait_ready(60), "cluster never became whole"
+
+paddle.seed(0)
+model = paddle.nn.Linear(4, 2)
+opt = paddle.optimizer.Adam(parameters=model.parameters())
+
+while True:
+    rank = em.rank()
+    nodes = em.live_nodes()
+    print(f"RANK {rank} nodes={len(nodes)}", flush=True)
+    baseline = list(nodes)
+    tr = TrainEpochRange(MAX_EPOCH, "elastic_demo").add_model(
+        model).add_optimizer(opt)
+    if rank != 0:
+        tr._save = lambda epoch: None  # one writer per job checkpoint
+    interrupted = False
+    first = None
+    for epoch in tr:
+        if first is None:
+            first = epoch
+            print(f"RESUME_FROM {epoch}", flush=True)
+        print(f"EPOCH {epoch}", flush=True)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if VICTIM_EPOCH >= 0 and epoch == VICTIM_EPOCH:
+            os._exit(1)  # fault injection: die mid-epoch, no save
+        time.sleep(0.6)
+        if em.live_nodes() != baseline:
+            print("INTERRUPTED", flush=True)
+            interrupted = True
+            break
+    if not interrupted:
+        print("DONE", flush=True)
+        # completion rendezvous: keep heartbeating until every slot has a
+        # done flag, or the peer would see our exit as a fault
+        em.store.put(f"done/{ENDPOINT}", "1")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(em.store.list("done/")) >= NP:
+                break
+            time.sleep(0.2)
+        break
+    # hold until the scheduler brings the cluster back to np, then
+    # re-rank and resume from the auto-checkpoint (relaunch-in-place)
+    assert em.wait_ready(60), "replacement never arrived"
+
+em.exit()
+sys.exit(0)

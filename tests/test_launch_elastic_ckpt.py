@@ -6,6 +6,7 @@ auto_checkpoint tests (epoch-resume).
 """
 import json
 import os
+import re
 import subprocess
 import sys
 import textwrap
@@ -162,3 +163,84 @@ def test_local_fs(tmp_path):
     assert not fs.is_exist(f) and fs.is_file(os.path.join(d, "y.txt"))
     fs.delete(d)
     assert not fs.is_exist(d)
+
+
+class TestElasticFaultInjection:
+    """Kill a worker mid-epoch; assert the survivor detects the fault,
+    the replacement re-ranks in, and training resumes from the
+    auto-checkpoint — the reference's etcd watch/re-rank/relaunch cycle
+    (elastic.py:99,316) against the in-framework TCP KV service."""
+
+    def _spawn_node(self, endpoint, kv_port, ckpt_dir, victim_epoch=-1):
+        env = dict(os.environ)
+        env.update({
+            "ELASTIC_ENDPOINT": endpoint,
+            "PADDLE_ELASTIC_KV_ENDPOINT": f"127.0.0.1:{kv_port}",
+            "PADDLE_ELASTIC_NP": "2",
+            "PADDLE_AUTO_CHECKPOINT_DIR": ckpt_dir,
+            "PADDLE_JOB_ID": "elastic_fault_job",
+            "VICTIM_EPOCH": str(victim_epoch),
+            "JAX_PLATFORMS": "cpu",
+        })
+        fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                               "elastic_node_fixture.py")
+        script = ("import jax; jax.config.update('jax_platforms','cpu');"
+                  "import runpy; runpy.run_path(%r, run_name='__main__')"
+                  % fixture)
+        return subprocess.Popen([sys.executable, "-c", script],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env,
+                                cwd=os.path.dirname(os.path.dirname(
+                                    os.path.abspath(__file__))))
+
+    def test_kill_worker_rerank_relaunch_resume(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import start_kv_server
+        srv, kv_port = start_kv_server(host="127.0.0.1")
+        try:
+            ckpt = str(tmp_path / "ckpt")
+            os.makedirs(ckpt, exist_ok=True)
+            # endpoints sort: survivor keeps rank 0 after the re-rank
+            n0 = self._spawn_node("127.0.0.1:20001", kv_port, ckpt)
+            n1 = self._spawn_node("127.0.0.1:20002", kv_port, ckpt,
+                                  victim_epoch=2)
+            # victim dies mid-epoch 2
+            assert n1.wait(timeout=120) == 1
+            # the "scheduler" waits for the dead node's lease to expire
+            # (the survivor must observe the membership SHRINK first)
+            from paddle_tpu.distributed.fleet.elastic import TcpKVStore
+            import time as _time
+            mon = TcpKVStore(f"127.0.0.1:{kv_port}")
+            deadline = _time.time() + 30
+            while _time.time() < deadline:
+                if len(mon.list("nodes/", ttl=3)) <= 1:
+                    break
+                _time.sleep(0.2)
+            mon.close()
+            # scheduler relaunches a replacement node
+            n2 = self._spawn_node("127.0.0.1:20003", kv_port, ckpt)
+            out0, err0 = n0.communicate(timeout=180)
+            out2, err2 = n2.communicate(timeout=180)
+            assert n0.returncode == 0, err0[-2000:]
+            assert n2.returncode == 0, err2[-2000:]
+
+            out1 = n1.stdout.read()
+            # victim trained epochs 0..2 as rank 1, then died (no DONE)
+            assert "RANK 1 nodes=2" in out1 and "DONE" not in out1
+
+            # survivor: detected the fault, re-ranked (still rank 0 by
+            # sorted endpoints), resumed from checkpoint — NOT epoch 0
+            assert "INTERRUPTED" in out0, out0
+            resumes = re.findall(r"RESUME_FROM (\d+)", out0)
+            assert resumes[0] == "0"
+            assert int(resumes[1]) >= 1  # checkpoint resume, not restart
+            assert out0.count("RANK 0") >= 2  # re-ranked after the fault
+            assert "DONE" in out0
+
+            # replacement: joined as rank 1, resumed from the job
+            # checkpoint rather than epoch 0
+            assert "RANK 1 nodes=2" in out2, out2
+            m = re.search(r"RESUME_FROM (\d+)", out2)
+            assert m and int(m.group(1)) >= 1, out2
+            assert "DONE" in out2
+        finally:
+            srv.shutdown()
