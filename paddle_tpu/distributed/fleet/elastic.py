@@ -174,8 +174,9 @@ class TcpKVStore:
                 if not line:
                     raise ConnectionError("kv server closed connection")
                 return json.loads(line)
-            except (OSError, ConnectionError):
-                # drop the broken socket so the next call reconnects
+            except (OSError, ConnectionError, ValueError):
+                # ValueError covers a truncated/garbage JSON reply from a
+                # dying server; drop the socket so the next call reconnects
                 if self._sock is not None:
                     try:
                         self._sock.close()
@@ -234,7 +235,10 @@ class ElasticManager:
         self.hb_interval = heartbeat_interval
         self._stop = threading.Event()
         self._hb_thread = None
-        self._key = f"nodes/{self.endpoint}"
+        # job-scoped keys: one KV endpoint may serve many jobs (the
+        # FileKVStore gets the same scoping from its per-job directory)
+        self._prefix = f"{self.job_id}/nodes/"
+        self._key = self._prefix + self.endpoint
 
     # -- membership ---------------------------------------------------------
     def register(self):
@@ -248,14 +252,14 @@ class ElasticManager:
             try:
                 if not self.store.refresh(self._key):
                     self.store.put(self._key, self.endpoint)
-            except (OSError, ConnectionError):
+            except (OSError, ConnectionError, ValueError):
                 # transient KV failure (TcpKVStore raises, FileKVStore
                 # returns False): keep beating — dying here would expire
                 # the lease and split-brain the ranks while we still train
                 continue
 
     def live_nodes(self):
-        return sorted(self.store.list("nodes/", ttl=self.ttl).values())
+        return sorted(self.store.list(self._prefix, ttl=self.ttl).values())
 
     def rank(self):
         """Deterministic re-rank: position in the sorted live endpoints."""

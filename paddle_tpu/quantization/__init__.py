@@ -23,7 +23,8 @@ from ..nn import functional as F
 
 __all__ = [
     "fake_quant", "QuantizedLinear", "QuantizedConv2D",
-    "ImperativeQuantAware", "PTQ", "quant_post_static",
+    "QuantizedEmbedding", "ImperativeQuantAware", "PTQ",
+    "quant_post_static", "load_quant_scales",
 ]
 
 
@@ -60,8 +61,9 @@ def fake_quant(x, scale, bits=8, op_name="fake_quantize"):
     return call_op(f, x, op_name=op_name)
 
 
-def _absmax(x):
-    return jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+def _absmax(x, axis=None, keepdims=False):
+    return jnp.maximum(jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims),
+                       1e-8)
 
 
 class _QuantLayerMixin:
@@ -69,13 +71,20 @@ class _QuantLayerMixin:
     (reference: imperative/qat.py QuantizedLinear/QuantizedConv2D wrappers +
     moving_average_abs_max_scale op)."""
 
-    def _init_quant(self, weight_bits, activation_bits=None, momentum=0.9):
+    def _init_quant(self, weight_bits, activation_bits=None, momentum=0.9,
+                    channel_wise=False):
         self._qbits = weight_bits
         self._qabits = activation_bits if activation_bits is not None \
             else weight_bits
         self._qmomentum = momentum
+        self._channel_wise = channel_wise
         self._act_scale = 1.0
         self._act_scale_initialized = False
+        # output-scale observer (reference: ImperativeCalcOutputScale /
+        # moving_average_abs_max_scale on layer outputs — the
+        # out_threshold attr serving backends read)
+        self._out_scale = 1.0
+        self._out_scale_initialized = False
         self._frozen = False
         # per-instance calibration hook (PTQ percentile observer); instance
         # state, never a class-wide patch, so concurrent models can't
@@ -97,8 +106,40 @@ class _QuantLayerMixin:
                           op_name="fake_quant_act")
 
     def _quant_weight(self, w):
-        scale = float(np.asarray(jax.device_get(_absmax(unwrap(w)))))
-        return fake_quant(w, scale, self._qbits, op_name="fake_quant_weight")
+        # scales stay IN-GRAPH (jnp): weight quantization must trace
+        # through jit.save / to_static (a host float() here would fail on
+        # traced weights at export time)
+        wv = unwrap(w)
+        if self._channel_wise:
+            # channel_wise_abs_max (reference fake_quantize_op.cc): one
+            # scale per output channel, broadcast against the weight
+            axes, shape = self._channel_axes(tuple(w.shape))
+            sv = jnp.reshape(_absmax(wv, axis=axes, keepdims=True), shape)
+            return fake_quant(w, sv, self._qbits,
+                              op_name="fake_quant_weight_channel")
+        return fake_quant(w, _absmax(wv), self._qbits,
+                          op_name="fake_quant_weight")
+
+    def _observe_out(self, y):
+        # the moving average stays a LAZY jnp scalar (no host sync on the
+        # training hot path); quant_scales() materializes it once at save
+        if not self._frozen and not isinstance(unwrap(y), jax.core.Tracer):
+            cur = _absmax(unwrap(y))
+            if not self._out_scale_initialized:
+                self._out_scale = cur
+                self._out_scale_initialized = True
+            else:
+                m = self._qmomentum
+                self._out_scale = m * jnp.asarray(self._out_scale) \
+                    + (1 - m) * cur
+        return y
+
+    def quant_scales(self):
+        """Exported calibration record (act/out thresholds + config)."""
+        return {"act_scale": float(np.asarray(self._act_scale)),
+                "out_scale": float(np.asarray(self._out_scale)),
+                "weight_bits": self._qbits, "activation_bits": self._qabits,
+                "channel_wise": self._channel_wise}
 
     def freeze(self):
         """Stop updating activation scales (calibration done)."""
@@ -106,33 +147,72 @@ class _QuantLayerMixin:
 
 
 class QuantizedLinear(Layer, _QuantLayerMixin):
-    def __init__(self, layer, bits=8, activation_bits=None):
+    def __init__(self, layer, bits=8, activation_bits=None,
+                 channel_wise=False):
         super().__init__()
         self.weight = layer.weight
         self.bias = layer.bias
-        self._init_quant(bits, activation_bits)
+        self._init_quant(bits, activation_bits, channel_wise=channel_wise)
+
+    @staticmethod
+    def _channel_axes(wshape):
+        # weight [in, out]: per-output-column scales
+        return (0,), (1, wshape[1])
 
     def forward(self, x):
-        return F.linear(self._quant_act(x), self._quant_weight(self.weight),
-                        self.bias)
+        y = F.linear(self._quant_act(x), self._quant_weight(self.weight),
+                     self.bias)
+        return self._observe_out(y)
 
 
 class QuantizedConv2D(Layer, _QuantLayerMixin):
-    def __init__(self, layer, bits=8, activation_bits=None):
+    def __init__(self, layer, bits=8, activation_bits=None,
+                 channel_wise=False):
         super().__init__()
         self.weight = layer.weight
         self.bias = layer.bias
         self._inner = dict(stride=layer._stride, padding=layer._padding,
                            dilation=layer._dilation, groups=layer._groups,
                            data_format=layer._data_format)
-        self._init_quant(bits, activation_bits)
+        self._init_quant(bits, activation_bits, channel_wise=channel_wise)
+
+    @staticmethod
+    def _channel_axes(wshape):
+        # weight [out_c, in_c, kh, kw]: per-out-channel scales
+        return (1, 2, 3), (wshape[0], 1, 1, 1)
 
     def forward(self, x):
-        return F.conv2d(self._quant_act(x), self._quant_weight(self.weight),
-                        self.bias, **self._inner)
+        y = F.conv2d(self._quant_act(x), self._quant_weight(self.weight),
+                     self.bias, **self._inner)
+        return self._observe_out(y)
 
 
-_QUANTIZABLE = {Linear: QuantizedLinear, Conv2D: QuantizedConv2D}
+class QuantizedEmbedding(Layer, _QuantLayerMixin):
+    """Embedding-table quantization (reference: slim quant_embedding pass —
+    abs_max int8 table; ids are not activation-quantized)."""
+
+    def __init__(self, layer, bits=8, activation_bits=None,
+                 channel_wise=False):
+        super().__init__()
+        self.weight = layer.weight
+        self._padding_idx = getattr(layer, "_padding_idx", None)
+        self._init_quant(bits, activation_bits, channel_wise=channel_wise)
+
+    @staticmethod
+    def _channel_axes(wshape):
+        # table [vocab, dim]: per-row scales
+        return (1,), (wshape[0], 1)
+
+    def forward(self, ids):
+        y = F.embedding(ids, self._quant_weight(self.weight),
+                        padding_idx=self._padding_idx)
+        return self._observe_out(y)
+
+
+from ..nn.layer.common import Embedding as _Embedding  # noqa: E402
+
+_QUANTIZABLE = {Linear: QuantizedLinear, Conv2D: QuantizedConv2D,
+                _Embedding: QuantizedEmbedding}
 
 
 class ImperativeQuantAware:
@@ -141,9 +221,15 @@ class ImperativeQuantAware:
     in place)."""
 
     def __init__(self, weight_bits=8, activation_bits=8,
-                 quantizable_layer_type=("Linear", "Conv2D"), **kw):
+                 quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_quantize_type="abs_max", **kw):
         self._bits = weight_bits
         self._abits = activation_bits
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                f"unsupported weight_quantize_type {weight_quantize_type!r}:"
+                " expected 'abs_max' or 'channel_wise_abs_max'")
+        self._channel_wise = weight_quantize_type == "channel_wise_abs_max"
         self._types = tuple(
             cls for cls in _QUANTIZABLE
             if cls.__name__ in quantizable_layer_type)
@@ -158,17 +244,36 @@ class ImperativeQuantAware:
                 continue
             if isinstance(sub, self._types):
                 layer._sub_layers[name] = _QUANTIZABLE[type(sub)](
-                    sub, self._bits, self._abits)
+                    sub, self._bits, self._abits,
+                    channel_wise=self._channel_wise)
             else:
                 self._swap(sub)
 
     @staticmethod
     def save_quantized_model(model, path, input_spec=None):
+        """Freeze scales, export the servable artifact (StableHLO
+        .pdmodel via jit.save) plus a `<path>.quant.json` sidecar with
+        every layer's calibration record (the out_threshold/act-scale
+        attrs the reference embeds in the quantized program)."""
+        import json
+
         from .. import jit
-        for sub in model.sublayers(include_self=True):
+        scales = {}
+        for name, sub in model.named_sublayers(include_self=True):
             if isinstance(sub, _QuantLayerMixin):
                 sub.freeze()
-        return jit.save(model, path, input_spec=input_spec)
+                scales[name or "<root>"] = sub.quant_scales()
+        out = jit.save(model, path, input_spec=input_spec)
+        with open(path + ".quant.json", "w") as f:
+            json.dump(scales, f, indent=1)
+        return out
+
+
+def load_quant_scales(path):
+    """Read the calibration sidecar saved next to a quantized artifact."""
+    import json
+    with open(path + ".quant.json") as f:
+        return json.load(f)
 
 
 class PTQ:

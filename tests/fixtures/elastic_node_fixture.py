@@ -61,10 +61,10 @@ while True:
         print("DONE", flush=True)
         # completion rendezvous: keep heartbeating until every slot has a
         # done flag, or the peer would see our exit as a fault
-        em.store.put(f"done/{ENDPOINT}", "1")
+        em.store.put(f"{em.job_id}/done/{ENDPOINT}", "1")
         deadline = time.time() + 60
         while time.time() < deadline:
-            if len(em.store.list("done/")) >= NP:
+            if len(em.store.list(f"{em.job_id}/done/")) >= NP:
                 break
             time.sleep(0.2)
         break

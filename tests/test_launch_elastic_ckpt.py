@@ -116,7 +116,7 @@ def test_elastic_ttl_expiry(tmp_path):
     store = FileKVStore(str(tmp_path / "kv"))
     m = ElasticManager("host-x:1", np=1, store=store, ttl=1,
                        heartbeat_interval=10)  # heartbeat slower than ttl
-    store.put("nodes/host-x:1", "host-x:1")
+    store.put(f"{m.job_id}/nodes/host-x:1", "host-x:1")
     assert m.live_nodes() == ["host-x:1"]
     time.sleep(1.2)
     assert m.live_nodes() == []  # stale entry aged out
@@ -203,8 +203,10 @@ class TestElasticFaultInjection:
             n0 = self._spawn_node("127.0.0.1:20001", kv_port, ckpt)
             n1 = self._spawn_node("127.0.0.1:20002", kv_port, ckpt,
                                   victim_epoch=2)
-            # victim dies mid-epoch 2
-            assert n1.wait(timeout=120) == 1
+            # victim dies mid-epoch 2 (communicate drains both pipes —
+            # a full stderr buffer must not deadlock the child)
+            out1, _err1 = n1.communicate(timeout=120)
+            assert n1.returncode == 1
             # the "scheduler" waits for the dead node's lease to expire
             # (the survivor must observe the membership SHRINK first)
             from paddle_tpu.distributed.fleet.elastic import TcpKVStore
@@ -212,7 +214,7 @@ class TestElasticFaultInjection:
             mon = TcpKVStore(f"127.0.0.1:{kv_port}")
             deadline = _time.time() + 30
             while _time.time() < deadline:
-                if len(mon.list("nodes/", ttl=3)) <= 1:
+                if len(mon.list("elastic_fault_job/nodes/", ttl=3)) <= 1:
                     break
                 _time.sleep(0.2)
             mon.close()
@@ -223,7 +225,6 @@ class TestElasticFaultInjection:
             assert n0.returncode == 0, err0[-2000:]
             assert n2.returncode == 0, err2[-2000:]
 
-            out1 = n1.stdout.read()
             # victim trained epochs 0..2 as rank 1, then died (no DONE)
             assert "RANK 1 nodes=2" in out1 and "DONE" not in out1
 

@@ -4,6 +4,8 @@ Mirrors reference tests: slim/tests/test_imperative_qat.py,
 test_post_training_quantization_*.py, asp/test_asp_pruning_1d.py,
 asp/test_asp_optimize.py.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -173,3 +175,107 @@ def test_asp_decorated_optimizer_keeps_masks():
     # the pruned slots stay zero through optimizer updates
     assert (w[zero_positions] == 0).all()
     assert sparsity.check_mask_1d(w, 2, 4)
+
+
+def test_channel_wise_weight_scales_beat_per_tensor():
+    """channel_wise_abs_max: per-output-channel scales quantize a weight
+    with wildly different column magnitudes far better than one tensor
+    scale (reference: fake_quantize_op.cc FakeChannelWiseQuantizeAbsMax)."""
+    from paddle_tpu.quantization import ImperativeQuantAware
+
+    rng_l = np.random.RandomState(0)
+    w = rng_l.randn(8, 4).astype(np.float32)
+    w[:, 0] *= 100.0  # one loud column drowns the per-tensor scale
+    x = rng_l.rand(5, 8).astype(np.float32)
+
+    def build(channel):
+        m = paddle.nn.Linear(8, 4)
+        m.weight.set_value(w)
+        m.bias.set_value(np.zeros(4, np.float32))
+        qt = "channel_wise_abs_max" if channel else "abs_max"
+        ImperativeQuantAware(weight_quantize_type=qt).quantize(
+            nn_wrap := paddle.nn.Sequential(m))
+        return nn_wrap
+
+    ref = x @ w
+    err_t = np.abs(np.asarray(build(False)(paddle.to_tensor(x)).numpy())
+                   - ref)[:, 1:].mean()
+    err_c = np.abs(np.asarray(build(True)(paddle.to_tensor(x)).numpy())
+                   - ref)[:, 1:].mean()
+    assert err_c < err_t / 4
+
+
+def test_quantized_embedding_swap_and_forward():
+    from paddle_tpu.quantization import ImperativeQuantAware, \
+        QuantizedEmbedding
+
+    m = paddle.nn.Sequential(paddle.nn.Embedding(16, 8))
+    ImperativeQuantAware(
+        quantizable_layer_type=("Embedding",)).quantize(m)
+    assert isinstance(m[0], QuantizedEmbedding)
+    ids = paddle.to_tensor(np.array([1, 5, 9], np.int64))
+    out = m(ids)
+    assert out.shape == [3, 8]
+
+
+def test_output_scales_and_sidecar(tmp_path):
+    from paddle_tpu.quantization import (ImperativeQuantAware,
+                                         load_quant_scales)
+    from paddle_tpu.jit.to_static import InputSpec
+
+    model = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                                 paddle.nn.Linear(8, 2))
+    q = ImperativeQuantAware()
+    q.quantize(model)
+    for _ in range(3):
+        model(paddle.to_tensor(np.random.RandomState(1)
+                               .rand(2, 4).astype(np.float32)))
+    prefix = str(tmp_path / "qmodel")
+    q.save_quantized_model(model, prefix,
+                           input_spec=[InputSpec([None, 4], "float32")])
+    scales = load_quant_scales(prefix)
+    assert len(scales) == 2  # two quantized Linears
+    for rec in scales.values():
+        assert rec["act_scale"] > 0 and rec["out_scale"] > 0
+        assert rec["weight_bits"] == 8
+
+
+def test_ptq_resnet_serving_accuracy_delta(tmp_path):
+    """The VERDICT bar: PTQ a ResNet, serve the saved artifact through
+    the Predictor in-process, assert the quantized predictions track the
+    float model (top-1 agreement)."""
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.jit.to_static import InputSpec
+    from paddle_tpu.quantization import PTQ, ImperativeQuantAware
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(7)
+    rng_l = np.random.RandomState(3)
+    imgs = rng_l.rand(8, 3, 32, 32).astype(np.float32)
+
+    float_model = resnet18(num_classes=10)
+    float_model.eval()
+    float_logits = np.asarray(
+        float_model(paddle.to_tensor(imgs)).numpy())
+
+    calib = [(paddle.to_tensor(imgs[i:i + 2]),) for i in range(0, 8, 2)]
+    qmodel = PTQ(algo="abs_max").quantize(float_model, calib)
+    prefix = str(tmp_path / "resnet_q")
+    ImperativeQuantAware.save_quantized_model(
+        qmodel, prefix,
+        input_spec=[InputSpec([None, 3, 32, 32], "float32")])
+    assert os.path.exists(prefix + ".quant.json")
+
+    pred = create_predictor(Config(prefix + ".pdmodel",
+                                   prefix + ".pdiparams"))
+    name = pred.get_input_names()[0]
+    pred.get_input_handle(name).copy_from_cpu(imgs)
+    pred.run()
+    served = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+
+    agree = (served.argmax(-1) == float_logits.argmax(-1)).mean()
+    assert agree >= 0.75, agree
+    # logits deviation bounded (8-bit fake-quant on a float backbone)
+    rel = np.abs(served - float_logits).mean() / (
+        np.abs(float_logits).mean() + 1e-6)
+    assert rel < 0.5, rel
