@@ -24,6 +24,7 @@ from .core.dtype import (  # noqa: F401
 
 # ops (also patches Tensor methods)
 from . import ops  # noqa: F401
+from . import onnx  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from . import linalg  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401

@@ -1,29 +1,82 @@
 """ONNX export (reference: `python/paddle/onnx/export.py` — delegates to
-paddle2onnx).
+paddle2onnx over a ProgramDesc).
 
-TPU build: the portable serving artifact is StableHLO (`paddle.jit.save`
-with input_spec → .pdmodel, see jit/export.py), which XLA-based runtimes
-consume directly. ONNX interchange additionally requires the `onnx` package
-(not part of this environment's baked dependency set); when it is available
-the exporter maps the traced program onto ONNX ops, otherwise it raises
-with the working alternative spelled out.
+TPU-native design: the layer's forward is traced to a JAXPR (the exact
+primitive program XLA compiles) and mapped primitive-by-primitive onto
+ONNX ops (`_export.py`); the file is serialized with a self-contained
+protobuf wire-format writer (`_proto.py`), so no `onnx` package is
+required to produce standard .onnx artifacts. StableHLO via
+`paddle.jit.save` remains the native serving format.
 """
+import numpy as np
 
-__all__ = ["export"]
+__all__ = ["export", "read_model"]
+
+from ._proto import read_model  # noqa: F401,E402  (verification reader)
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """paddle.onnx.export API shape."""
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        from ..core.enforce import UnavailableError
-        raise UnavailableError(
-            "onnx is not installed in this environment. For a portable, "
-            "class-free serving artifact use paddle.jit.save(layer, path, "
-            "input_spec=[...]) — it exports a StableHLO .pdmodel that "
-            "paddle_tpu.inference.Predictor (and any XLA runtime) serves "
-            "in a fresh process; install `onnx` to enable ONNX interchange.")
-    raise NotImplementedError(
-        "onnx runtime detected but the op mapping is not implemented in "
-        "this snapshot; use paddle.jit.save (StableHLO) for serving")
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """paddle.onnx.export API shape: writes `<path>.onnx`, returns it.
+
+    Shapes are exported FIXED at the traced sizes (None dims trace as 1):
+    broadcast/reshape shape constants from the trace are baked into the
+    graph, so advertising a symbolic batch dim would be a contract the
+    nodes cannot honor. Re-export per batch size, or serve the StableHLO
+    artifact (paddle.jit.save), which is batch-polymorphic."""
+    import jax
+
+    if opset_version < 13:
+        raise ValueError(
+            f"opset_version {opset_version} < 13: the emitted op "
+            "signatures (Squeeze/Slice/Reduce* with axes inputs) are "
+            "opset-13 forms")
+
+    from . import _export as E
+    from . import _proto as P
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+    from ..jit.to_static import InputSpec
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+    specs = []
+    for i, s in enumerate(input_spec):
+        if isinstance(s, InputSpec):
+            specs.append((s.name or f"x{i}", list(s.shape), s.dtype))
+        else:  # a template Tensor
+            specs.append((f"x{i}", list(s.shape), str(s.dtype)))
+
+    fwd = layer.forward if hasattr(layer, "forward") else layer
+
+    def fn(*vals):
+        outs = fwd(*[Tensor(v) for v in vals])
+        flat = outs if isinstance(outs, (tuple, list)) else [outs]
+        return tuple(unwrap(o) for o in flat)
+
+    templates = [
+        jax.numpy.zeros([1 if d in (None, -1) else d for d in shape],
+                        dtype) for _, shape, dtype in specs]
+    closed = jax.make_jaxpr(fn)(*templates)
+
+    in_names = [name for name, _, _ in specs]
+    g, out_names = E.convert_jaxpr(closed, in_names,
+                                   [np.asarray(c) for c in closed.consts])
+
+    inputs = [P.value_info(name,
+                           E._DTYPE[np.dtype(dtype)],
+                           [1 if d in (None, -1) else d for d in shape])
+              for name, shape, dtype in specs]
+    outputs = []
+    for name, var in zip(out_names, closed.jaxpr.outvars):
+        aval = var.aval
+        outputs.append(P.value_info(name, E._DTYPE[np.dtype(aval.dtype)],
+                                    list(aval.shape)))
+    g.prune(out_names)
+    nodes, inits = g.serialize()
+    graph = P.graph_proto(nodes, "paddle_tpu_graph", inits,
+                          inputs, outputs)
+    model = P.model_proto(graph, opset=opset_version)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path

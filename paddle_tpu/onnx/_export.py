@@ -1,0 +1,343 @@
+"""jaxpr → ONNX graph conversion.
+
+The reference exporter walks a ProgramDesc and maps fluid ops onto ONNX
+(paddle2onnx, driven by `python/paddle/onnx/export.py`). The TPU-native
+analog walks the JAXPR of the layer's forward — the exact primitive-level
+program XLA would compile — and maps lax primitives onto ONNX ops.
+Call-like primitives (pjit, custom_jvp/vjp, remat) are inlined. An
+unsupported primitive raises with its name so coverage gaps are loud.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import _proto as P
+
+_DTYPE = {
+    np.dtype(np.float32): P.FLOAT, np.dtype(np.float64): P.DOUBLE,
+    np.dtype(np.int32): P.INT32, np.dtype(np.int64): P.INT64,
+    np.dtype(np.bool_): P.BOOL, np.dtype(np.float16): P.FLOAT16,
+    np.dtype(np.int8): P.INT8, np.dtype(np.uint8): P.UINT8,
+}
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "pow": "Pow",
+    "max": "Max", "min": "Min", "neg": "Neg", "exp": "Exp", "log": "Log",
+    "tanh": "Tanh", "logistic": "Sigmoid", "sqrt": "Sqrt", "abs": "Abs",
+    "erf": "Erf", "floor": "Floor", "ceil": "Ceil", "sign": "Sign",
+    "sin": "Sin", "cos": "Cos", "rem": "Mod",
+}
+
+_COMPARE = {"gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
+            "le": "LessOrEqual", "eq": "Equal", "ne": "Equal"}
+
+_REDUCE = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+           "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}
+
+
+class _Graph:
+    def __init__(self):
+        self.nodes = []         # (op_type, inputs, outputs, attrs)
+        self.initializers = {}  # name -> (dims, data_type, raw)
+        self._n = 0
+        self.names = {}  # jaxpr var -> onnx name
+
+    def fresh(self, hint="t"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def add_node(self, op, inputs, outputs, attrs=()):
+        self.nodes.append((op, list(inputs), list(outputs), list(attrs)))
+
+    def const(self, arr, hint="const"):
+        arr = np.asarray(arr)
+        name = self.fresh(hint)
+        self.initializers[name] = (arr.shape, _DTYPE[arr.dtype],
+                                   arr.tobytes())
+        return name
+
+    def name_of(self, var):
+        if hasattr(var, "val"):  # Literal
+            return self.const(np.asarray(var.val), "lit")
+        return self.names[var]
+
+    def prune(self, output_names):
+        """Drop nodes/initializers not reachable from the outputs —
+        inlined custom_jvp/vjp branches leave dead subgraphs behind."""
+        needed = set(output_names)
+        kept = []
+        for op, ins, outs, attrs in reversed(self.nodes):
+            if any(o in needed for o in outs):
+                kept.append((op, ins, outs, attrs))
+                needed.update(ins)
+        self.nodes = list(reversed(kept))
+        self.initializers = {k: v for k, v in self.initializers.items()
+                             if k in needed}
+
+    def serialize(self):
+        nodes = [P.node_proto(op, ins, outs, name=f"n{i}", attrs=attrs)
+                 for i, (op, ins, outs, attrs) in enumerate(self.nodes)]
+        inits = [P.tensor_proto(name, dims, dt, raw)
+                 for name, (dims, dt, raw) in self.initializers.items()]
+        return nodes, inits
+
+
+class UnsupportedPrimitive(NotImplementedError):
+    pass
+
+
+def _ints(name, vals):
+    return P.attr_ints(name, vals)
+
+
+def convert_jaxpr(closed, input_names, weights):
+    """closed: ClosedJaxpr of fn(*inputs); weights: list of np arrays for
+    closed.consts. Returns (_Graph, output_names)."""
+    g = _Graph()
+    jaxpr = closed.jaxpr
+    for var, name in zip(jaxpr.invars, input_names):
+        g.names[var] = name
+    for var, w in zip(jaxpr.constvars, weights):
+        g.names[var] = g.const(np.asarray(w), "w")
+    _convert_eqns(g, jaxpr.eqns)
+    outs = [g.name_of(v) for v in jaxpr.outvars]
+    return g, outs
+
+
+def _inline(g, sub_jaxpr, invals, eqn_outvars, consts=()):
+    for var, name in zip(sub_jaxpr.invars, invals):
+        g.names[var] = name
+    for var, c in zip(sub_jaxpr.constvars, consts):
+        g.names[var] = g.const(np.asarray(c), "w")
+    _convert_eqns(g, sub_jaxpr.eqns)
+    for outer, inner in zip(eqn_outvars, sub_jaxpr.outvars):
+        g.names[outer] = g.name_of(inner)
+
+
+def _convert_eqns(g, eqns):
+    for eqn in eqns:
+        _convert_eqn(g, eqn)
+
+
+def _convert_eqn(g, eqn):  # noqa: C901 — one dispatch table, kept flat
+    prim = eqn.primitive.name
+    ins = [g.name_of(v) for v in eqn.invars]
+    outs = [g.fresh(prim) for _ in eqn.outvars]
+
+    def bind_outs():
+        for var, name in zip(eqn.outvars, outs):
+            g.names[var] = name
+
+    # ---- call-like: inline ------------------------------------------------
+    if prim in ("pjit", "jit", "closed_call", "core_call", "remat",
+            "checkpoint"):
+        sub = eqn.params.get("jaxpr")
+        _inline(g, sub.jaxpr, ins, eqn.outvars, sub.consts)
+        return
+    if prim in ("custom_jvp_call", "custom_vjp_call",
+                "custom_vjp_call_jaxpr", "custom_jvp_call_jaxpr"):
+        sub = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+        if hasattr(sub, "jaxpr"):
+            _inline(g, sub.jaxpr, ins, eqn.outvars, sub.consts)
+        else:
+            _inline(g, sub, ins, eqn.outvars)
+        return
+
+    # ---- structure --------------------------------------------------------
+    if prim == "reshape":
+        shape = g.const(np.asarray(eqn.params["new_sizes"], np.int64),
+                        "shape")
+        g.add_node("Reshape", [ins[0], shape], outs)
+        return bind_outs()
+    if prim == "transpose":
+        g.add_node("Transpose", ins, outs,
+                   attrs=[_ints("perm", eqn.params["permutation"])])
+        return bind_outs()
+    if prim == "broadcast_in_dim":
+        out_shape = eqn.params["shape"]
+        bdims = eqn.params["broadcast_dimensions"]
+        in_aval = eqn.invars[0].aval
+        aligned = [1] * len(out_shape)
+        for src, dst in enumerate(bdims):
+            aligned[dst] = in_aval.shape[src]
+        mid = ins[0]
+        if tuple(aligned) != tuple(in_aval.shape):
+            shape_c = g.const(np.asarray(aligned, np.int64), "shape")
+            mid2 = g.fresh("reshape")
+            g.add_node("Reshape", [mid, shape_c], [mid2])
+            mid = mid2
+        target = g.const(np.asarray(out_shape, np.int64), "shape")
+        g.add_node("Expand", [mid, target], outs)
+        return bind_outs()
+    if prim == "squeeze":
+        axes = g.const(np.asarray(eqn.params["dimensions"], np.int64),
+                       "axes")
+        g.add_node("Squeeze", [ins[0], axes], outs)
+        return bind_outs()
+    if prim == "concatenate":
+        g.add_node("Concat", ins, outs,
+                   attrs=[P.attr_i("axis", eqn.params["dimension"])])
+        return bind_outs()
+    if prim == "slice":
+        starts = g.const(np.asarray(eqn.params["start_indices"], np.int64),
+                         "starts")
+        ends = g.const(np.asarray(eqn.params["limit_indices"], np.int64),
+                       "ends")
+        axes = g.const(np.arange(len(eqn.params["start_indices"]),
+                                 dtype=np.int64), "axes")
+        strides = eqn.params.get("strides")
+        extra = []
+        if strides is not None:
+            extra = [g.const(np.asarray(strides, np.int64), "steps")]
+        g.add_node("Slice", [ins[0], starts, ends, axes] + extra, outs)
+        return bind_outs()
+    if prim == "pad":
+        cfg = eqn.params["padding_config"]
+        if any(i != 0 for _, _, i in cfg):
+            raise UnsupportedPrimitive("pad with interior padding")
+        pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+        pads_c = g.const(np.asarray(pads, np.int64), "pads")
+        g.add_node("Pad", [ins[0], pads_c, ins[1]], outs)
+        return bind_outs()
+    if prim == "convert_element_type":
+        to = _DTYPE[np.dtype(eqn.params["new_dtype"])]
+        g.add_node("Cast", ins, outs, attrs=[P.attr_i("to", to)])
+        return bind_outs()
+    if prim == "iota":
+        n = eqn.outvars[0].aval.shape[eqn.params["dimension"]]
+        val = np.arange(n, dtype=eqn.params["dtype"])
+        shape = [1] * len(eqn.outvars[0].aval.shape)
+        shape[eqn.params["dimension"]] = n
+        g.names[eqn.outvars[0]] = g.const(
+            np.broadcast_to(val.reshape(shape),
+                            eqn.outvars[0].aval.shape).copy(), "iota")
+        return
+    if prim == "stop_gradient" or prim == "copy":
+        g.add_node("Identity", ins, outs)
+        return bind_outs()
+
+    # ---- math -------------------------------------------------------------
+    if prim in _ELEMENTWISE:
+        g.add_node(_ELEMENTWISE[prim], ins, outs)
+        return bind_outs()
+    if prim == "integer_pow":
+        e = g.const(np.asarray(eqn.params["y"], np.float32), "exp")
+        g.add_node("Pow", [ins[0], e], outs)
+        return bind_outs()
+    if prim == "rsqrt":
+        mid = g.fresh("sqrt")
+        g.add_node("Sqrt", ins, [mid])
+        one = g.const(np.asarray(1.0, np.float32), "one")
+        g.add_node("Div", [one, mid], outs)
+        return bind_outs()
+    if prim in _COMPARE:
+        if prim == "ne":
+            mid = g.fresh("eq")
+            g.add_node("Equal", ins, [mid])
+            g.add_node("Not", [mid], outs)
+        else:
+            g.add_node(_COMPARE[prim], ins, outs)
+        return bind_outs()
+    if prim == "select_n":
+        # select_n(pred, on_false, on_true) -> Where(pred, on_true, on_false)
+        g.add_node("Where", [ins[0], ins[2], ins[1]], outs)
+        return bind_outs()
+    if prim in _REDUCE:
+        axes = g.const(np.asarray(eqn.params["axes"], np.int64), "axes")
+        g.add_node(_REDUCE[prim], [ins[0], axes], outs,
+                   attrs=[P.attr_i("keepdims", 0)])
+        return bind_outs()
+    if prim in ("argmax", "argmin"):
+        (axis,) = eqn.params["axes"]
+        mid = g.fresh("arg")
+        g.add_node("ArgMax" if prim == "argmax" else "ArgMin",
+                   [ins[0]], [mid],
+                   attrs=[P.attr_i("axis", axis), P.attr_i("keepdims", 0)])
+        to = _DTYPE[np.dtype(eqn.params["index_dtype"])]
+        g.add_node("Cast", [mid], outs, attrs=[P.attr_i("to", to)])
+        return bind_outs()
+
+    # ---- linear algebra ---------------------------------------------------
+    if prim == "dot_general":
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        la, ra = eqn.invars[0].aval, eqn.invars[1].aval
+        std_l = tuple(lc) == (la.ndim - 1,)
+        std_r = tuple(rc) == (ra.ndim - 2,) if ra.ndim >= 2 else False
+        batch_ok = tuple(lb) == tuple(range(len(lb))) and \
+            tuple(rb) == tuple(range(len(rb)))
+        if std_l and std_r and batch_ok:
+            g.add_node("MatMul", ins, outs)
+            return bind_outs()
+        raise UnsupportedPrimitive(
+            f"dot_general with dimension_numbers "
+            f"{eqn.params['dimension_numbers']}")
+    if prim == "conv_general_dilated":
+        dn = eqn.params["dimension_numbers"]
+        if dn.lhs_spec != tuple(range(len(dn.lhs_spec))):
+            raise UnsupportedPrimitive("conv with non-NCHW layout")
+        pads = eqn.params["padding"]
+        attrs = [
+            _ints("strides", eqn.params["window_strides"]),
+            _ints("dilations", eqn.params["rhs_dilation"]),
+            _ints("pads", [p[0] for p in pads] + [p[1] for p in pads]),
+            P.attr_i("group", eqn.params["feature_group_count"]),
+        ]
+        g.add_node("Conv", ins, outs, attrs=attrs)
+        return bind_outs()
+    if prim == "reduce_window_max":
+        attrs = _pool_attrs(eqn.params)
+        g.add_node("MaxPool", ins, outs, attrs=attrs)
+        return bind_outs()
+    if prim == "reduce_window_sum":
+        # AveragePool = reduce_window_sum / window size: emit the sum as
+        # MaxPool-shaped pooling is wrong, so divide explicitly
+        # count_include_pad=1 makes avg*size == sum exactly even at
+        # padded borders (default 0 would divide by the VALID count there)
+        attrs = _pool_attrs(eqn.params) + [P.attr_i("count_include_pad", 1)]
+        mid = g.fresh("sumpool")
+        wd = eqn.params["window_dimensions"]
+        size = float(np.prod(wd))
+        g.add_node("AveragePool", ins, [mid], attrs=attrs)
+        k = g.const(np.asarray(size, np.float32), "winsize")
+        g.add_node("Mul", [mid, k], outs)
+        return bind_outs()
+    if prim == "gather":
+        # jnp.take/embedding-style gather: single collapsed leading dim
+        dn = eqn.params["dimension_numbers"]
+        if (tuple(dn.collapsed_slice_dims) == (0,)
+                and tuple(dn.start_index_map) == (0,)):
+            idx_name = ins[1]
+            idx_aval = eqn.invars[1].aval
+            if idx_aval.shape and idx_aval.shape[-1] == 1:
+                sq = g.fresh("squeeze")
+                axes = g.const(np.asarray([idx_aval.ndim - 1], np.int64),
+                               "axes")
+                g.add_node("Squeeze", [idx_name, axes], [sq])
+                idx_name = sq
+            g.add_node("Gather", [ins[0], idx_name], outs,
+                       attrs=[P.attr_i("axis", 0)])
+            return bind_outs()
+        raise UnsupportedPrimitive(f"gather {dn}")
+
+    raise UnsupportedPrimitive(
+        f"jax primitive {prim!r} has no ONNX mapping yet (file an op "
+        "mapping in paddle_tpu/onnx/_export.py)")
+
+
+def _pool_attrs(params):
+    wd = params["window_dimensions"]
+    ws = params["window_strides"]
+    pads = params["padding"]
+    # leading batch/channel dims must be un-windowed
+    if tuple(wd[:2]) != (1, 1) or tuple(ws[:2]) != (1, 1):
+        raise UnsupportedPrimitive("pooling over batch/channel dims")
+    for k in ("base_dilation", "window_dilation"):
+        dil = params.get(k)
+        if dil is not None and any(d != 1 for d in dil):
+            raise UnsupportedPrimitive(f"pooling with {k} {tuple(dil)}")
+    return [
+        _ints("kernel_shape", wd[2:]),
+        _ints("strides", ws[2:]),
+        _ints("pads", [p[0] for p in pads[2:]] + [p[1] for p in pads[2:]]),
+    ]

@@ -1,0 +1,286 @@
+"""CTR / text-matching / tree op tail (reference: the pslib-era contrib set
+`python/paddle/fluid/contrib/layers/nn.py` — shuffle_batch:785,
+filter_by_instag, search_pyramid_hash:669, rank_attention:1321,
+tree_conv:402, var_conv_2d:129, with kernels in
+`operators/{shuffle_batch,filter_by_instag,pyramid_hash,rank_attention,
+tree_conv,var_conv_2d}_op.*`).
+
+TPU notes: rank_attention / var_conv_2d / shuffle_batch are fully traced
+jnp (differentiable, jit-able). filter_by_instag and the tree/patch
+construction of tree_conv are HOST ops — their output structure depends on
+data values (dynamic row counts, tree shapes), exactly the part the
+reference runs on CPU over LoD; the differentiable math (gather + einsum)
+stays on device.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import call_op, unwrap, wrap
+from ..core.tensor import Tensor
+
+__all__ = ["shuffle_batch", "filter_by_instag", "search_pyramid_hash",
+           "rank_attention", "tree_conv", "var_conv_2d"]
+
+
+def shuffle_batch(x, seed=None, startup_seed=0):
+    """Random row permutation (reference: shuffle_batch_op.cc; returns the
+    shuffled tensor like the python front-end, ShuffleIdx retrievable via
+    return_index)."""
+    from ..core import random as core_random
+
+    n = x.shape[0]
+    if seed is not None:
+        key = jax.random.PRNGKey(int(unwrap(seed) if isinstance(seed, Tensor)
+                                     else seed))
+    else:
+        key = core_random.next_key()
+    perm = jax.random.permutation(key, n)
+
+    def _sh(v):
+        return v[perm]
+
+    return call_op(_sh, x, op_name="shuffle_batch")
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """Keep rows of `ins` whose tag set intersects `filter_tag`
+    (reference: filter_by_instag_op.cc). HOST op: the output row count is
+    data-dependent. `ins_tag`: list-of-lists (ragged per-row tags) or a
+    padded [N, T] array (0 = padding). Returns (out, loss_weight,
+    index_map) exactly like the reference outputs Out/LossWeight/IndexMap."""
+    ins_np = np.asarray(unwrap(ins))
+    ftags = set(int(t) for t in np.asarray(unwrap(filter_tag)).ravel())
+    if isinstance(ins_tag, Tensor) or isinstance(ins_tag, np.ndarray):
+        tag_np = np.asarray(unwrap(ins_tag))
+        rows_tags = [set(int(t) for t in row if int(t) != 0)
+                     for row in tag_np]
+    else:
+        rows_tags = [set(int(t) for t in row) for row in ins_tag]
+    keep = [i for i, tags in enumerate(rows_tags) if tags & ftags]
+    if keep:
+        out = ins_np[keep]
+        loss_weight = np.ones((len(keep), 1), np.float32)
+        index_map = np.asarray([[i, i] for i in keep], np.int64)
+    else:
+        # reference: emit one zero row so downstream shapes stay valid
+        out = np.full((1,) + ins_np.shape[1:], out_val_if_empty,
+                      ins_np.dtype)
+        loss_weight = np.zeros((1, 1), np.float32)
+        index_map = np.zeros((1, 2), np.int64)
+    return wrap(jnp.asarray(out)), wrap(jnp.asarray(loss_weight)), \
+        wrap(jnp.asarray(index_map))
+
+
+def _hash64(a, b):
+    """Deterministic splitmix64-style mix (the reference hashes n-grams
+    with xxhash — the family differs, the pyramid semantics don't)."""
+    x = (np.uint64(a) * np.uint64(0x9E3779B97F4A7C15)
+         + np.uint64(b) * np.uint64(0xBF58476D1CE4E5B9))
+    x ^= x >> np.uint64(30)
+    x = x * np.uint64(0x94D049BB133111EB) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    return x ^ (x >> np.uint64(31))
+
+
+def search_pyramid_hash(input, weight, num_emb, space_len, pyramid_layer=2,  # noqa: A002
+                        rand_len=16, drop_out_percent=0.0, is_training=False,
+                        seed=0):
+    """PyramidHash text embedding (reference: pyramid_hash_op.cc /
+    search_pyramid_hash:669): every n-gram of window size 2..pyramid_layer
+    is hashed `num_emb // rand_len` times into the [space_len, rand_len]
+    table; the concatenated pieces form the n-gram embedding and a
+    sequence's embedding is their sum.
+
+    input: int32 [B, T] padded token ids (0 = pad). Returns [B, num_emb].
+    """
+    assert num_emb % rand_len == 0, "num_emb must divide by rand_len"
+    ids = np.asarray(unwrap(input)).astype(np.int64)
+    B, T = ids.shape
+    pieces = num_emb // rand_len
+    # HOST: n-gram hashing (integer mixing over data values); the gather +
+    # sum below stay on device and are differentiable wrt the table
+    idx_rows = []  # per example: list of [pieces] table rows per ngram
+    for b in range(B):
+        toks = [t for t in ids[b] if t != 0]
+        rows = []
+        for w in range(2, pyramid_layer + 1):
+            for s in range(0, max(0, len(toks) - w + 1)):
+                gram = toks[s:s + w]
+                sig = np.uint64(seed)
+                for t in gram:
+                    sig = _hash64(sig, np.uint64(t))
+                rows.append([int(_hash64(sig, np.uint64(j))
+                                 % np.uint64(space_len))
+                             for j in range(pieces)])
+        idx_rows.append(rows)
+    max_g = max(1, max(len(r) for r in idx_rows))
+    idx = np.zeros((B, max_g, pieces), np.int32)
+    mask = np.zeros((B, max_g, 1, 1), np.float32)
+    for b, rows in enumerate(idx_rows):
+        for g, r in enumerate(rows):
+            idx[b, g] = r
+            mask[b, g] = 1.0
+
+    def _emb(w):
+        # [B, G, pieces, rand_len] -> sum over grams, concat pieces
+        g = w[idx] * jnp.asarray(mask)
+        summed = jnp.sum(g, axis=1)  # [B, pieces, rand_len]
+        return summed.reshape(B, num_emb)
+
+    out = call_op(_emb, weight, op_name="pyramid_hash")
+    if is_training and drop_out_percent > 0:
+        from ..nn import functional as F
+        out = F.dropout(out, p=drop_out_percent, training=True)
+    return out
+
+
+def rank_attention(input, rank_offset, rank_param, max_rank=3, max_size=0):  # noqa: A002
+    """Rank attention (reference: rank_attention.cu.h expand kernels):
+    rank_offset [N, 1+2K] int32 — col 0 is the instance's own rank
+    (1-based, 0 invalid); cols (2k+1, 2k+2) are the k-th related
+    instance's rank and its row in `input`. For every instance the K
+    related feature rows multiply the param block selected by
+    (own_rank, related_rank): out[i] = sum_k X[index_k] @ P[(own-1)*K +
+    (rank_k - 1)], with P viewed as [K*K, d, out]."""
+    d = input.shape[1]
+    out_col = rank_param.shape[1]
+    K = max_rank
+
+    def _ra(x, ro, p):
+        ro = ro.astype(jnp.int32)
+        own = ro[:, 0] - 1                       # [N]
+        rel_rank = ro[:, 1::2] - 1               # [N, K]
+        rel_idx = ro[:, 2::2]                    # [N, K]
+        valid = (own[:, None] >= 0) & (rel_rank >= 0)
+        gathered = x[jnp.clip(rel_idx, 0, x.shape[0] - 1)]  # [N, K, d]
+        gathered = jnp.where(valid[..., None], gathered, 0.0)
+        pb = p.reshape(K * K, d, out_col)
+        block = jnp.clip(own[:, None] * K + rel_rank, 0, K * K - 1)
+        pg = pb[block]                           # [N, K, d, out]
+        pg = jnp.where(valid[..., None, None], pg, 0.0)
+        return jnp.einsum("nkd,nkdo->no", gathered, pg)
+
+    return call_op(_ra, input, rank_offset, rank_param,
+                   op_name="rank_attention")
+
+
+def _tree_patches(edges, n_nodes, max_depth):
+    """construct_tree + construct_patch (reference: math/tree2col.cc) —
+    DFS patches with (eta_t, eta_l, eta_r) continuous-binary-tree
+    coefficients. Host structure work; returns (patch_idx [N, P],
+    coef [N, P, 3], pmask [N, P])."""
+    tr = [[] for _ in range(n_nodes + 2)]
+    for u, v in edges:
+        if u != 0 and v != 0:
+            tr[int(u)].append(int(v))
+        else:
+            break
+
+    def eta(index, pclen, depth):
+        et = (max_depth - depth) / max_depth
+        el = (1.0 - et) * (0.5 if pclen == 1
+                           else (index - 1.0) / (pclen - 1.0))
+        er = (1.0 - et) * (1.0 - (0.5 if pclen == 1 else
+                                  (index - 1.0) / (pclen - 1.0)))
+        return et, el, er
+
+    patches = []
+    for root in range(1, n_nodes + 1):
+        patch = [(root, 1, 1, 0)]
+        stack = [(root, 1, 1, 0)]
+        visited = {root}
+        while stack:
+            node, _, _, depth = stack[-1]
+            end = True
+            sz = len(tr[node])
+            for i, v in enumerate(tr[node]):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, i, sz, depth + 1))
+                    patch.append((v, i + 1, sz, depth + 1))
+                    end = False
+            if end:
+                stack.pop()
+        patches.append(patch)
+    P = max(len(p) for p in patches)
+    idx = np.zeros((n_nodes, P), np.int32)
+    coef = np.zeros((n_nodes, P, 3), np.float32)
+    pm = np.zeros((n_nodes, P, 1), np.float32)
+    for r, patch in enumerate(patches):
+        for j, (node, index, pclen, depth) in enumerate(patch):
+            idx[r, j] = node - 1
+            coef[r, j] = eta(index, pclen, depth)
+            pm[r, j] = 1.0
+    return idx, coef, pm
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2):  # noqa: A002
+    """Tree-based convolution (TBCNN, reference: tree_conv_op.cc +
+    math/tree2col.*): nodes_vector [B, N, C], edge_set [B, E, 2] int32
+    (1-based node ids, 0-padded), filter [C, 3, output_size, num_filters]
+    -> [B, N, output_size, num_filters]."""
+    edges_np = np.asarray(unwrap(edge_set)).astype(np.int64)
+    B, N, C = nodes_vector.shape
+    idxs, coefs, masks = [], [], []
+    for b in range(B):
+        i, c, m = _tree_patches(edges_np[b], N, max_depth)
+        idxs.append(i)
+        coefs.append(c)
+        masks.append(m)
+    P = max(i.shape[1] for i in idxs)
+    idx = np.zeros((B, N, P), np.int32)
+    coef = np.zeros((B, N, P, 3), np.float32)
+    pm = np.zeros((B, N, P, 1), np.float32)
+    for b in range(B):
+        p = idxs[b].shape[1]
+        idx[b, :, :p] = idxs[b]
+        coef[b, :, :p] = coefs[b]
+        pm[b, :, :p] = masks[b]
+
+    def _tc(nodes, w):
+        gath = jnp.take_along_axis(
+            nodes[:, :, None, :], jnp.asarray(idx)[..., None], axis=1)
+        gath = gath * jnp.asarray(pm)            # [B, N, P, C]
+        c3 = jnp.asarray(coef)                   # [B, N, P, 3]
+        # out[b,n,o,f] = sum_{p,c,e} gath[b,n,p,c] c3[b,n,p,e] w[c,e,o,f]
+        return jnp.einsum("bnpc,bnpe,ceof->bnof", gath, c3, w)
+
+    return call_op(_tc, nodes_vector, filter, op_name="tree_conv")
+
+
+def var_conv_2d(x, rows, cols, filter, input_channel=1, output_channel=1,  # noqa: A002
+                stride=(1, 1), kernel_size=(3, 3)):
+    """Variable-size 2D convolution (reference: var_conv_2d_op.cc — conv
+    over per-sample (row, col) sized images carried in LoD). Padded
+    TPU design: x [B, Cin, Hmax, Wmax] with per-sample valid extents
+    `rows`/`cols` [B]; invalid area is masked to zero before AND after the
+    conv so padding never leaks into valid outputs."""
+    from ..nn import functional as F
+
+    rows_np = np.asarray(unwrap(rows)).astype(np.int32)
+    cols_np = np.asarray(unwrap(cols)).astype(np.int32)
+    B, Cin, H, W = x.shape
+    rmask = (np.arange(H)[None, :] < rows_np[:, None])
+    cmask = (np.arange(W)[None, :] < cols_np[:, None])
+    mask = (rmask[:, None, :, None] & cmask[:, None, None, :])
+
+    def _mask_in(v):
+        return jnp.where(jnp.asarray(mask), v, 0.0)
+
+    xm = call_op(_mask_in, x, op_name="var_conv_mask")
+    out = F.conv2d(xm, filter, stride=stride,
+                   padding=(kernel_size[0] // 2, kernel_size[1] // 2))
+    oh = out.shape[2]
+    ow = out.shape[3]
+    orows = np.minimum((rows_np + stride[0] - 1) // stride[0], oh)
+    ocols = np.minimum((cols_np + stride[1] - 1) // stride[1], ow)
+    ormask = (np.arange(oh)[None, :] < orows[:, None])
+    ocmask = (np.arange(ow)[None, :] < ocols[:, None])
+    omask = (ormask[:, None, :, None] & ocmask[:, None, None, :])
+
+    def _mask_out(v):
+        return jnp.where(jnp.asarray(omask), v, 0.0)
+
+    return call_op(_mask_out, out, op_name="var_conv_mask_out")

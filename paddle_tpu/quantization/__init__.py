@@ -135,9 +135,19 @@ class _QuantLayerMixin:
         return y
 
     def quant_scales(self):
-        """Exported calibration record (act/out thresholds + config)."""
+        """Exported calibration record (act/out thresholds + weight
+        scales — per-channel when channel_wise, so a serving backend can
+        requantize without re-deriving from the float weights)."""
+        w = unwrap(self.weight)
+        if self._channel_wise:
+            axes, _ = self._channel_axes(tuple(self.weight.shape))
+            wscale = np.asarray(
+                jax.device_get(_absmax(w, axis=axes))).ravel().tolist()
+        else:
+            wscale = float(np.asarray(jax.device_get(_absmax(w))))
         return {"act_scale": float(np.asarray(self._act_scale)),
                 "out_scale": float(np.asarray(self._out_scale)),
+                "weight_scale": wscale,
                 "weight_bits": self._qbits, "activation_bits": self._qabits,
                 "channel_wise": self._channel_wise}
 

@@ -1,0 +1,111 @@
+"""CTR/serving op tail (reference: contrib/layers/nn.py shuffle_batch,
+filter_by_instag, search_pyramid_hash, rank_attention, tree_conv,
+var_conv_2d + their C++ kernels)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+rng = np.random.RandomState(9)
+
+
+def test_shuffle_batch_is_permutation():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+    paddle.seed(3)
+    out = ops.shuffle_batch(x)
+    got = out.numpy()
+    assert sorted(got[:, 0].tolist()) == list(range(0, 12, 2))
+    # seeded: deterministic
+    a = ops.shuffle_batch(x, seed=5).numpy()
+    b = ops.shuffle_batch(x, seed=5).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_filter_by_instag():
+    ins = paddle.to_tensor(rng.rand(4, 3).astype(np.float32))
+    tags = [[1, 2], [3], [2, 7], [4]]
+    out, lw, idx = ops.filter_by_instag(ins, tags,
+                                        paddle.to_tensor(
+                                            np.array([2, 4], np.int64)))
+    np.testing.assert_allclose(out.numpy(), ins.numpy()[[0, 2, 3]])
+    assert lw.numpy().shape == (3, 1)
+    np.testing.assert_array_equal(idx.numpy()[:, 1], [0, 2, 3])
+    # empty result: one padded row, zero loss weight
+    out2, lw2, _ = ops.filter_by_instag(ins, tags,
+                                        paddle.to_tensor(
+                                            np.array([99], np.int64)))
+    assert out2.numpy().shape == (1, 3)
+    assert float(lw2.numpy().sum()) == 0.0
+
+
+def test_pyramid_hash_shapes_and_grads():
+    W = paddle.to_tensor(rng.rand(64, 4).astype(np.float32))
+    W.stop_gradient = False
+    ids = paddle.to_tensor(
+        np.array([[3, 7, 9, 0], [5, 2, 0, 0]], np.int32))
+    out = ops.search_pyramid_hash(ids, W, num_emb=8, space_len=64,
+                                  pyramid_layer=3, rand_len=4)
+    assert out.shape == [2, 8]
+    out.sum().backward()
+    assert W.grad is not None and float(abs(W.grad.numpy()).sum()) > 0
+    # same ids -> same embedding (deterministic hash)
+    out2 = ops.search_pyramid_hash(ids, W, num_emb=8, space_len=64,
+                                   pyramid_layer=3, rand_len=4)
+    np.testing.assert_allclose(out.numpy(), out2.numpy())
+
+
+def test_rank_attention_matches_manual():
+    N, d, K, out_col = 3, 2, 2, 3
+    x = rng.rand(N, d).astype(np.float32)
+    p = rng.rand(d * K * K, out_col).astype(np.float32)
+    # ins 0: own rank 1, one related (rank 2, row 1); ins 1: own rank 2,
+    # related (rank 1, row 0) and (rank 2, row 1); ins 2: invalid (rank 0)
+    ro = np.array([[1, 2, 1, 0, 0],
+                   [2, 1, 0, 2, 1],
+                   [0, 0, 0, 0, 0]], np.int32)
+    out = ops.rank_attention(paddle.to_tensor(x), paddle.to_tensor(ro),
+                             paddle.to_tensor(p), max_rank=K).numpy()
+    pb = p.reshape(K * K, d, out_col)
+    want0 = x[1] @ pb[(1 - 1) * K + (2 - 1)]
+    want1 = x[0] @ pb[(2 - 1) * K + (1 - 1)] + x[1] @ pb[(2 - 1) * K + (2 - 1)]
+    np.testing.assert_allclose(out[0], want0, rtol=1e-5)
+    np.testing.assert_allclose(out[1], want1, rtol=1e-5)
+    np.testing.assert_allclose(out[2], np.zeros(out_col), atol=1e-7)
+
+
+def test_tree_conv_root_leaf():
+    """2-node tree (1 -> 2), max_depth 2: root patch = {self, child},
+    leaf patch = {self}; eta coefficients per tree2col.cc."""
+    B, N, C, O, F_ = 1, 2, 2, 3, 1
+    nodes = rng.rand(B, N, C).astype(np.float32)
+    edges = np.zeros((B, 3, 2), np.int32)
+    edges[0, 0] = [1, 2]
+    w = rng.rand(C, 3, O, F_).astype(np.float32)
+    out = ops.tree_conv(paddle.to_tensor(nodes), paddle.to_tensor(edges),
+                        paddle.to_tensor(w), max_depth=2).numpy()
+    et0, el0, er0 = 1.0, 0.0, 0.0  # depth 0: eta_t=(2-0)/2=1
+    etc, elc, erc = 0.5, 0.25, 0.25  # child: depth1, index1, pclen1
+    want_root = np.einsum("c,ceo->o",
+                          nodes[0, 0], w[:, :, :, 0] * np.array(
+                              [et0, el0, er0])[None, :, None]) + \
+        np.einsum("c,ceo->o", nodes[0, 1], w[:, :, :, 0] * np.array(
+            [etc, elc, erc])[None, :, None])
+    np.testing.assert_allclose(out[0, 0, :, 0], want_root, rtol=1e-4)
+    want_leaf = np.einsum("c,ceo->o", nodes[0, 1],
+                          w[:, :, :, 0] * np.array(
+                              [et0, el0, er0])[None, :, None])
+    np.testing.assert_allclose(out[0, 1, :, 0], want_leaf, rtol=1e-4)
+
+
+def test_var_conv_2d_masks_padding():
+    B, H, W = 2, 6, 6
+    x = np.ones((B, 1, H, W), np.float32)
+    f = np.ones((1, 1, 3, 3), np.float32)
+    out = ops.var_conv_2d(paddle.to_tensor(x),
+                          paddle.to_tensor(np.array([4, 6], np.int32)),
+                          paddle.to_tensor(np.array([4, 6], np.int32)),
+                          paddle.to_tensor(f)).numpy()
+    # outputs beyond each sample's valid extent are exactly zero
+    assert np.all(out[0, 0, 4:, :] == 0) and np.all(out[0, 0, :, 4:] == 0)
+    assert out[0, 0, 1, 1] == 9.0  # interior of the valid region
+    assert np.all(out[1, 0] != 0)

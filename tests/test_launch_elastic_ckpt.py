@@ -178,7 +178,8 @@ class TestElasticFaultInjection:
             "PADDLE_ELASTIC_KV_ENDPOINT": f"127.0.0.1:{kv_port}",
             "PADDLE_ELASTIC_NP": "2",
             "PADDLE_AUTO_CHECKPOINT_DIR": ckpt_dir,
-            "PADDLE_JOB_ID": "elastic_fault_job",
+            "PADDLE_JOB_ID": "elastic_fault_job",  # auto_checkpoint scope
+            "PADDLE_ELASTIC_JOB_ID": "elastic_fault_job",  # KV key scope
             "VICTIM_EPOCH": str(victim_epoch),
             "JAX_PLATFORMS": "cpu",
         })

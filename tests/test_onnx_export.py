@@ -1,0 +1,80 @@
+"""ONNX export (reference: python/paddle/onnx/export.py). The exporter
+maps the layer's JAXPR onto ONNX ops and serializes standard protobuf
+wire format with no onnx package; verified with the bundled reader."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit.to_static import InputSpec
+from paddle_tpu.onnx import export, read_model
+
+
+def test_export_mlp(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    path = export(m, str(tmp_path / "mlp"),
+                  input_spec=[InputSpec([None, 4], "float32", name="feat")])
+    mm = read_model(path)
+    ops = [n[0] for n in mm["nodes"]]
+    assert ops.count("MatMul") == 2
+    assert "Max" in ops  # relu = max(x, 0)
+    assert mm["inputs"] == ["feat"]
+    assert len(mm["outputs"]) == 1
+    assert mm["producer"] == "paddle_tpu"
+    assert mm["opset"] == 13
+    # both weight matrices land as initializers with the right dims
+    dims = sorted(tuple(d) for _, d in mm["initializers"]
+                  if len(d) == 2)
+    assert (4, 8) in dims and (8, 2) in dims
+
+
+def test_export_convnet(tmp_path):
+    m = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1, stride=2), nn.ReLU())
+    path = export(m, str(tmp_path / "conv"),
+                  input_spec=[InputSpec([None, 3, 8, 8], "float32")])
+    ops = [n[0] for n in read_model(path)["nodes"]]
+    assert "Conv" in ops
+
+
+def test_export_softmax_tanh_graph(tmp_path):
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+            return F.softmax(F.tanh(self.fc(x)), axis=-1)
+
+    path = export(M(), str(tmp_path / "smax"),
+                  input_spec=[InputSpec([None, 4], "float32")])
+    ops = [n[0] for n in read_model(path)["nodes"]]
+    assert "Tanh" in ops
+    assert "Exp" in ops and "Div" in ops  # softmax decomposition
+
+
+def test_unsupported_primitive_is_loud(tmp_path):
+    class Weird(nn.Layer):
+        def forward(self, x):
+            import paddle_tpu.ops as ops
+            return ops.cumsum(x, axis=0)
+
+    with pytest.raises(NotImplementedError, match="cumsum"):
+        export(Weird(), str(tmp_path / "weird"),
+               input_spec=[InputSpec([4], "float32")])
+
+
+def test_wire_format_roundtrip(tmp_path):
+    """The writer emits valid protobuf wire format: a field-level reparse
+    of the file reproduces the node/initializer structure exactly."""
+    from paddle_tpu.onnx._proto import parse_fields
+
+    m = nn.Sequential(nn.Linear(3, 3))
+    path = export(m, str(tmp_path / "p"),
+                  input_spec=[InputSpec([None, 3], "float32")])
+    with open(path, "rb") as f:
+        fields = parse_fields(f.read())
+    field_nums = [f for f, _, _ in fields]
+    assert 1 in field_nums  # ir_version
+    assert 7 in field_nums  # graph
+    assert 8 in field_nums  # opset_import
