@@ -74,16 +74,45 @@ class PipelineLayer(Layer):
         self.layers = LayerList([l for kind, l in built if kind == "layer"])
         self._segments = self._segment_network(seg_method)
 
-    # reference: _segment_network :202 — uniform or by-param-count
+    # reference: _segment_network :202 / SegmentLayers :23 (the snapshot
+    # ships uniform; later releases add param-count balancing — both here)
     def _segment_network(self, seg_method):
         n = len(self.run_list)
         k = self._num_stages
-        if seg_method == "uniform" or not seg_method.startswith("layer:"):
+        if seg_method == "param_size":
+            # balance cumulative parameter counts: boundary i is the first
+            # index whose prefix sum reaches quantile i/k, clamped so every
+            # stage keeps at least one item (strictly monotone bounds)
+            sizes = []
+            for kind, item in self.run_list:
+                if kind == "layer":
+                    sizes.append(sum(p.size for p in item.parameters()))
+                else:
+                    sizes.append(0)
+            prefix = [0]
+            for sz in sizes:
+                prefix.append(prefix[-1] + sz)
+            total = max(prefix[-1], 1)
+            bounds = [0]
+            for i in range(1, k):
+                target = total * i / k
+                j = bounds[-1] + 1
+                hi = n - (k - i)  # leave >=1 item per remaining stage
+                while j < hi and prefix[j] < target:
+                    j += 1
+                bounds.append(min(max(j, bounds[-1] + 1), hi))
+            bounds.append(n)
+            return bounds
+        if seg_method == "uniform":
             base, rem = divmod(n, k)
             bounds = [0]
             for i in range(k):
                 bounds.append(bounds[-1] + base + (1 if i < rem else 0))
             return bounds
+        if not seg_method.startswith("layer:"):
+            raise ValueError(
+                f"unknown seg_method {seg_method!r}: expected 'uniform', "
+                "'param_size', or 'layer:ClassName'")
         # "layer:ClassName" — split before each occurrence of the class
         cls_name = seg_method.split(":")[1]
         marks = [i for i, (kind, l) in enumerate(self.run_list)

@@ -29,6 +29,23 @@ from .communicator import (AsyncCommunicator, GeoCommunicator,
 from .embedding import (SparseEmbedding, distributed_lookup_table,
                         flush_sparse_grads, reset_registry, sparse_tables)
 from .server import OPT_ADAM, OPT_SGD, OPT_SUM, PsServer, TableConfig
+from .trainer import DownpourTrainer, DownpourWorker  # noqa: F401
+
+
+def bind_model(model, communicator, bind_embeddings=True):
+    """Attach a model replica to a communicator: bind its SparseEmbedding
+    layers and register every trainable dense parameter under sequential
+    table ids. The ONE place that owns the dense-table-id-by-enumeration
+    contract (server and every worker/replica must agree on it)."""
+    if bind_embeddings:
+        for sub in model.sublayers(include_self=True):
+            if isinstance(sub, SparseEmbedding):
+                sub.bind(communicator)
+    dense_id = 0
+    for p in model.parameters():
+        if p.trainable:
+            communicator.register_dense_param(dense_id, p)
+            dense_id += 1
 
 
 class PsRuntime:
@@ -114,14 +131,7 @@ class PsRuntime:
         for emb in sparse_tables():
             emb.bind(self.communicator)
         if model is not None:
-            # SparseEmbedding holds no local Parameters, so parameters()
-            # enumerates exactly the dense vars — same order as the server's
-            # table ids (both sides construct the same model)
-            dense_id = 0
-            for p in model.parameters():
-                if p.trainable:
-                    self.communicator.register_dense_param(dense_id, p)
-                    dense_id += 1
+            bind_model(model, self.communicator, bind_embeddings=False)
         self.communicator.init_params()
         # one init-barrier round for every worker: nobody may start pushing
         # step-0 grads before all workers adopted the initial params (keeps

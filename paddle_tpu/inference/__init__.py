@@ -40,12 +40,33 @@ class Config:
         pass
 
     def switch_ir_optim(self, flag=True):
-        self._ir_optim = flag  # XLA always optimizes; recorded only
+        """Recorded for API compat. On this backend XLA ALWAYS optimizes
+        the compiled program; switching IR optimization off has no effect,
+        which is behavior-affecting in the reference — warn so the caller
+        knows the knob did nothing."""
+        if not flag:
+            import warnings
+            warnings.warn(
+                "switch_ir_optim(False) has no effect on the TPU build: "
+                "XLA always optimizes the program (there is no separate "
+                "IR-pass pipeline to disable)", stacklevel=2)
+        self._ir_optim = flag
 
     def enable_memory_optim(self):
+        """No-op beyond recording: XLA buffer assignment already performs
+        the reference's memory-reuse passes (SURVEY Appendix A)."""
         self._memory_optim = True
 
     def set_cpu_math_library_num_threads(self, n):
+        """Recorded only — XLA:CPU threading is process-global; warn since
+        the reference uses this to size MKL thread pools."""
+        if n != 1:
+            import warnings
+            warnings.warn(
+                "set_cpu_math_library_num_threads is recorded but not "
+                "applied: XLA's thread pool is process-global "
+                "(set XLA_FLAGS=--xla_cpu_multi_thread_eigen / "
+                "intra_op_parallelism instead)", stacklevel=2)
         self._cpu_math_threads = n
 
 

@@ -21,7 +21,8 @@ from ..core.dispatch import call_op, unwrap, wrap
 from ..core.tensor import Tensor
 
 __all__ = ["shuffle_batch", "filter_by_instag", "search_pyramid_hash",
-           "rank_attention", "tree_conv", "var_conv_2d"]
+           "rank_attention", "tree_conv", "var_conv_2d",
+           "bilateral_slice"]
 
 
 def shuffle_batch(x, seed=None, startup_seed=0):
@@ -284,3 +285,60 @@ def var_conv_2d(x, rows, cols, filter, input_channel=1, output_channel=1,  # noq
         return jnp.where(jnp.asarray(omask), v, 0.0)
 
     return call_op(_mask_out, out, op_name="var_conv_mask_out")
+
+
+def bilateral_slice(x, guide, grid, has_offset=False):
+    """HDRnet bilateral-grid slice-and-apply (reference:
+    bilateral_slice_op.cu BilateralSliceCudaForwardKernel): per pixel,
+    trilinearly sample affine coefficients from `grid` at
+    (gx, gy, guide-value) and apply them to the input channels.
+
+    x [N, Cin, H, W]; guide [N, H, W] in [0,1];
+    grid [N, Cg, gd, gh, gw] with Cg = Cout*Cin (+Cout when has_offset).
+    Returns [N, Cout, H, W]. Fully traced jnp (differentiable in x,
+    guide, grid).
+    """
+    N, Cin, H, W = x.shape
+    Cg = grid.shape[1]
+    stride = Cin + (1 if has_offset else 0)
+    if Cg % stride:
+        raise ValueError(
+            f"grid channels {Cg} must be a multiple of Cin+offset "
+            f"({stride}); check has_offset against how the grid was built")
+    Cout = Cg // stride
+
+    def _bs(xv, gv, grv):
+        gd, gh, gw = grv.shape[2], grv.shape[3], grv.shape[4]
+        xs = (jnp.arange(W, dtype=jnp.float32) + 0.5) * gw / W
+        ys = (jnp.arange(H, dtype=jnp.float32) + 0.5) * gh / H
+        gx = jnp.broadcast_to(xs[None, None, :], (N, H, W))
+        gy = jnp.broadcast_to(ys[None, :, None], (N, H, W))
+        gz = gv.astype(jnp.float32) * gd
+
+        def tri(coords, size):
+            f = jnp.floor(coords - 0.5).astype(jnp.int32)
+            idx0 = jnp.clip(f, 0, size - 1)
+            idx1 = jnp.clip(f + 1, 0, size - 1)
+            w1 = jnp.maximum(1.0 - jnp.abs(f + 0.5 - coords), 0.0)
+            w2 = jnp.maximum(1.0 - jnp.abs(f + 1.5 - coords), 0.0)
+            return (idx0, w1), (idx1, w2)
+
+        corners_x = tri(gx, gw)
+        corners_y = tri(gy, gh)
+        corners_z = tri(gz, gd)
+        bidx = jnp.arange(N)[:, None, None]
+        coeff = 0.0
+        for ix, wx in corners_x:
+            for iy, wy in corners_y:
+                for iz, wz in corners_z:
+                    # [N, Cg, H, W] gather of the grid cell per pixel
+                    cell = grv[bidx, :, iz, iy, ix]          # [N,H,W,Cg]
+                    coeff = coeff + cell * (wx * wy * wz)[..., None]
+        coeff = jnp.moveaxis(coeff, -1, 1)                   # [N,Cg,H,W]
+        co = coeff.reshape(N, Cout, stride, H, W)
+        out = jnp.einsum("noshw,nshw->nohw", co[:, :, :Cin], xv)
+        if has_offset:
+            out = out + co[:, :, Cin]
+        return out
+
+    return call_op(_bs, x, guide, grid, op_name="bilateral_slice")

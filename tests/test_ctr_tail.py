@@ -109,3 +109,37 @@ def test_var_conv_2d_masks_padding():
     assert np.all(out[0, 0, 4:, :] == 0) and np.all(out[0, 0, :, 4:] == 0)
     assert out[0, 0, 1, 1] == 9.0  # interior of the valid region
     assert np.all(out[1, 0] != 0)
+
+
+def test_bilateral_slice_constant_grid():
+    """A grid holding the same affine transform in every cell must reduce
+    to that exact per-pixel affine map (reference kernel semantics)."""
+    N, Cin, Cout, H, W = 1, 2, 2, 4, 4
+    gd, gh, gw = 3, 2, 2
+    A = rng.rand(Cout, Cin).astype(np.float32)
+    b = rng.rand(Cout).astype(np.float32)
+    stride = Cin + 1
+    grid = np.zeros((N, Cout * stride, gd, gh, gw), np.float32)
+    for o in range(Cout):
+        for i in range(Cin):
+            grid[0, o * stride + i] = A[o, i]
+        grid[0, o * stride + Cin] = b[o]
+    x = rng.rand(N, Cin, H, W).astype(np.float32)
+    guide = rng.rand(N, H, W).astype(np.float32)
+    out = ops.bilateral_slice(paddle.to_tensor(x), paddle.to_tensor(guide),
+                              paddle.to_tensor(grid), has_offset=True)
+    want = np.einsum("oi,nihw->nohw", A, x) + b[None, :, None, None]
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_bilateral_slice_grads_flow():
+    N, Cin, H, W = 1, 1, 3, 3
+    gd, gh, gw = 2, 2, 2
+    grid = paddle.to_tensor(rng.rand(N, 2, gd, gh, gw).astype(np.float32))
+    grid.stop_gradient = False
+    x = paddle.to_tensor(rng.rand(N, Cin, H, W).astype(np.float32))
+    guide = paddle.to_tensor(rng.rand(N, H, W).astype(np.float32))
+    out = ops.bilateral_slice(x, guide, grid, has_offset=True)
+    out.sum().backward()
+    assert grid.grad is not None
+    assert float(abs(grid.grad.numpy()).sum()) > 0

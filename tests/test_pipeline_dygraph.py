@@ -108,3 +108,26 @@ class TestScheduleLiveness:
         b_order = [m for k, m in pp._last_schedule if k == "B"]
         assert f_order == sorted(f_order)
         assert b_order == sorted(b_order)  # oldest-first backward
+
+
+def test_param_size_segmentation_balances_stages():
+    """seg_method='param_size': boundaries at the quantiles of cumulative
+    parameter counts, so a fat embedding doesn't share a stage with half
+    the blocks (reference: later-release SegmentLayers param balancing)."""
+    import numpy as np
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                            PipelineLayer)
+
+    descs = [LayerDesc(nn.Linear, 4, 400),   # fat
+             LayerDesc(nn.Linear, 4, 4),
+             LayerDesc(nn.Linear, 4, 4),
+             LayerDesc(nn.Linear, 4, 4)]
+    pp = PipelineLayer(descs, num_stages=2, seg_method="param_size")
+    s0 = pp.get_stage_layers(0)
+    s1 = pp.get_stage_layers(1)
+    assert len(s0) == 1 and len(s1) == 3  # fat layer alone on stage 0
+
+    import pytest
+    with pytest.raises(ValueError, match="unknown seg_method"):
+        PipelineLayer(descs, num_stages=2, seg_method="typo")
