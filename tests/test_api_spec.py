@@ -1,0 +1,41 @@
+"""API-freeze tooling (reference: tools/print_signatures.py +
+check_api_compatible.py gating CI on paddle/fluid/API.spec)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_surface_matches_spec():
+    """The committed API.spec must match the live surface — a failing run
+    means an API was removed/changed without refreshing the spec."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_api_compatible.py")],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+
+
+def test_checker_flags_removal(tmp_path):
+    spec = os.path.join(REPO, "API.spec")
+    with open(spec) as f:
+        lines = f.readlines()
+    # a fake frozen entry that no longer exists must fail the gate
+    fake = "paddle_tpu.definitely_removed_api function(x)\n"
+    alt = tmp_path / "API.spec"
+    alt.write_text("".join(lines) + fake)
+    code = (
+        "import sys, importlib\n"
+        f"sys.path.insert(0, {os.path.join(REPO, 'tools')!r})\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import print_signatures, check_api_compatible\n"
+        f"print_signatures.SPEC_PATH = {str(alt)!r}\n"
+        f"check_api_compatible.SPEC_PATH = {str(alt)!r}\n"
+        "sys.exit(check_api_compatible.main())\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, cwd=REPO, timeout=300,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 1, r.stdout[-2000:]
+    assert "REMOVED" in r.stdout

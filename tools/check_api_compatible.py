@@ -1,0 +1,58 @@
+"""API compatibility gate (reference: `tools/check_api_compatible.py` —
+CI fails when the public API surface drifts from the frozen API.spec
+without the spec being updated in the same change).
+
+Usage: python tools/check_api_compatible.py
+Exit 0 = surface matches API.spec; exit 1 = drift (removed or changed
+entries are breaking; additions are listed but allowed — refresh the spec
+with `python tools/print_signatures.py --write`).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from print_signatures import SPEC_PATH, collect  # noqa: E402
+
+
+def main():
+    if not os.path.exists(SPEC_PATH):
+        print("API.spec missing — generate it with "
+              "`python tools/print_signatures.py --write`")
+        return 1
+    with open(SPEC_PATH) as f:
+        frozen = set(line.rstrip("\n") for line in f if line.strip())
+    current = set(collect())
+
+    def key(line):
+        return line.split(" ", 1)[0]
+
+    frozen_by_key = {key(ln): ln for ln in frozen}
+    current_by_key = {key(ln): ln for ln in current}
+
+    removed = sorted(set(frozen_by_key) - set(current_by_key))
+    added = sorted(set(current_by_key) - set(frozen_by_key))
+    changed = sorted(k for k in set(frozen_by_key) & set(current_by_key)
+                     if frozen_by_key[k] != current_by_key[k])
+
+    for k in removed:
+        print(f"REMOVED  {frozen_by_key[k]}")
+    for k in changed:
+        print(f"CHANGED  {frozen_by_key[k]}")
+        print(f"     ->  {current_by_key[k]}")
+    for k in added:
+        print(f"added    {current_by_key[k]}")
+
+    if removed or changed:
+        print(f"\nAPI drift: {len(removed)} removed, {len(changed)} "
+              f"changed (breaking). If intentional, refresh the spec: "
+              f"python tools/print_signatures.py --write")
+        return 1
+    print(f"API surface compatible ({len(current)} entries, "
+          f"{len(added)} new).")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

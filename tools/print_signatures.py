@@ -1,0 +1,101 @@
+"""Print the public API surface as a stable spec (reference:
+`tools/print_signatures.py` — generates paddle/fluid/API.spec, the frozen
+API contract CI diffs against).
+
+Usage:
+    python tools/print_signatures.py             # print to stdout
+    python tools/print_signatures.py --write     # refresh API.spec
+"""
+import argparse
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.nn.initializer",
+    "paddle_tpu.ops",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.optimizer.lr",
+    "paddle_tpu.static",
+    "paddle_tpu.jit",
+    "paddle_tpu.amp",
+    "paddle_tpu.io",
+    "paddle_tpu.metric",
+    "paddle_tpu.linalg",
+    "paddle_tpu.vision.models",
+    "paddle_tpu.vision.transforms",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet",
+    "paddle_tpu.distributed.ps",
+    "paddle_tpu.quantization",
+    "paddle_tpu.sparsity",
+    "paddle_tpu.inference",
+    "paddle_tpu.onnx",
+    "paddle_tpu.incubate",
+    "paddle_tpu.text",
+    "paddle_tpu.hapi",
+]
+
+SPEC_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "API.spec")
+
+
+def _sig_of(obj):
+    try:
+        sig = str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        sig = "(...)"
+    return sig
+
+
+def collect():
+    lines = []
+    for modname in MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError as e:
+            lines.append(f"{modname} IMPORT-ERROR {e}")
+            continue
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append(f"{modname}.{name} class{_sig_of(obj)}")
+                for mname, meth in sorted(vars(obj).items()):
+                    if mname.startswith("_") or not callable(meth):
+                        continue
+                    lines.append(
+                        f"{modname}.{name}.{mname} method"
+                        f"{_sig_of(meth)}")
+            elif callable(obj):
+                lines.append(f"{modname}.{name} function{_sig_of(obj)}")
+    return sorted(set(lines))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", action="store_true",
+                    help="refresh API.spec in place")
+    args = ap.parse_args()
+    lines = collect()
+    text = "\n".join(lines) + "\n"
+    if args.write:
+        with open(SPEC_PATH, "w") as f:
+            f.write(text)
+        print(f"wrote {len(lines)} entries to {SPEC_PATH}")
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main()
