@@ -30,6 +30,7 @@ from .embedding import (SparseEmbedding, distributed_lookup_table,
                         flush_sparse_grads, reset_registry, sparse_tables)
 from .server import OPT_ADAM, OPT_SGD, OPT_SUM, PsServer, TableConfig
 from .trainer import DownpourTrainer, DownpourWorker  # noqa: F401
+from .heter import HeterClient, HeterServer, start_heter_server  # noqa: F401
 
 
 def bind_model(model, communicator, bind_embeddings=True):

@@ -1,0 +1,145 @@
+"""Heterogeneous PS training (reference: `distributed/service/
+heter_client.h:67` / `heter_server.h:151` + `framework/
+heterxpu_trainer.cc` — CPU workers run the sparse/embedding stage and
+exchange ACTIVATIONS with accelerator trainers over RPC
+(SendAndRecvAsync); the trainer runs the dense stage forward+backward and
+returns the activation gradients).
+
+TPU analog: the worker (host) pulls sparse rows from the PS, computes the
+embedding stage, ships activations to the trainer process (TPU) over a
+length-prefixed socket channel, receives d(loss)/d(activations) back,
+completes the sparse backward, and pushes grads to the PS. The trainer
+owns the dense parameters and updates them locally per batch.
+"""
+import io
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["HeterServer", "HeterClient", "start_heter_server"]
+
+
+def _send_arrays(sock, arrays):
+    buf = io.BytesIO()
+    np.savez(buf, **{f"a{i}": np.asarray(a) for i, a in enumerate(arrays)})
+    payload = buf.getvalue()
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_arrays(sock):
+    hdr = _recv_exact(sock, 4)
+    (ln,) = struct.unpack("<I", hdr)
+    buf = io.BytesIO(_recv_exact(sock, ln))
+    with np.load(buf) as z:
+        return [z[f"a{i}"] for i in range(len(z.files))]
+
+
+def _recv_exact(sock, n):
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("heter peer closed connection")
+        out.extend(chunk)
+    return bytes(out)
+
+
+class HeterServer:
+    """Trainer-side endpoint (reference: HeterServer::SendAndRecvAsync
+    handlers). `handler(activations, labels) -> (loss, d_activations)`
+    runs the dense stage forward+backward+update per request."""
+
+    def __init__(self, handler, port=0, host="127.0.0.1"):
+        # loopback by default: the channel is unauthenticated (a reachable
+        # peer could stop the trainer or inject batches); bind wider only
+        # deliberately
+        self.handler = handler
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads = []
+
+    def serve_forever(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not self._stop.is_set():
+                arrays = _recv_arrays(conn)
+                if len(arrays) == 1 and arrays[0].shape == ():  # STOP
+                    _send_arrays(conn, [np.zeros(())])
+                    self._stop.set()
+                    self._sock.close()
+                    return
+                acts, labels = arrays
+                try:
+                    loss, dacts = self.handler(acts, labels)
+                except Exception as e:  # report to the WORKER, not just
+                    # the trainer's stderr: a 1-element error frame the
+                    # client re-raises (the remote failure would otherwise
+                    # surface as an opaque ConnectionError)
+                    _send_arrays(conn, [np.asarray(f"HETER_ERROR: {e}")])
+                    continue
+                _send_arrays(conn, [np.asarray(loss), np.asarray(dacts)])
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        self._sock.close()
+
+
+def start_heter_server(handler, port=0):
+    """Start on a daemon thread; returns (server, port)."""
+    srv = HeterServer(handler, port=port)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.port
+
+
+class HeterClient:
+    """Worker-side channel (reference: HeterClient::SendAndRecvAsync)."""
+
+    def __init__(self, endpoint):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=120)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._mu = threading.Lock()
+
+    def send_and_recv(self, activations, labels):
+        """Ship the embedding-stage output; get (loss, d_activations)."""
+        with self._mu:
+            _send_arrays(self._sock, [activations, labels])
+            arrays = _recv_arrays(self._sock)
+            if len(arrays) == 1:  # trainer-side handler failure
+                raise RuntimeError(str(arrays[0]))
+            loss, dacts = arrays
+            return float(loss), dacts
+
+    def stop_server(self):
+        with self._mu:
+            try:
+                _send_arrays(self._sock, [np.zeros(())])
+                _recv_arrays(self._sock)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        self._sock.close()

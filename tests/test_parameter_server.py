@@ -343,3 +343,110 @@ class TestDownpourTrainer:
         finally:
             Runtime.client.stop_servers()
             srv.stop()
+
+
+class TestPsGeoMultiWorker:
+    def test_geo_two_workers_k4_converge(self):
+        """2 workers, geo delta sync every 4 local steps (the reference
+        GeoCommunicator's actual operating point): both converge."""
+        outs = _run_cluster("geo", 2, extra={"PS_K_STEPS": "4"})
+        for out in outs:
+            ls = _losses(out)
+            assert len(ls) == 200
+            assert np.mean(ls[-10:]) < 0.35 < np.mean(ls[:5])
+
+
+class TestHeterPs:
+    """Heterogeneous PS (reference: heter_client.h:67/heter_server.h:151
+    + heterxpu_trainer.cc): the worker runs the sparse/embedding stage and
+    exchanges activations with a trainer process owning the dense stage;
+    activation grads flow back and sparse grads land on the PS."""
+
+    def test_heter_worker_trainer_pipeline(self):
+        import subprocess
+        import sys as _s
+        import textwrap
+
+        trainer_code = textwrap.dedent("""
+            import jax; jax.config.update('jax_platforms','cpu')
+            import numpy as np
+            import paddle_tpu as paddle
+            from paddle_tpu import nn
+            from paddle_tpu.distributed.ps.heter import HeterServer
+
+            paddle.seed(1)
+            dense = nn.Sequential(nn.Linear(12, 16), nn.ReLU(),
+                                  nn.Linear(16, 1))
+            opt = paddle.optimizer.SGD(parameters=dense.parameters(),
+                                       learning_rate=0.2)
+
+            def handler(acts, labels):
+                a = paddle.to_tensor(acts.astype(np.float32))
+                a.stop_gradient = False
+                logits = dense(a)
+                loss = paddle.nn.functional.\\
+                    binary_cross_entropy_with_logits(
+                        logits, paddle.to_tensor(labels))
+                loss.backward()
+                opt.step(); opt.clear_grad()
+                return float(loss.numpy()), np.asarray(a.grad.numpy())
+
+            srv = HeterServer(handler, port=int(__import__('sys').argv[1]))
+            print("TRAINER_READY", flush=True)
+            srv.serve_forever()
+        """)
+        port = _free_port()
+        trainer = subprocess.Popen(
+            [_s.executable, "-c", trainer_code, str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            cwd=REPO, env=_clean_env())
+        try:
+            line = trainer.stdout.readline()
+            assert "TRAINER_READY" in line, line
+
+            # worker side (this process): PS sparse table + embedding stage
+            import paddle_tpu as paddle
+            from paddle_tpu.distributed import ps
+            from paddle_tpu.distributed.ps import (PsClient, PsServer,
+                                                   TableConfig)
+            from paddle_tpu.distributed.ps.communicator import \
+                AsyncCommunicator
+            from paddle_tpu.distributed.ps.heter import HeterClient
+
+            VOCAB, DIM = 40, 4
+            pss = PsServer([TableConfig(1000, "sparse", DIM, "sgd", lr=0.2,
+                                        init_range=0.1, seed=1000)], port=0)
+            ps_port = pss.start()
+            cli = PsClient([f"127.0.0.1:{ps_port}"])
+            comm = AsyncCommunicator(cli, n_workers=1)
+            emb = ps.SparseEmbedding([VOCAB, DIM], table_id=1000)
+            emb.bind(comm)
+            heter = HeterClient(f"127.0.0.1:{port}")
+
+            w_id = np.random.RandomState(42).randn(VOCAB).astype(np.float32)
+            rng_l = np.random.RandomState(0)
+            losses = []
+            for step in range(150):
+                ids = rng_l.randint(0, VOCAB, (32, 3)).astype(np.int64)
+                labels = (w_id[ids[:, 0]] > 0).astype(
+                    np.float32).reshape(-1, 1)
+                e = emb(paddle.to_tensor(ids))          # sparse stage (host)
+                acts = paddle.ops.reshape(e, [32, 3 * DIM])
+                loss, dacts = heter.send_and_recv(
+                    np.asarray(acts.numpy()), labels)   # dense stage (trainer)
+                acts.backward(paddle.to_tensor(dacts))  # sparse backward
+                from paddle_tpu.distributed.ps.embedding import \
+                    flush_sparse_grads
+                flush_sparse_grads(comm)
+                comm.step()
+                losses.append(loss)
+            assert np.mean(losses[-10:]) < 0.4 < np.mean(losses[:5])
+            assert cli.sparse_size(1000) > 0  # sparse grads reached the PS
+            heter.stop_server()
+            heter.close()
+            comm.stop()
+            cli.stop_servers()
+            pss.stop()
+        finally:
+            if trainer.poll() is None:
+                trainer.kill()

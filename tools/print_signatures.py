@@ -71,12 +71,28 @@ def collect():
                 continue
             if inspect.isclass(obj):
                 lines.append(f"{modname}.{name} class{_sig_of(obj)}")
-                for mname, meth in sorted(vars(obj).items()):
-                    if mname.startswith("_") or not callable(meth):
+                for mname in sorted(dir(obj)):
+                    if mname.startswith("_"):
+                        continue
+                    raw = inspect.getattr_static(obj, mname, None)
+                    # getattr_static sees class/static/plain methods alike
+                    # (callable(classmethod) is False; vars() misses
+                    # inherited methods) — properties freeze as attributes
+                    if isinstance(raw, (classmethod, staticmethod)):
+                        meth = raw.__func__
+                        kind = ("classmethod"
+                                if isinstance(raw, classmethod)
+                                else "staticmethod")
+                    elif inspect.isfunction(raw):
+                        meth, kind = raw, "method"
+                    elif isinstance(raw, property):
+                        lines.append(
+                            f"{modname}.{name}.{mname} property")
+                        continue
+                    else:
                         continue
                     lines.append(
-                        f"{modname}.{name}.{mname} method"
-                        f"{_sig_of(meth)}")
+                        f"{modname}.{name}.{mname} {kind}{_sig_of(meth)}")
             elif callable(obj):
                 lines.append(f"{modname}.{name} function{_sig_of(obj)}")
     return sorted(set(lines))
