@@ -21,10 +21,11 @@ helpers fall back to plain Python dispatch when nothing is traced):
 
 `return` inside `if` branches is lowered by moving the post-if statements
 into the non-returning branch (the reference return_transformer's
-flattening). Not transformed (left as plain Python; traced predicates
-there still fail loudly): loops containing `break`/`continue`/`return`,
-`for` over tensors. The reference's break_continue transformer is the
-model for extending it.
+flattening); `break`/`continue` lower to loop-carried flags with
+post-site guards (the reference break_continue_transformer's scheme).
+Not transformed (left as plain Python; traced predicates there still
+fail loudly): `return` inside loops, `while ... else`, `for` over
+tensors.
 """
 import ast
 import functools
@@ -94,12 +95,20 @@ class _Jst:
 
     @staticmethod
     def convert_while(test_fn, body_fn, args):
-        first = test_fn(*args)
-        if not _is_traced(first):
-            vals = tuple(args)
-            while _to_bool(test_fn(*vals)):
-                vals = tuple(body_fn(*vals))
-            return vals
+        # tracedness is re-probed EVERY iteration: a concrete test (e.g.
+        # `while True:` with a lowered break flag) can turn traced after
+        # the first body run makes the flag a traced bool
+        vals = tuple(args)
+        t = test_fn(*vals)
+        while not _is_traced(t):
+            if not _to_bool(t):
+                return vals
+            vals = tuple(body_fn(*vals))
+            t = test_fn(*vals)
+        return _Jst._traced_while(test_fn, body_fn, vals)
+
+    @staticmethod
+    def _traced_while(test_fn, body_fn, args):
         from ..nn.control_flow import while_loop
         # names unbound at loop entry are per-iteration temps (python
         # would NameError on a genuine read-before-write): exclude them
@@ -218,7 +227,7 @@ def _convert_function(fn):
         return _fn_cache[key]
     try:
         conv = convert_to_static(fn)
-    except (OSError, TypeError, SyntaxError):
+    except (OSError, TypeError, SyntaxError, RecursionError):
         conv = None
     _fn_cache[key] = conv
     return conv
@@ -287,6 +296,56 @@ def _tuple(names, ctx=None):
 
 def _jst_attr(attr):
     return ast.Attribute(value=_name("_jst"), attr=attr, ctx=ast.Load())
+
+
+def _contains_break_continue(stmts):
+    return _contains(stmts, (ast.Break, ast.Continue))
+
+
+def _guard_break_continue(stmts, brk, cont, used):
+    """Rewrite break/continue at THIS loop level into flag assignments;
+    statements after a conditional break/continue are wrapped in an
+    `if not (brk or cont):` guard (the reference
+    break_continue_transformer's flag scheme). Nested loops keep their
+    own break/continue untouched."""
+    def set_flag(name):
+        return ast.Assign(targets=[_name(name, ast.Store())],
+                          value=ast.Constant(True))
+
+    out = []
+    for i, st in enumerate(stmts):
+        if isinstance(st, ast.Break):
+            used.add(brk)
+            out.append(set_flag(brk))
+            return out  # rest is unreachable (python semantics)
+        if isinstance(st, ast.Continue):
+            used.add(cont)
+            out.append(set_flag(cont))
+            return out
+        if isinstance(st, (ast.If, ast.With, ast.Try)) and \
+                _contains_break_continue([st]):
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub:
+                    setattr(st, attr,
+                            _guard_break_continue(sub, brk, cont, used)
+                            or [ast.Pass()])
+            for h in getattr(st, "handlers", []) or []:
+                h.body = _guard_break_continue(h.body, brk, cont, used) \
+                    or [ast.Pass()]
+            out.append(st)
+            rest = _guard_break_continue(stmts[i + 1:], brk, cont, used)
+            if rest:
+                # only reference flags that some branch actually sets
+                names = [_name(n) for n in (brk, cont) if n in used]
+                flags = (names[0] if len(names) == 1
+                         else ast.BoolOp(op=ast.Or(), values=names))
+                out.append(ast.If(
+                    test=ast.UnaryOp(op=ast.Not(), operand=flags),
+                    body=rest, orelse=[]))
+            return out
+        out.append(st)
+    return out
 
 
 def _make_fdef(name, args, body):
@@ -426,11 +485,42 @@ class _Transformer(ast.NodeTransformer):
         return prologue + [t_def, f_def, assign]
 
     # -- while ------------------------------------------------------------
-    def visit_While(self, node):
+    def visit_While(self, node, tail_stmts=None):
+        if node.orelse or _contains(node.body, (ast.Return,)):
+            self.generic_visit(node)
+            return node  # while-else / return-in-loop: plain python
+        if _contains_break_continue(node.body):
+            uid_f = self._uid()
+            brk = f"_jst_brk_{uid_f}"
+            cont = f"_jst_cont_{uid_f}"
+            used = set()
+            body = _guard_break_continue(list(node.body), brk, cont, used)
+            if _contains_break_continue(body):
+                # a construct the rewrite can't reach still holds a raw
+                # break/continue: leave the loop as plain python rather
+                # than recursing forever
+                node.body = node.body + list(tail_stmts or [])
+                self.generic_visit(node)
+                return node
+            prologue = []
+            if cont in used:
+                # continue resets every iteration; `tail_stmts` (the
+                # for-lowering's index increment) must still run
+                body = [ast.Assign(targets=[_name(cont, ast.Store())],
+                                   value=ast.Constant(False))] + body
+            if brk in used:
+                prologue.append(ast.Assign(
+                    targets=[_name(brk, ast.Store())],
+                    value=ast.Constant(False)))
+                node.test = ast.BoolOp(
+                    op=ast.And(),
+                    values=[ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                            node.test])
+            node.body = body + list(tail_stmts or [])
+            res = self.visit_While(node)
+            return prologue + (res if isinstance(res, list) else [res])
+        node.body = node.body + list(tail_stmts or [])
         self.generic_visit(node)
-        if node.orelse or _contains(node.body, (ast.Break, ast.Continue)) \
-                or _contains(node.body, (ast.Return,)):
-            return node  # v1 scope: leave as plain python
         names = _assigned_names(node.body)
         # names read by the test that are assigned in the body are already
         # included; other test names are loop-invariant closures
@@ -460,7 +550,6 @@ class _Transformer(ast.NodeTransformer):
                 and isinstance(node.iter.func, ast.Name)
                 and node.iter.func.id == "range"
                 and isinstance(node.target, ast.Name)
-                and not _contains(node.body, (ast.Break, ast.Continue))
                 and not _contains(node.body, (ast.Return,))):
             uid = self._uid()
             i = node.target.id
@@ -490,12 +579,11 @@ class _Transformer(ast.NodeTransformer):
                               value=_name(it_name))
             inc = ast.AugAssign(target=_name(it_name, ast.Store()),
                                 op=ast.Add(), value=_name(step_name))
-            loop = ast.While(test=test, body=[bind] + node.body + [inc],
-                             orelse=[])
-            out = []
-            for stmt in init:
-                out.append(stmt)
-            res = self.visit_While(loop)
+            # inc is an UNGUARDED tail: `continue` must still advance
+            # the induction variable (python for semantics)
+            loop = ast.While(test=test, body=[bind] + node.body, orelse=[])
+            out = list(init)
+            res = self.visit_While(loop, tail_stmts=[inc])
             out.extend(res if isinstance(res, list) else [res])
             return out
         self.generic_visit(node)

@@ -217,3 +217,103 @@ def test_for_negative_step_and_loop_var_semantics():
 
     g = convert_to_static(f)
     assert g(0) == f(0) == (15, 1)
+
+
+def test_while_with_break_traced():
+    """Conditional `break` in a traced while: lowered to a loop-carried
+    flag (reference: break_continue_transformer)."""
+    def f(x):
+        i = paddle.to_tensor(0)
+        acc = x * 0.0
+        while i < 10:
+            acc = acc + x
+            if acc.sum() > 2.5:
+                break
+            i = i + 1
+        return acc.sum(), i
+
+    x = paddle.to_tensor(np.ones(1, np.float32))
+    with paddle.no_grad():
+        ev, ei = f(x)
+        static = paddle.jit.to_static(f)
+        gv, gi = static(x)
+    assert float(gv.numpy()) == float(ev.numpy()) == 3.0
+    assert int(gi.numpy()) == int(ei.numpy()) == 2
+
+
+def test_for_with_continue_traced():
+    def f(x):
+        acc = x * 0.0
+        n = paddle.to_tensor(6)
+        for i in range(n):
+            if i % 2 == 1:
+                continue
+            acc = acc + x * float(1.0)
+        return acc.sum()
+
+    # NOTE: `i % 2 == 1` over the traced induction var is a traced pred;
+    # the continue lowers to a cont-flag guard inside the loop body
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    with paddle.no_grad():
+        ev = f(x)
+        static = paddle.jit.to_static(f)
+        gv = static(x)
+    np.testing.assert_allclose(float(gv.numpy()), float(ev.numpy()))
+    assert float(gv.numpy()) == 6.0  # 3 even iterations x sum(x)=2
+
+
+def test_break_continue_eager_semantics():
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(n):
+        total = 0
+        for i in range(n):
+            if i == 2:
+                continue
+            if i == 5:
+                break
+            total += i
+        return total, i
+
+    g = convert_to_static(f)
+    assert g(8) == f(8) == (1 + 3 + 4, 5)
+    assert g(2) == f(2)
+
+
+def test_while_true_with_traced_break():
+    """Concrete `while True:` whose ONLY exit is a traced break: the
+    eager dispatch must hand over to lax lowering once the lowered break
+    flag turns traced (review regression)."""
+    def f(x):
+        acc = x * 0.0
+        while True:
+            acc = acc + x
+            if acc.sum() > 2.5:
+                break
+        return acc.sum()
+
+    x = paddle.to_tensor(np.ones(1, np.float32))
+    with paddle.no_grad():
+        ev = float(f(x).numpy())
+        static = paddle.jit.to_static(f)
+        gv = float(static(x).numpy())
+    assert gv == ev == 3.0
+
+
+def test_break_inside_with_does_not_recurse():
+    """break under a `with` in the loop body must either transform or
+    degrade to plain python — never RecursionError (review regression)."""
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(n):
+        import contextlib
+        total = 0
+        for i in range(n):
+            with contextlib.nullcontext():
+                if i == 3:
+                    break
+                total += i
+        return total
+
+    g = convert_to_static(f)
+    assert g(6) == f(6) == 0 + 1 + 2
