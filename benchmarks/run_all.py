@@ -155,9 +155,12 @@ def bench_allreduce():
         float(f(x)[0])
         dt = (time.perf_counter() - t0) / K
         bw = 2 * nbytes / dt / 1e9
-        return {"metric": "allreduce_bus_bw_GBps", "value": round(bw, 1),
+        # honest name: on one chip this measures HBM read+write, NOT the
+        # interconnect bus bandwidth BASELINE.md's metric refers to
+        return {"metric": "allreduce_1chip_hbm_GBps", "value": round(bw, 1),
                 "unit": "GB/s", "backend": jax.default_backend(),
-                "devices": 1, "note": "single device: HBM r/w bound"}
+                "devices": 1, "note": "single device: HBM r/w bound; not "
+                "comparable to the multi-chip allreduce_bus_bw_GBps metric"}
     from jax.sharding import PartitionSpec as P
     import paddle_tpu.distributed as dist
     mesh = dist.make_mesh({"dp": n})

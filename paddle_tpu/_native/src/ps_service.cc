@@ -17,8 +17,13 @@
 //   - table snapshots            (reference: the_one_ps.py:815 save_persistables)
 //
 // Wire format (little-endian):
-//   request : u32 body_len | u8 op | u32 table | u64 n | payload
+//   request : u32 body_len | u32 magic("PTS1") | u8 op | u32 table | u64 n
+//             | payload                         (body_len counts from magic)
 //   response: u32 body_len | payload
+// The magic word doubles as a protocol version; it is read and checked
+// BEFORE the body is allocated, so a stray peer (port collision, HTTP
+// probe, garbage) cannot drive an attacker-controlled resize — the
+// connection drops before any payload is interpreted or buffered.
 // The Python client (paddle_tpu/distributed/ps/client.py) shards sparse keys
 // across servers by key % nservers and dense tables by table % nservers.
 
@@ -60,6 +65,9 @@ enum Op : uint8_t {
 };
 
 enum OptKind : int32_t { kOptSum = 0, kOptSgd = 1, kOptAdam = 2 };
+
+constexpr uint32_t kMagic = 0x31535450u;  // "PTS1"
+constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB frame cap (sanity bound)
 
 struct OptConf {
   int32_t kind = kOptSgd;
@@ -136,16 +144,23 @@ struct DenseTable {
   bool initialized = false;
   std::mutex mu;
 
-  void ensure(int n) {
-    if ((int)param.size() != n) param.assign(n, 0.0f);
-    if (opt.kind == kOptAdam && (int)m.size() != n) {
-      m.assign(n, 0.0f);
-      v.assign(n, 0.0f);
+  // Grows only from empty: a size mismatch against a live table is a
+  // client bug, and silently re-zeroing would destroy trained state —
+  // the caller replies ok=0 so the client raises.
+  bool ensure(size_t n) {
+    if (param.empty() && n > 0)
+      param.assign(n, 0.0f);
+    else if (param.size() != n)
+      return false;
+    if (opt.kind == kOptAdam && m.size() != param.size()) {
+      m.assign(param.size(), 0.0f);
+      v.assign(param.size(), 0.0f);
     }
+    return true;
   }
 
-  void apply_grad(const float* g, int n) {
-    ensure(n);
+  bool apply_grad(const float* g, int n) {
+    if (!ensure(n)) return false;
     switch (opt.kind) {
       case kOptSum:
         for (int i = 0; i < n; ++i) param[i] += g[i];
@@ -165,6 +180,7 @@ struct DenseTable {
         break;
       }
     }
+    return true;
   }
 };
 
@@ -184,6 +200,8 @@ struct PsServer {
   std::atomic<bool> running{false};
   std::thread accept_thread;
   std::vector<std::thread> conns;
+  std::vector<int> conn_fds;  // parallel to conns; -1 once the handler
+                              // has closed its socket (guarded by conns_mu)
   std::mutex conns_mu;
 };
 
@@ -331,7 +349,7 @@ bool load_tables(PsServer* ps, const std::string& path) {
   return ok;
 }
 
-void handle_conn(PsServer* ps, int fd) {
+void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   std::vector<char> body;
@@ -339,16 +357,32 @@ void handle_conn(PsServer* ps, int fd) {
   while (ps->running.load()) {
     uint32_t blen;
     if (!read_all(fd, &blen, 4)) break;
-    body.resize(blen);
-    if (blen && !read_all(fd, body.data(), blen)) break;
-    if (blen < 13) break;
+    if (blen < 17 || blen > kMaxFrame) break;  // malformed length: drop
+    uint32_t magic;
+    if (!read_all(fd, &magic, 4)) break;
+    if (magic != kMagic) break;  // wrong protocol/version: drop connection
+    body.resize(blen - 4);  // rest of the body, now known to be ours
+    if (!read_all(fd, body.data(), blen - 4)) break;
     uint8_t op = (uint8_t)body[0];
     uint32_t table;
     uint64_t n;
     memcpy(&table, body.data() + 1, 4);
     memcpy(&n, body.data() + 5, 8);
     const char* payload = body.data() + 13;
-    size_t psize = blen - 13;
+    size_t psize = blen - 17;
+
+    // Validate sparse payload sizes against the header count before any
+    // table access: a truncated/corrupt frame must not cause out-of-bounds
+    // reads (keys are n*8 bytes; pushes carry n*dim*4 grad bytes after).
+    if (op == kPullSparse || op == kPushSparseGrad ||
+        op == kPushSparseDelta) {
+      SparseTable* tp = find_sparse(ps, table);
+      uint64_t dim = tp ? (uint64_t)tp->dim : 0;
+      bool bad = n > psize / 8;
+      if (!bad && op != kPullSparse && dim > 0)
+        bad = n > (psize - n * 8) / (dim * 4);
+      if (bad) break;  // drop the connection
+    }
 
     if (op == kStop) {
       uint32_t ok = 1;
@@ -388,14 +422,17 @@ void handle_conn(PsServer* ps, int fd) {
         DenseTable& t = *tp;
         std::lock_guard<std::mutex> lk(t.mu);
         size_t cnt = psize / 4;
-        if (op == kPushDenseDelta) {
-          t.ensure(cnt);
-          const float* d = (const float*)payload;
-          for (size_t i = 0; i < cnt; ++i) t.param[i] += d[i];
-        } else {
-          t.apply_grad((const float*)payload, cnt);
-        }
         uint32_t ok = 1;
+        if (op == kPushDenseDelta) {
+          if (!t.ensure(cnt)) {
+            ok = 0;  // size mismatch on a live table: reject, don't zero
+          } else {
+            const float* d = (const float*)payload;
+            for (size_t i = 0; i < cnt; ++i) t.param[i] += d[i];
+          }
+        } else if (!t.apply_grad((const float*)payload, cnt)) {
+          ok = 0;
+        }
         send_resp(fd, &ok, 4);
         break;
       }
@@ -482,7 +519,11 @@ void handle_conn(PsServer* ps, int fd) {
       }
     }
   }
+  // Close under conns_mu and mark the slot so pt_ps_stop never calls
+  // shutdown() on a recycled fd number.
+  std::lock_guard<std::mutex> lk(ps->conns_mu);
   close(fd);
+  if (conn_idx < ps->conn_fds.size()) ps->conn_fds[conn_idx] = -1;
 }
 
 void accept_loop(PsServer* ps) {
@@ -496,7 +537,14 @@ void accept_loop(PsServer* ps) {
       break;
     }
     std::lock_guard<std::mutex> lk(ps->conns_mu);
-    ps->conns.emplace_back(handle_conn, ps, fd);
+    // Reap finished handlers first: client reconnect-with-backoff makes
+    // connection churn routine, and an unjoined thread pins its stack.
+    // Joined slots stay as cheap tombstones so conn_idx stays stable.
+    for (size_t i = 0; i < ps->conns.size(); ++i)
+      if (ps->conn_fds[i] == -1 && ps->conns[i].joinable())
+        ps->conns[i].join();
+    ps->conn_fds.push_back(fd);
+    ps->conns.emplace_back(handle_conn, ps, fd, ps->conn_fds.size() - 1);
   }
   // wake any barrier waiters so their conns can exit
   {
@@ -591,11 +639,29 @@ PT_API void pt_ps_stop() {
   if (ps->accept_thread.joinable()) ps->accept_thread.join();
   close(ps->listen_fd);
   ps->listen_fd = -1;
+  // A handler blocked in read_all() on a still-open client socket would
+  // block join() forever; shutdown() every live conn fd first so those
+  // reads return 0 and the handlers exit.
   {
     std::lock_guard<std::mutex> lk(ps->conns_mu);
-    for (auto& t : ps->conns)
-      if (t.joinable()) t.join();
-    ps->conns.clear();
+    for (int cfd : ps->conn_fds)
+      if (cfd >= 0) shutdown(cfd, SHUT_RDWR);
+  }
+  {
+    std::lock_guard<std::mutex> lk(ps->barrier.mu);
+    ps->barrier.cv.notify_all();  // release any barrier waiters
+  }
+  std::vector<std::thread> conns;
+  {
+    std::lock_guard<std::mutex> lk(ps->conns_mu);
+    conns.swap(ps->conns);  // join without holding conns_mu (handlers
+                            // take it to close their fds on exit)
+  }
+  for (auto& t : conns)
+    if (t.joinable()) t.join();
+  {
+    std::lock_guard<std::mutex> lk(ps->conns_mu);
+    ps->conn_fds.clear();
   }
 }
 

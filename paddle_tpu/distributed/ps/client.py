@@ -9,8 +9,11 @@ format of `_native/src/ps_service.cc`.
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
+
+MAGIC = 0x31535450  # b"PTS1": protocol magic/version (ps_service.cc kMagic)
 
 OP_PULL_DENSE = 1
 OP_PUSH_DENSE_GRAD = 2
@@ -27,7 +30,19 @@ OP_PULL_DENSE_INIT = 12
 
 
 class PsClient:
-    """One client per worker process; thread-safe per-server sockets."""
+    """One client per worker process; thread-safe per-server sockets.
+
+    Failure handling (reference: `brpc_ps_client.cc` retries connects under
+    FLAGS_pserver_connect_timeout_ms): connects retry with backoff so a
+    worker survives a server restart; *pull*-family calls are idempotent
+    and are re-sent over a fresh connection; *push*-family calls are NOT
+    (a re-sent grad could be applied twice) and abort loudly instead —
+    recovery for those is snapshot restore, as in the reference.
+    """
+
+    CONNECT_RETRIES = 60
+    CONNECT_BACKOFF = 0.25  # seconds between connect attempts (~15s window)
+    CALL_RETRIES = 5        # re-sends for idempotent calls
 
     def __init__(self, endpoints):
         self.endpoints = list(endpoints)
@@ -51,20 +66,65 @@ class PsClient:
     def _sock(self, i):
         if self._socks[i] is None:
             host, port = self.endpoints[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=120)
+            last = None
+            for _ in range(self.CONNECT_RETRIES):
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=120)
+                    break
+                except OSError as e:
+                    last = e
+                    time.sleep(self.CONNECT_BACKOFF)
+            else:
+                raise ConnectionError(
+                    f"ps server {self.endpoints[i]} unreachable after "
+                    f"{self.CONNECT_RETRIES} connect attempts") from last
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks[i] = s
         return self._socks[i]
 
-    def _call(self, server, op, table, n, payload=b""):
-        body = struct.pack("<BIQ", op, table, n) + payload
+    def _drop_sock(self, i):
+        s, self._socks[i] = self._socks[i], None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _call(self, server, op, table, n, payload=b"", idempotent=False):
+        body = struct.pack("<IBIQ", MAGIC, op, table, n) + payload
         msg = struct.pack("<I", len(body)) + body
         with self._locks[server]:
-            s = self._sock(server)
-            s.sendall(msg)
-            hdr = self._recv_exact(s, 4)
-            (rlen,) = struct.unpack("<I", hdr)
-            return self._recv_exact(s, rlen) if rlen else b""
+            attempts = self.CALL_RETRIES if idempotent else 1
+            last = None
+            for a in range(attempts):
+                try:
+                    s = self._sock(server)
+                except (ConnectionError, OSError) as e:
+                    # connect failed after its own retry window: nothing was
+                    # ever transmitted, so this is safe to retry verbatim —
+                    # say so instead of prescribing a snapshot rollback
+                    raise ConnectionError(
+                        f"ps server {self.endpoints[server]} unreachable; "
+                        f"request (op={op}) was never sent and is safe to "
+                        f"retry once the server is back") from e
+                try:
+                    s.sendall(msg)
+                    hdr = self._recv_exact(s, 4)
+                    (rlen,) = struct.unpack("<I", hdr)
+                    return self._recv_exact(s, rlen) if rlen else b""
+                except (ConnectionError, OSError) as e:
+                    last = e
+                    self._drop_sock(server)
+            if idempotent:
+                raise ConnectionError(
+                    f"ps server {self.endpoints[server]} lost after "
+                    f"{attempts} attempts: {last}") from last
+            raise ConnectionError(
+                f"connection to ps server {self.endpoints[server]} dropped "
+                f"mid-push (op={op}): refusing to re-send a non-idempotent "
+                f"update (it may already have been applied); restore from "
+                f"the last snapshot") from last
 
     @staticmethod
     def _recv_exact(s, n):
@@ -81,7 +141,8 @@ class PsClient:
         return table % self.n_servers
 
     def pull_dense(self, table):
-        raw = self._call(self._dense_server(table), OP_PULL_DENSE, table, 0)
+        raw = self._call(self._dense_server(table), OP_PULL_DENSE, table, 0,
+                         idempotent=True)
         return np.frombuffer(raw, np.float32).copy()
 
     def pull_dense_init(self, table, init_values):
@@ -89,7 +150,7 @@ class PsClient:
         (worker-0 initialization handoff, reference: communicator init)."""
         payload = np.ascontiguousarray(init_values, np.float32).tobytes()
         raw = self._call(self._dense_server(table), OP_PULL_DENSE_INIT,
-                         table, 0, payload)
+                         table, 0, payload, idempotent=True)
         return np.frombuffer(raw, np.float32).copy()
 
     def push_dense_grad(self, table, grad):
@@ -109,7 +170,8 @@ class PsClient:
         if len(raw) != 4 or struct.unpack("<I", raw)[0] != 1:
             raise RuntimeError(
                 f"ps server rejected push for table {table} (not "
-                f"registered on the server, or snapshot load failed?)")
+                f"registered on the server, value size does not match the "
+                f"live table, or snapshot load failed?)")
 
     # -- sparse -----------------------------------------------------------
     def pull_sparse(self, table, keys):
@@ -118,7 +180,7 @@ class PsClient:
         out = np.empty((keys.size, dim), np.float32)
         for srv, idx in self._shard(keys):
             raw = self._call(srv, OP_PULL_SPARSE, table, idx.size,
-                             keys[idx].tobytes())
+                             keys[idx].tobytes(), idempotent=True)
             if len(raw) != idx.size * dim * 4:
                 raise RuntimeError(
                     f"sparse table {table} pull returned {len(raw)} bytes, "
@@ -165,7 +227,7 @@ class PsClient:
     def save(self, path_prefix):
         for i in range(self.n_servers):
             raw = self._call(i, OP_SAVE, 0, 0,
-                             f"{path_prefix}.{i}".encode())
+                             f"{path_prefix}.{i}".encode(), idempotent=True)
             if struct.unpack("<I", raw)[0] != 1:
                 raise RuntimeError(
                     f"ps server {i} failed to write snapshot "
@@ -174,7 +236,7 @@ class PsClient:
     def load(self, path_prefix):
         for i in range(self.n_servers):
             raw = self._call(i, OP_LOAD, 0, 0,
-                             f"{path_prefix}.{i}".encode())
+                             f"{path_prefix}.{i}".encode(), idempotent=True)
             if struct.unpack("<I", raw)[0] != 1:
                 raise RuntimeError(
                     f"ps server {i} failed to load snapshot "
@@ -183,7 +245,7 @@ class PsClient:
     def sparse_size(self, table):
         total = 0
         for i in range(self.n_servers):
-            raw = self._call(i, OP_SPARSE_SIZE, table, 0)
+            raw = self._call(i, OP_SPARSE_SIZE, table, 0, idempotent=True)
             total += struct.unpack("<Q", raw)[0]
         return total
 

@@ -20,17 +20,23 @@ import numpy as np
 
 __all__ = ["HeterServer", "HeterClient", "start_heter_server"]
 
+_MAGIC = 0x31485450  # b"PTH1": frame magic/version word
+
 
 def _send_arrays(sock, arrays):
     buf = io.BytesIO()
     np.savez(buf, **{f"a{i}": np.asarray(a) for i, a in enumerate(arrays)})
     payload = buf.getvalue()
-    sock.sendall(struct.pack("<I", len(payload)) + payload)
+    sock.sendall(struct.pack("<II", _MAGIC, len(payload)) + payload)
 
 
 def _recv_arrays(sock):
-    hdr = _recv_exact(sock, 4)
-    (ln,) = struct.unpack("<I", hdr)
+    hdr = _recv_exact(sock, 8)
+    magic, ln = struct.unpack("<II", hdr)
+    if magic != _MAGIC:
+        raise ConnectionError(
+            f"bad heter frame magic {magic:#010x} (expected {_MAGIC:#010x} "
+            f"— protocol version mismatch or stray peer)")
     buf = io.BytesIO(_recv_exact(sock, ln))
     with np.load(buf) as z:
         return [z[f"a{i}"] for i in range(len(z.files))]

@@ -189,7 +189,10 @@ jst = _Jst()
 # callee conversion (reference: convert_call_func.py convert_call)
 # ---------------------------------------------------------------------------
 
-_fn_cache = {}  # code object id -> transformed function factory
+# keyed on the code OBJECT (not id(): a collected code object's id can be
+# reused, which would hand an unrelated function a stale transform); the
+# cache entry also keeps the code object alive, making the key stable
+_fn_cache = {}  # code object -> transformed function (or None)
 
 
 def _convert_callee(f):
@@ -222,7 +225,7 @@ def _convert_function(fn):
     if mod.split(".")[0] in [p.split(".")[0] for p in _SKIP_MODULE_PREFIXES] \
             or any(mod.startswith(p) for p in _SKIP_MODULE_PREFIXES):
         return None
-    key = id(fn.__code__)
+    key = fn.__code__
     if key in _fn_cache:
         return _fn_cache[key]
     try:

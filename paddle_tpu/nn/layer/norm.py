@@ -165,10 +165,3 @@ class LocalResponseNorm(Layer):
     def forward(self, x):
         return F.local_response_norm(x, self.size, self.alpha, self.beta,
                                      self.k, self.data_format)
-
-
-class SpectralNorm(Layer):
-    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
-                 name=None):
-        super().__init__()
-        raise NotImplementedError("SpectralNorm planned for a later round")

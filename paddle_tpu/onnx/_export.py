@@ -222,13 +222,17 @@ def _convert_eqn(g, eqn):  # noqa: C901 — one dispatch table, kept flat
         g.add_node(_ELEMENTWISE[prim], ins, outs)
         return bind_outs()
     if prim == "integer_pow":
-        e = g.const(np.asarray(eqn.params["y"], np.float32), "exp")
+        # constant must match the operand dtype — strict ONNX checkers
+        # reject Pow with mixed input element types
+        dt = np.dtype(eqn.invars[0].aval.dtype)
+        e = g.const(np.asarray(eqn.params["y"], dt), "exp")
         g.add_node("Pow", [ins[0], e], outs)
         return bind_outs()
     if prim == "rsqrt":
         mid = g.fresh("sqrt")
         g.add_node("Sqrt", ins, [mid])
-        one = g.const(np.asarray(1.0, np.float32), "one")
+        dt = np.dtype(eqn.invars[0].aval.dtype)
+        one = g.const(np.asarray(1.0, dt), "one")
         g.add_node("Div", [one, mid], outs)
         return bind_outs()
     if prim in _COMPARE:

@@ -452,6 +452,111 @@ class TestHeterPs:
                 trainer.kill()
 
 
+_KILL_SERVER_SCRIPT = """
+import sys, time
+import jax; jax.config.update('jax_platforms', 'cpu')
+from paddle_tpu.distributed.ps import PsServer, TableConfig
+tables = [TableConfig(1000, "sparse", 4, "adam", lr=0.05, init_range=0.1,
+                      seed=7),
+          TableConfig(0, "dense", 0, "adam", lr=0.05)]
+srv = PsServer(tables, port=int(sys.argv[1]))
+srv.start()
+print("SERVER_READY", flush=True)
+srv.run()
+"""
+
+
+class TestPsServerKillFaultInjection:
+    """Server-side fault injection (reference: brpc_ps_client.cc connect
+    retry under FLAGS_pserver_connect_timeout_ms): SIGKILL a pserver
+    mid-training, bring up a replacement on the same port, and the worker
+    — same PsClient object, never rebuilt — reconnects and resumes from
+    the last snapshot. Complements test_launch_elastic_ckpt.py, which
+    kills a *worker*."""
+
+    def _spawn_server(self, port):
+        srv = subprocess.Popen(
+            [sys.executable, "-c", _KILL_SERVER_SCRIPT, str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_clean_env(), cwd=REPO)
+        line = srv.stdout.readline()
+        assert "SERVER_READY" in line, line + srv.stderr.read()[-2000:]
+        return srv
+
+    def test_worker_reconnects_and_resumes_after_sigkill(self, tmp_path):
+        from paddle_tpu.distributed.ps import PsClient
+        port = _free_port()
+        snap = str(tmp_path / "kill_snap")
+        srv = self._spawn_server(port)
+        srv2 = None
+        cli = PsClient([f"127.0.0.1:{port}"])
+        try:
+            cli.register_sparse(1000, 4)
+            cli.register_dense(0, 6)
+            keys = np.array([2, 5, 11], np.uint64)
+            rng = np.random.RandomState(3)
+            cli.pull_dense_init(0, np.zeros(6, np.float32))
+            for _ in range(4):
+                cli.push_sparse_grad(1000, keys,
+                                     rng.rand(3, 4).astype(np.float32))
+                cli.push_dense_grad(0, rng.rand(6).astype(np.float32))
+            cli.save(snap)
+            trained_sparse = cli.pull_sparse(1000, keys)
+            trained_dense = cli.pull_dense(0)
+
+            srv.kill()  # SIGKILL: no graceful shutdown, sockets just die
+            srv.wait(timeout=30)
+            srv2 = self._spawn_server(port)
+
+            # the SAME client object reconnects: the first pull rides the
+            # idempotent retry path over a fresh socket
+            fresh = cli.pull_sparse(1000, keys)
+            assert not np.allclose(fresh, trained_sparse), \
+                "replacement server unexpectedly has trained state"
+            cli.load(snap)
+            np.testing.assert_allclose(cli.pull_sparse(1000, keys),
+                                       trained_sparse)
+            np.testing.assert_allclose(cli.pull_dense(0), trained_dense)
+            # and training continues against the replacement
+            cli.push_dense_grad(0, rng.rand(6).astype(np.float32))
+            assert not np.allclose(cli.pull_dense(0), trained_dense)
+        finally:
+            try:
+                cli.stop_servers()
+            except (ConnectionError, OSError):
+                pass
+            cli.close()
+            for p in (srv, srv2):
+                if p is not None and p.poll() is None:
+                    p.kill()
+
+    def test_push_after_kill_aborts_loudly(self):
+        """A dropped connection mid-push must NOT be silently re-sent (a
+        duplicate grad would be applied twice); the client aborts with an
+        actionable message."""
+        from paddle_tpu.distributed.ps import PsClient
+        port = _free_port()
+        srv = self._spawn_server(port)
+        cli = PsClient([f"127.0.0.1:{port}"])
+        cli.CONNECT_RETRIES = 3
+        cli.CONNECT_BACKOFF = 0.05
+        try:
+            cli.register_dense(0, 6)
+            cli.pull_dense_init(0, np.zeros(6, np.float32))  # opens socket
+            srv.kill()
+            srv.wait(timeout=30)
+            with pytest.raises(ConnectionError):
+                # several sends may be needed before the dead peer is
+                # observed; none may be silently retried
+                for _ in range(10):
+                    cli.push_dense_grad(0, np.ones(6, np.float32))
+                    time.sleep(0.1)
+        finally:
+            cli.close()
+            if srv.poll() is None:
+                srv.kill()
+
+
 class TestPsServerRestartResume:
     def test_snapshot_restart_resume_training(self, tmp_path):
         """Server-side fault-tolerance cycle (reference:
