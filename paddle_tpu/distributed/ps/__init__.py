@@ -31,6 +31,8 @@ from .embedding import (SparseEmbedding, distributed_lookup_table,
 from .server import OPT_ADAM, OPT_SGD, OPT_SUM, PsServer, TableConfig
 from .trainer import DownpourTrainer, DownpourWorker  # noqa: F401
 from .heter import HeterClient, HeterServer, start_heter_server  # noqa: F401
+from .hbm_cache import (CachedSparseEmbedding, HbmEmbeddingCache,  # noqa: F401
+                        PsTpuTrainer)
 
 
 def bind_model(model, communicator, bind_embeddings=True):

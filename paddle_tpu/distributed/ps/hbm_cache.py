@@ -1,0 +1,597 @@
+"""HBM-resident embedding cache + pass-level trainer — the GPUPS analog
+(reference: `framework/fleet/ps_gpu_wrapper.cc:43/533` BuildTask /
+BuildGPUPSTask, `framework/fleet/heter_ps/hashtable.h` device hash
+tables, `framework/trainer.h:250` PSGPUTrainer).
+
+The reference's CTR perf story: before each dataset pass, every feasign
+key in the pass is deduped and bulk-pulled from the parameter servers
+into GPU-resident hash tables; trainer threads then read/update
+embeddings at HBM speed, and EndPass writes the trained values back.
+
+TPU-first redesign, not a translation:
+  - the device "hash table" is a dense ``(capacity, dim)`` jax array in
+    HBM, optionally row-sharded over a mesh axis (the multi-GPU
+    ``heter_comm.h`` inter-card exchange becomes XLA collectives);
+  - key->slot lookup is a host-side LRU dict (key hashing is host work
+    in the reference too, and keeping it off-device leaves every device
+    program static-shaped for XLA);
+  - lookup / optimizer-update / write-back are jit'd gather/scatter
+    programs with power-of-two bucket padding so the compile count stays
+    bounded; row 0 is a scratch slot that absorbs padded lanes;
+  - rows faulted on a miss are pulled per batch (batched), cold rows are
+    LRU-evicted with a delta write-back — so capacity smaller than the
+    working set degrades gracefully instead of OOMing;
+  - the optimizer (sgd/adam, matching ps_service.cc's server rules
+    bit-for-bit) runs on-device, like the reference's optimizer.cuh.h.
+
+Write-back pushes ``trained - staged`` deltas (kPushSparseDelta), so the
+server composes concurrent workers' contributions the same way geo mode
+does; with one worker the final server rows equal the device rows
+exactly.
+
+Cache observability rides the global monitor registry (monitor.py):
+``hbm_cache_hit`` / ``hbm_cache_miss`` / ``hbm_cache_evict`` /
+``hbm_cache_writeback_rows`` — the analog of the reference's pull/push
+timer VLOGs.
+"""
+import functools
+from collections import OrderedDict
+
+import numpy as np
+
+from ... import monitor
+from ...core.dispatch import call_op, unwrap, wrap
+from .embedding import SparseEmbedding
+
+__all__ = ["HbmEmbeddingCache", "CachedSparseEmbedding", "PsTpuTrainer"]
+
+
+def _bucket(n):
+    b = 8
+    while b < n:
+        b <<= 1
+    return b
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_gather():
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(lambda tbl, s: jnp.take(tbl, s, axis=0))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_install():
+    import jax
+
+    def f(tbl, staged, slots, rows):
+        return tbl.at[slots].set(rows), staged.at[slots].set(rows)
+
+    return jax.jit(f, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_copy():
+    import jax
+    return jax.jit(lambda x: x + 0.0)  # on-device copy, keeps sharding
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_delta():
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(
+        lambda tbl, staged, s: jnp.take(tbl, s, 0) - jnp.take(staged, s, 0))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_sgd():
+    import jax
+
+    def f(tbl, slots, grad, lr):
+        return tbl.at[slots].add(-lr * grad)
+
+    return jax.jit(f, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_adam():
+    import jax
+    import jax.numpy as jnp
+
+    # mirrors ps_service.cc SparseTable::apply_grad kOptAdam exactly:
+    # p -= lr * (m/bc1) / (sqrt(v/bc2) + eps), t per-row
+    def f(tbl, m, v, t, slots, grad, lr, b1, b2, eps):
+        t = t.at[slots].add(1.0)
+        ts = t[slots][:, None]
+        mn = b1 * m[slots] + (1.0 - b1) * grad
+        vn = b2 * v[slots] + (1.0 - b2) * grad * grad
+        m = m.at[slots].set(mn)
+        v = v.at[slots].set(vn)
+        bc1 = 1.0 - b1 ** ts
+        bc2 = 1.0 - b2 ** ts
+        tbl = tbl.at[slots].add(-lr * (mn / bc1) /
+                                (jnp.sqrt(vn / bc2) + eps))
+        return tbl, m, v, t
+
+    return jax.jit(f, donate_argnums=(0, 1, 2, 3))
+
+
+class HbmEmbeddingCache:
+    """Device-resident cache over one PS sparse table.
+
+    ``capacity`` counts device rows; row 0 is reserved as the padding
+    scratch slot, so ``capacity - 1`` keys can be resident. Keep
+    ``capacity`` divisible by the mesh-axis size when sharding.
+    """
+
+    def __init__(self, client, table_id, dim, capacity, optimizer="sgd",
+                 lr=0.01, beta1=0.9, beta2=0.999, eps=1e-8, mesh=None,
+                 mesh_axis=None):
+        import jax.numpy as jnp
+
+        if capacity < 2:
+            raise ValueError("capacity must be >= 2 (row 0 is scratch)")
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        self.capacity = capacity
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.beta1, self.beta2, self.eps = float(beta1), float(beta2), \
+            float(eps)
+        self._sharding = None
+        self._sharding_1d = None
+        if mesh is not None and mesh_axis is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            if capacity % mesh.shape[mesh_axis]:
+                raise ValueError(
+                    f"capacity {capacity} must divide the mesh axis "
+                    f"{mesh_axis!r} ({mesh.shape[mesh_axis]} devices)")
+            self._sharding = NamedSharding(mesh, P(mesh_axis, None))
+            self._sharding_1d = NamedSharding(mesh, P(mesh_axis))
+        self.table = self._place(jnp.zeros((capacity, dim), jnp.float32))
+        self.staged = self._place(jnp.zeros((capacity, dim), jnp.float32))
+        if optimizer == "adam":
+            self.m = self._place(jnp.zeros((capacity, dim), jnp.float32))
+            self.v = self._place(jnp.zeros((capacity, dim), jnp.float32))
+            self.t = self._place(jnp.zeros((capacity,), jnp.float32),
+                                 one_d=True)
+        elif optimizer != "sgd":
+            raise ValueError(f"unsupported cache optimizer {optimizer!r}")
+        self._fused_progs = {}        # (fn, shapes) -> compiled pass
+        self._slots = OrderedDict()   # key -> slot, LRU order (front=cold)
+        self._free = list(range(capacity - 1, 0, -1))  # never slot 0
+        self._key_of = np.zeros(capacity, np.uint64)
+        self._dirty = np.zeros(capacity, bool)
+        self._pending = []            # (slots, slice_tensor) per lookup
+
+    def _place(self, arr, one_d=False):
+        if self._sharding is None:
+            return arr
+        import jax
+        return jax.device_put(arr,
+                              self._sharding_1d if one_d else self._sharding)
+
+    # -- pass staging (BuildGPUPSTask analog) -----------------------------
+    def build_pass(self, keys):
+        """Dedup `keys` (every feasign in the upcoming pass), bulk-pull
+        the non-resident ones from the PS, and stage them into HBM. If
+        the pass working set exceeds capacity, the most frequent keys are
+        staged and the tail is left to per-batch faulting."""
+        keys = np.asarray(keys, np.uint64).ravel()
+        uniq, counts = np.unique(keys, return_counts=True)
+        order = np.argsort(-counts, kind="stable")
+        uniq = uniq[order]
+        if self._slots:  # vectorized residency check (no per-key walk)
+            res = np.sort(np.fromiter(self._slots.keys(), np.uint64,
+                                      len(self._slots)))
+            pos = np.searchsorted(res, uniq)
+            resident = (pos < res.size) & (res[np.minimum(
+                pos, res.size - 1)] == uniq)
+            missing = uniq[~resident]
+        else:
+            missing = uniq
+        room = len(self._free)
+        if missing.size > room:
+            missing = missing[:room]
+        # install least-frequent-FIRST so the hottest keys end up most
+        # recently used — under capacity pressure, mid-pass faulting then
+        # evicts the cold tail, not the keys staging exists to protect
+        missing = missing[::-1].copy()
+        if missing.size:
+            self._fault_in(missing, count_miss=False)
+        monitor.stat_add("hbm_cache_staged", int(missing.size))
+        return int(missing.size)
+
+    # -- lookup (differentiable; PullSparse analog) -----------------------
+    def lookup(self, ids):
+        """Differentiable embedding lookup served from HBM. Returns a
+        Tensor shaped ``ids.shape + (dim,)``; the pulled slice is
+        recorded so :meth:`apply_grads` can run the on-device optimizer
+        after ``loss.backward()``.
+
+        Every device shape here is padded to a power-of-two bucket: the
+        per-batch unique-key count varies, and an unpadded slice would
+        force an XLA recompile per distinct count (ruinous through a
+        device tunnel). Padded lanes point at scratch row 0.
+        """
+        import jax.numpy as jnp
+
+        ids_np = np.asarray(unwrap(ids)).astype(np.int64)
+        shape = ids_np.shape
+        uniq, inv = np.unique(ids_np.ravel(), return_inverse=True)
+        slots = self._ensure(uniq.astype(np.uint64))
+        n = slots.size
+        b = _bucket(n)
+        slots_p = np.zeros(b, np.int32)   # padded lanes hit scratch row 0
+        slots_p[:n] = slots
+        rows_p = _jit_gather()(self.table, jnp.asarray(slots_p))  # (b,dim)
+        slice_t = wrap(rows_p, stop_gradient=False)
+
+        def _gather(rows_):
+            return rows_[jnp.asarray(inv)].reshape(shape + (self.dim,))
+
+        out = call_op(_gather, slice_t, op_name="hbm_cache_lookup")
+        from ...core import autograd as _ag
+        if _ag.grad_enabled():
+            self._pending.append((slots, slots_p, slice_t))
+        return out
+
+    # -- optimizer update (PushSparseGrad + optimizer.cuh.h analog) -------
+    def apply_grads(self):
+        """Apply every recorded slice gradient to the device table with
+        the cache's optimizer rule. Call after ``loss.backward()``."""
+        import jax.numpy as jnp
+
+        for slots, slots_p, slice_t in self._pending:
+            if slice_t._grad is None:
+                continue
+            # the slice grad is already bucket-padded (lookup kept the
+            # padded shape); padded rows are zero and target scratch
+            sj = jnp.asarray(slots_p)
+            gj = jnp.asarray(slice_t._grad, jnp.float32)
+            if self.optimizer == "sgd":
+                self.table = _jit_sgd()(self.table, sj, gj,
+                                        jnp.float32(self.lr))
+            else:
+                self.table, self.m, self.v, self.t = _jit_adam()(
+                    self.table, self.m, self.v, self.t, sj, gj,
+                    jnp.float32(self.lr), jnp.float32(self.beta1),
+                    jnp.float32(self.beta2), jnp.float32(self.eps))
+            self._dirty[slots] = True
+            self._dirty[0] = False  # scratch row never written back
+        self._pending = []
+
+    # -- fused pass (the GPUPS perf story, TPU-style) ---------------------
+    def run_fused_pass(self, ids_batches, emb_loss_fn, labels=None):
+        """Run a whole staged pass as ONE compiled device program.
+
+        This is where the TPU design beats the reference's per-batch
+        device round-trips: after :meth:`build_pass` stages every key,
+        no host work remains mid-pass, so the full pass — gather →
+        ``emb_loss_fn`` forward/backward → optimizer scatter — compiles
+        into a single ``lax.scan`` over batches. One dispatch executes
+        K batches; dispatch latency amortizes to ~0 per batch.
+
+        ``ids_batches``: list of int id arrays, all the same shape.
+        ``emb_loss_fn(emb[, label]) -> scalar`` must be pure jax AND a
+        stable callable — the compiled pass is cached on its identity,
+        so a fresh lambda per call recompiles per call.
+        ``labels``: optional per-batch arrays (stacked and scanned).
+        Every key must be resident (the pass contract); a miss raises.
+        Returns the per-batch loss array.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        shape = np.asarray(ids_batches[0]).shape
+        # vectorized key->slot resolution: one sorted snapshot of the
+        # resident index per pass, searchsorted per batch (the per-key
+        # python dict walk would dominate the fused pass's host cost)
+        if not self._slots:
+            raise RuntimeError("fused pass requires every key staged "
+                               "(build_pass first); cache is empty")
+        res_keys = np.fromiter(self._slots.keys(), np.uint64,
+                               len(self._slots))
+        res_slots = np.fromiter(self._slots.values(), np.int32,
+                                len(self._slots))
+        order = np.argsort(res_keys)
+        res_keys, res_slots = res_keys[order], res_slots[order]
+        slots_l, inv_l = [], []
+        for ids in ids_batches:
+            ids_np = np.asarray(ids).astype(np.int64)
+            if ids_np.shape != shape:
+                raise ValueError("all fused-pass batches must share one "
+                                 "shape (bucket static shapes for XLA)")
+            uniq, inv = np.unique(ids_np.ravel(), return_inverse=True)
+            uniq = uniq.astype(np.uint64)
+            pos = np.searchsorted(res_keys, uniq)
+            bad = (pos >= res_keys.size) | (res_keys[
+                np.minimum(pos, res_keys.size - 1)] != uniq)
+            if bad.any():
+                raise RuntimeError(
+                    f"fused pass requires every key staged "
+                    f"(build_pass first); key {int(uniq[bad][0])} is not "
+                    f"resident")
+            slots_l.append(res_slots[pos])
+            inv_l.append(inv.astype(np.int32))
+        monitor.stat_add("hbm_cache_hit",
+                         int(sum(s.size for s in slots_l)))
+        b = _bucket(max(s.size for s in slots_l))
+        K = len(ids_batches)
+        slots_a = np.zeros((K, b), np.int32)
+        inv_a = np.stack(inv_l)
+        for i, s in enumerate(slots_l):
+            slots_a[i, :s.size] = s
+        lab_a = (np.stack([np.asarray(l, np.float32) for l in labels])
+                 if labels is not None else np.zeros((K, 1), np.float32))
+        opt_adam = self.optimizer == "adam"
+        has_labels = labels is not None
+        prog_key = (emb_loss_fn, shape, K, b, has_labels, lab_a.shape)
+        run = self._fused_progs.get(prog_key)
+        if run is None:
+            lr, b1, b2, eps = (jnp.float32(self.lr),
+                               jnp.float32(self.beta1),
+                               jnp.float32(self.beta2),
+                               jnp.float32(self.eps))
+            dim = self.dim
+
+            def body(carry, xs):
+                slots_k, inv_k, lab_k = xs
+                tbl = carry[0]
+                rows = jnp.take(tbl, slots_k, axis=0)
+
+                def g(rows_):
+                    e = rows_[inv_k].reshape(shape + (dim,))
+                    return (emb_loss_fn(e, lab_k) if has_labels
+                            else emb_loss_fn(e))
+
+                loss, dr = jax.value_and_grad(g)(rows)
+                if opt_adam:
+                    tbl, m, v, t = carry
+                    t = t.at[slots_k].add(1.0)
+                    ts = t[slots_k][:, None]
+                    mn = b1 * m[slots_k] + (1.0 - b1) * dr
+                    vn = b2 * v[slots_k] + (1.0 - b2) * dr * dr
+                    m = m.at[slots_k].set(mn)
+                    v = v.at[slots_k].set(vn)
+                    tbl = tbl.at[slots_k].add(
+                        -lr * (mn / (1.0 - b1 ** ts)) /
+                        (jnp.sqrt(vn / (1.0 - b2 ** ts)) + eps))
+                    return (tbl, m, v, t), loss
+                tbl = tbl.at[slots_k].add(-lr * dr)
+                return (tbl,), loss
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def run(carry, slots_a, inv_a, lab_a):
+                return jax.lax.scan(body, carry, (slots_a, inv_a, lab_a))
+
+            if len(self._fused_progs) >= 16:  # bound retained programs
+                self._fused_progs.pop(next(iter(self._fused_progs)))
+            self._fused_progs[prog_key] = run
+
+        carry = ((self.table, self.m, self.v, self.t) if opt_adam
+                 else (self.table,))
+        carry, losses = run(carry, jnp.asarray(slots_a),
+                            jnp.asarray(inv_a), jnp.asarray(lab_a))
+        if opt_adam:
+            self.table, self.m, self.v, self.t = carry
+        else:
+            (self.table,) = carry
+        touched = np.unique(np.concatenate(slots_l))
+        self._dirty[touched] = True
+        self._dirty[0] = False
+        return np.asarray(losses)
+
+    # -- write-back (EndPass analog) --------------------------------------
+    def end_pass(self):
+        """Push ``trained - staged`` deltas for every dirty resident row
+        back to the PS and re-baseline. Rows stay resident for the next
+        pass (warm cache across passes)."""
+        import jax.numpy as jnp
+
+        dirty = np.nonzero(self._dirty)[0]
+        if dirty.size:
+            keys = self._key_of[dirty]
+            delta = np.asarray(_jit_delta()(self.table, self.staged,
+                                            jnp.asarray(dirty.astype(
+                                                np.int32))))
+            self.client.push_sparse_delta(self.table_id, keys, delta)
+            # re-baseline on device (a host round-trip would move the
+            # whole table through the tunnel and un-shard it)
+            self.staged = _jit_copy()(self.table)
+            self._dirty[:] = False
+        monitor.stat_add("hbm_cache_writeback_rows", int(dirty.size))
+        return int(dirty.size)
+
+    @property
+    def stats(self):
+        return {k: monitor.stat_get(f"hbm_cache_{k}")
+                for k in ("hit", "miss", "evict", "staged",
+                          "writeback_rows")}
+
+    # -- internals --------------------------------------------------------
+    def _ensure(self, uniq_keys):
+        """Map unique keys to device slots, faulting misses in (batched)
+        and LRU-evicting if full. Returns int32 slots."""
+        slots = np.empty(uniq_keys.size, np.int32)
+        misses = []
+        for i, k in enumerate(uniq_keys):
+            k = int(k)
+            s = self._slots.get(k)
+            if s is None:
+                misses.append(i)
+                slots[i] = -1
+            else:
+                self._slots.move_to_end(k)
+                slots[i] = s
+        monitor.stat_add("hbm_cache_hit", uniq_keys.size - len(misses))
+        if misses:
+            missed = uniq_keys[misses]
+            got = self._fault_in(missed, pinned=set(uniq_keys.tolist()))
+            slots[misses] = got
+        return slots
+
+    def _fault_in(self, keys, pinned=None, count_miss=True):
+        """Pull `keys` from the PS and install them, evicting LRU victims
+        (with delta write-back) when the free list runs dry."""
+        import jax.numpy as jnp
+
+        need = keys.size - len(self._free)
+        if need > 0:
+            self._evict(need, pinned or set())
+        if keys.size > len(self._free):
+            raise RuntimeError(
+                f"hbm cache over capacity: need {keys.size} slots, "
+                f"{len(self._free)} free after eviction (batch working "
+                f"set larger than capacity {self.capacity}?)")
+        if count_miss:  # pass-level staging is counted as 'staged', not
+            monitor.stat_add("hbm_cache_miss", int(keys.size))  # a miss
+        rows = self.client.pull_sparse(self.table_id, keys)
+        slots = np.array([self._free.pop() for _ in range(keys.size)],
+                         np.int32)
+        for k, s in zip(keys.tolist(), slots.tolist()):
+            self._slots[int(k)] = int(s)
+            self._key_of[s] = k
+        n = keys.size
+        b = _bucket(n)
+        slots_p = np.zeros(b, np.int32)
+        slots_p[:n] = slots
+        rows_p = np.zeros((b, self.dim), np.float32)
+        rows_p[:n] = rows
+        self.table, self.staged = _jit_install()(
+            self.table, self.staged, jnp.asarray(slots_p),
+            jnp.asarray(rows_p))
+        return slots
+
+    def _evict(self, n, pinned):
+        import jax.numpy as jnp
+
+        # slots with an un-applied gradient (recorded by lookup, not yet
+        # consumed by apply_grads) must not be reused: the later scatter
+        # would train whatever key took the slot with the WRONG grad
+        pending_slots = set()
+        for slots, _p, _t in self._pending:
+            pending_slots.update(int(s) for s in slots)
+        victims, vkeys = [], []
+        for k in list(self._slots):          # front of the OrderedDict =
+            if k in pinned or self._slots[k] in pending_slots:  # LRU front
+                continue
+            victims.append(self._slots.pop(k))
+            vkeys.append(k)
+            if len(victims) >= n:
+                break
+        if len(victims) < n:
+            raise RuntimeError(
+                f"hbm cache cannot evict {n} rows: every resident key is "
+                f"pinned by the current batch or holds an un-applied "
+                f"gradient (capacity {self.capacity} too small for one "
+                f"step's working set)")
+        victims = np.asarray(victims, np.int32)
+        dirty_mask = self._dirty[victims]
+        if dirty_mask.any():
+            dv = victims[dirty_mask]
+            delta = np.asarray(_jit_delta()(self.table, self.staged,
+                                            jnp.asarray(dv)))
+            self.client.push_sparse_delta(self.table_id,
+                                          self._key_of[dv], delta)
+            self._dirty[dv] = False
+        self._free.extend(int(s) for s in victims)
+        monitor.stat_add("hbm_cache_evict", len(victims))
+
+
+class CachedSparseEmbedding(SparseEmbedding):
+    """Drop-in :class:`SparseEmbedding` whose rows are served from an
+    HBM-resident cache instead of a per-batch PS round-trip (reference:
+    the PSGPUTrainer path reads `heter_ps` device tables where the
+    Downpour path calls pull_sparse per batch)."""
+
+    def __init__(self, size, capacity=None, table_id=None, init_range=0.1,
+                 optimizer="sgd", lr=0.01, beta1=0.9, beta2=0.999,
+                 eps=1e-8, mesh=None, mesh_axis=None, name=None):
+        super().__init__(size, table_id=table_id, init_range=init_range,
+                         name=name)
+        num, _dim = size
+        self.capacity = capacity if capacity is not None else num + 1
+        self._cache_cfg = dict(optimizer=optimizer, lr=lr, beta1=beta1,
+                               beta2=beta2, eps=eps, mesh=mesh,
+                               mesh_axis=mesh_axis)
+        self.cache = None
+
+    def bind(self, communicator):
+        super().bind(communicator)
+        self.cache = HbmEmbeddingCache(
+            communicator.client, self.table_id, self.embedding_dim,
+            self.capacity, **self._cache_cfg)
+
+    def forward(self, ids):
+        if self.cache is None:
+            raise RuntimeError(
+                "CachedSparseEmbedding is not bound — call "
+                "fleet.init_worker() (or .bind(communicator)) first")
+        return self.cache.lookup(ids)
+
+
+class PsTpuTrainer:
+    """Pass-level trainer driving cached embeddings — the PSGPUTrainer
+    analog (reference: `framework/trainer.h:250`, `ps_gpu_worker.cc`).
+
+    Per pass: stage every key the pass will touch (BuildGPUPSTask), run
+    the batches with on-device sparse updates, write the trained rows
+    back (EndPass). Dense parameters ride the given communicator exactly
+    like the Downpour path, so a model can mix cached and direct
+    embeddings freely.
+    """
+
+    def __init__(self, model, loss_fn, communicator, keys_fn=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.comm = communicator
+        self.keys_fn = keys_fn
+        self.caches = [sub.cache
+                       for sub in model.sublayers(include_self=True)
+                       if isinstance(sub, CachedSparseEmbedding)]
+        if any(c is None for c in self.caches):
+            raise RuntimeError("model has unbound CachedSparseEmbedding "
+                               "layers — bind_model() first")
+
+    def train_pass(self, batches):
+        """One dataset pass. `batches` is materialized (the reference's
+        LoadIntoMemory) so keys can be collected before training. Returns
+        ``{"batches": n, "loss_sum": s, "losses": [...]}``."""
+        from .embedding import flush_sparse_grads
+
+        batches = list(batches)
+        by_table = {}
+        for batch in batches:
+            for tid, keys in self._batch_keys(batch).items():
+                by_table.setdefault(tid, []).append(
+                    np.asarray(keys, np.uint64).ravel())
+        for cache in self.caches:
+            keys = by_table.get(cache.table_id)
+            if keys:
+                cache.build_pass(np.concatenate(keys))
+        losses = []
+        for batch in batches:
+            loss = self.loss_fn(self.model, batch)
+            loss.backward()
+            for cache in self.caches:
+                cache.apply_grads()
+            flush_sparse_grads(self.comm)  # plain SparseEmbedding layers
+            self.comm.step()
+            losses.append(float(loss.numpy()))
+        for cache in self.caches:
+            cache.end_pass()
+        return {"batches": len(batches), "loss_sum": float(sum(losses)),
+                "losses": losses}
+
+    def _batch_keys(self, batch):
+        if self.keys_fn is not None:
+            return self.keys_fn(batch)
+        if len(self.caches) == 1 and isinstance(batch, (tuple, list)):
+            return {self.caches[0].table_id:
+                    np.asarray(unwrap(batch[0])).astype(np.uint64)}
+        raise RuntimeError(
+            "pass keys_fn(batch) -> {table_id: ids} when the model has "
+            "multiple cached embeddings or a custom batch layout")
