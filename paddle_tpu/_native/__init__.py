@@ -108,6 +108,7 @@ def _bind(lib):
         "pt_ps_add_sparse": (None, [c.c_uint32, I, I, c.c_float, c.c_float,
                                     c.c_float, c.c_float, c.c_float,
                                     c.c_uint64]),
+        "pt_ps_add_graph": (None, [c.c_uint32, I]),
         "pt_ps_start": (I, [I]),
         "pt_ps_stop": (None, []),
         "pt_ps_port": (I, []),

@@ -62,6 +62,14 @@ enum Op : uint8_t {
   kStop = 10,
   kSparseSize = 11,
   kPullDenseInit = 12,  // pull, initializing from payload if first touch
+  // graph service (reference: common_graph_table.cc + graph_brpc_server.cc)
+  kGraphAddNodes = 20,        // n ids | n*feat_dim f32 features
+  kGraphAddEdges = 21,        // n src | n dst | n f32 weights
+  kGraphSampleNeighbors = 22, // n ids | u32 k | u64 seed
+  kGraphPullList = 23,        // u64 start | u64 count -> node id batch
+  kGraphNodeFeat = 24,        // n ids -> n*feat_dim f32
+  kGraphRandomNodes = 25,     // u32 k | u64 seed -> <=k ids
+  kGraphSize = 26,            // -> u64 node count
 };
 
 enum OptKind : int32_t { kOptSum = 0, kOptSgd = 1, kOptAdam = 2 };
@@ -184,6 +192,45 @@ struct DenseTable {
   }
 };
 
+// Graph table shard (reference: table/common_graph_table.{h,cc} GraphShard
+// buckets + FeatureNode; features here are fixed-dim f32 vectors — the
+// TPU-friendly layout — instead of the reference's typed string features).
+struct GraphNode {
+  std::vector<uint64_t> nbr;
+  std::vector<float> w;
+  std::vector<float> feat;
+};
+
+struct GraphTable {
+  int feat_dim = 0;
+  std::unordered_map<uint64_t, GraphNode> nodes;
+  std::vector<uint64_t> order;  // insertion order, for pull_graph_list
+  std::mutex mu;
+
+  GraphNode& node(uint64_t id) {
+    auto it = nodes.find(id);
+    if (it != nodes.end()) return it->second;
+    order.push_back(id);
+    GraphNode& n = nodes[id];
+    n.feat.assign(feat_dim, 0.0f);
+    return n;
+  }
+};
+
+// Deterministic per-node sampling rng: every shard/restart/client agrees
+// (reference seeds per-thread rng pools; determinism is a test contract
+// here). xorshift64 seeded from mix64(seed ^ mix64(node_id)).
+struct SampleRng {
+  uint64_t s;
+  explicit SampleRng(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ull) {}
+  uint64_t next() {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  }
+};
+
 struct Barrier {
   std::mutex mu;
   std::condition_variable cv;
@@ -194,6 +241,7 @@ struct Barrier {
 struct PsServer {
   std::unordered_map<uint32_t, SparseTable> sparse;
   std::unordered_map<uint32_t, DenseTable> dense;
+  std::unordered_map<uint32_t, GraphTable> graph;
   Barrier barrier;
   int listen_fd = -1;
   int port = 0;
@@ -216,6 +264,11 @@ SparseTable* find_sparse(PsServer* ps, uint32_t table) {
 DenseTable* find_dense(PsServer* ps, uint32_t table) {
   auto it = ps->dense.find(table);
   return it == ps->dense.end() ? nullptr : &it->second;
+}
+
+GraphTable* find_graph(PsServer* ps, uint32_t table) {
+  auto it = ps->graph.find(table);
+  return it == ps->graph.end() ? nullptr : &it->second;
 }
 
 bool read_all(int fd, void* buf, size_t n) {
@@ -284,6 +337,27 @@ bool save_tables(PsServer* ps, const std::string& path) {
       fwrite(r.second.data(), 4, rl, f);
     }
   }
+  uint32_t ngr = ps->graph.size();
+  fwrite(&ngr, 4, 1, f);
+  for (auto& kv : ps->graph) {
+    GraphTable& t = kv.second;
+    std::lock_guard<std::mutex> lk(t.mu);
+    uint32_t id = kv.first, fdim = t.feat_dim;
+    uint64_t nn = t.order.size();
+    fwrite(&id, 4, 1, f);
+    fwrite(&fdim, 4, 1, f);
+    fwrite(&nn, 8, 1, f);
+    for (uint64_t oi = 0; oi < nn; ++oi) {  // insertion order preserved
+      uint64_t nid = t.order[oi];
+      GraphNode& nd = t.nodes[nid];
+      uint32_t deg = nd.nbr.size();
+      fwrite(&nid, 8, 1, f);
+      fwrite(&deg, 4, 1, f);
+      fwrite(nd.nbr.data(), 8, deg, f);
+      fwrite(nd.w.data(), 4, deg, f);
+      fwrite(nd.feat.data(), 4, fdim, f);
+    }
+  }
   bool ok = ferror(f) == 0;
   ok = (fclose(f) == 0) && ok;
   return ok;
@@ -343,6 +417,43 @@ bool load_tables(PsServer* ps, const std::string& path) {
       if (fread(vals.data(), 4, rl, f) != rl) { ok = false; break; }
       t.rows.emplace(key, std::move(vals));
       if (st) t.steps[key] = st;
+    }
+  }
+  uint32_t ngr = 0;
+  if (ok && fread(&ngr, 4, 1, f) == 1) {  // absent in pre-graph snapshots
+    for (uint32_t i = 0; i < ngr && ok; ++i) {
+      uint32_t id, fdim;
+      uint64_t nn;
+      if (fread(&id, 4, 1, f) != 1 || fread(&fdim, 4, 1, f) != 1 ||
+          fread(&nn, 8, 1, f) != 1) {
+        ok = false;
+        break;
+      }
+      GraphTable& t = ps->graph[id];
+      std::lock_guard<std::mutex> lk(t.mu);
+      t.feat_dim = fdim;
+      t.nodes.clear();
+      t.order.clear();
+      for (uint64_t r = 0; r < nn; ++r) {
+        uint64_t nid;
+        uint32_t deg;
+        if (fread(&nid, 8, 1, f) != 1 || fread(&deg, 4, 1, f) != 1) {
+          ok = false;
+          break;
+        }
+        GraphNode& nd = t.node(nid);
+        nd.nbr.resize(deg);
+        nd.w.resize(deg);
+        if (deg && (fread(nd.nbr.data(), 8, deg, f) != deg ||
+                    fread(nd.w.data(), 4, deg, f) != deg)) {
+          ok = false;
+          break;
+        }
+        if (fdim && fread(nd.feat.data(), 4, fdim, f) != fdim) {
+          ok = false;
+          break;
+        }
+      }
     }
   }
   fclose(f);
@@ -503,6 +614,158 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
         send_resp(fd, &ok, 4);
         break;
       }
+      case kGraphAddNodes: {
+        GraphTable* tp = find_graph(ps, table);
+        uint32_t ok = 0;
+        // division-form bounds checks throughout the graph ops: n is
+        // client-controlled and n*rowbytes could wrap (cf. sparse ops)
+        if (tp && n <= psize / (8 + 4ull * tp->feat_dim)) {
+          GraphTable& t = *tp;
+          std::lock_guard<std::mutex> lk(t.mu);
+          const uint64_t* ids = (const uint64_t*)payload;
+          const float* feats = (const float*)(payload + n * 8);
+          for (uint64_t i = 0; i < n; ++i) {
+            GraphNode& nd = t.node(ids[i]);
+            memcpy(nd.feat.data(), feats + i * t.feat_dim,
+                   t.feat_dim * 4);
+          }
+          ok = 1;
+        }
+        send_resp(fd, &ok, 4);
+        break;
+      }
+      case kGraphAddEdges: {
+        GraphTable* tp = find_graph(ps, table);
+        uint32_t ok = 0;
+        if (tp && n <= psize / 20) {  // src u64 + dst u64 + w f32
+          GraphTable& t = *tp;
+          std::lock_guard<std::mutex> lk(t.mu);
+          const uint64_t* src = (const uint64_t*)payload;
+          const uint64_t* dst = (const uint64_t*)(payload + n * 8);
+          const float* w = (const float*)(payload + n * 16);
+          for (uint64_t i = 0; i < n; ++i) {
+            GraphNode& nd = t.node(src[i]);
+            nd.nbr.push_back(dst[i]);
+            nd.w.push_back(w[i]);
+          }
+          ok = 1;
+        }
+        send_resp(fd, &ok, 4);
+        break;
+      }
+      case kGraphSampleNeighbors: {
+        GraphTable* tp = find_graph(ps, table);
+        if (!tp || psize < 12 || n > (psize - 12) / 8) {
+          send_resp(fd, nullptr, 0);
+          break;
+        }
+        GraphTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        const uint64_t* ids = (const uint64_t*)payload;
+        uint32_t k;
+        uint64_t seed;
+        memcpy(&k, payload + n * 8, 4);
+        memcpy(&seed, payload + n * 8 + 4, 8);
+        // reply: per id, u32 cnt | cnt * (u64 nbr + f32 weight)
+        std::vector<char> resp;
+        std::vector<uint32_t> idx;
+        for (uint64_t i = 0; i < n; ++i) {
+          auto it = t.nodes.find(ids[i]);
+          uint32_t deg = it == t.nodes.end()
+                             ? 0 : (uint32_t)it->second.nbr.size();
+          uint32_t cnt = deg < k ? deg : k;
+          size_t at = resp.size();
+          resp.resize(at + 4 + cnt * 12ull);
+          memcpy(resp.data() + at, &cnt, 4);
+          if (!cnt) continue;
+          GraphNode& nd = it->second;
+          // partial Fisher–Yates over index array, deterministic per
+          // (seed, node) — the python mirror in tests reproduces this
+          idx.resize(deg);
+          for (uint32_t j = 0; j < deg; ++j) idx[j] = j;
+          SampleRng rng(mix64(seed ^ mix64(ids[i])));
+          char* out_p = resp.data() + at + 4;
+          for (uint32_t j = 0; j < cnt; ++j) {
+            uint32_t pick = j + (uint32_t)(rng.next() % (deg - j));
+            uint32_t tmp = idx[j];
+            idx[j] = idx[pick];
+            idx[pick] = tmp;
+            memcpy(out_p + j * 12, &nd.nbr[idx[j]], 8);
+            memcpy(out_p + j * 12 + 8, &nd.w[idx[j]], 4);
+          }
+        }
+        send_resp(fd, resp.data(), (uint32_t)resp.size());
+        break;
+      }
+      case kGraphPullList: {
+        GraphTable* tp = find_graph(ps, table);
+        if (!tp || psize < 16) { send_resp(fd, nullptr, 0); break; }
+        GraphTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        uint64_t start, count;
+        memcpy(&start, payload, 8);
+        memcpy(&count, payload + 8, 8);
+        if (start > t.order.size()) start = t.order.size();
+        uint64_t avail = t.order.size() - start;  // wrap-safe clamp
+        if (count > avail) count = avail;
+        send_resp(fd, t.order.data() + start, (uint32_t)(count * 8));
+        break;
+      }
+      case kGraphNodeFeat: {
+        GraphTable* tp = find_graph(ps, table);
+        if (!tp || n > psize / 8) { send_resp(fd, nullptr, 0); break; }
+        GraphTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        const uint64_t* ids = (const uint64_t*)payload;
+        out.assign(n * t.feat_dim, 0.0f);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto it = t.nodes.find(ids[i]);
+          if (it != t.nodes.end())
+            memcpy(out.data() + i * t.feat_dim, it->second.feat.data(),
+                   t.feat_dim * 4);
+        }
+        send_resp(fd, out.data(), (uint32_t)(out.size() * 4));
+        break;
+      }
+      case kGraphRandomNodes: {
+        GraphTable* tp = find_graph(ps, table);
+        if (!tp || psize < 12) { send_resp(fd, nullptr, 0); break; }
+        GraphTable& t = *tp;
+        std::lock_guard<std::mutex> lk(t.mu);
+        uint32_t k;
+        uint64_t seed;
+        memcpy(&k, payload, 4);
+        memcpy(&seed, payload + 4, 8);
+        uint32_t total = (uint32_t)t.order.size();
+        uint32_t cnt = k < total ? k : total;
+        // sparse Fisher–Yates: O(k) displaced-slot map instead of
+        // materializing an O(total) index array per request
+        std::unordered_map<uint32_t, uint32_t> moved;
+        SampleRng rng(mix64(seed));
+        std::vector<uint64_t> picked(cnt);
+        for (uint32_t j = 0; j < cnt; ++j) {
+          uint32_t pick = j + (uint32_t)(rng.next() % (total - j));
+          auto itj = moved.find(j);
+          auto itp = moved.find(pick);
+          uint32_t vj = itj == moved.end() ? j : itj->second;
+          uint32_t vp = itp == moved.end() ? pick : itp->second;
+          moved[j] = vp;
+          moved[pick] = vj;
+          picked[j] = t.order[vp];
+        }
+        send_resp(fd, picked.data(), cnt * 8);
+        break;
+      }
+      case kGraphSize: {
+        GraphTable* tp = find_graph(ps, table);
+        uint64_t sz = 0;
+        if (tp) {
+          std::lock_guard<std::mutex> lk(tp->mu);
+          sz = tp->nodes.size();
+        }
+        send_resp(fd, &sz, 8);
+        break;
+      }
       case kSparseSize: {
         SparseTable* tp = find_sparse(ps, table);
         if (!tp) { uint64_t z = 0; send_resp(fd, &z, 8); break; }
@@ -584,6 +847,12 @@ PT_API void pt_ps_add_sparse(uint32_t table, int32_t dim, int32_t opt_kind,
   t.opt = {opt_kind, lr, beta1, beta2, eps};
   t.init_range = init_range;
   t.seed = seed;
+}
+
+PT_API void pt_ps_add_graph(uint32_t table, int32_t feat_dim) {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  if (!g_ps) g_ps = new PsServer();
+  g_ps->graph[table].feat_dim = feat_dim;
 }
 
 // returns the bound port (pass 0 for an ephemeral port), or -1 on error

@@ -33,6 +33,7 @@ from .trainer import DownpourTrainer, DownpourWorker  # noqa: F401
 from .heter import HeterClient, HeterServer, start_heter_server  # noqa: F401
 from .hbm_cache import (CachedSparseEmbedding, HbmEmbeddingCache,  # noqa: F401
                         PsTpuTrainer)
+from .graph import GraphPsClient  # noqa: F401
 
 
 def bind_model(model, communicator, bind_embeddings=True):

@@ -19,7 +19,7 @@ class TableConfig:
 
     def __init__(self, table_id, kind, dim, optimizer="sgd", lr=0.01,
                  beta1=0.9, beta2=0.999, eps=1e-8, init_range=0.0, seed=0):
-        assert kind in ("dense", "sparse")
+        assert kind in ("dense", "sparse", "graph")  # graph: dim=feat_dim
         self.table_id = table_id
         self.kind = kind
         self.dim = dim
@@ -52,6 +52,8 @@ class PsServer:
             if t.kind == "dense":
                 lib.pt_ps_add_dense(t.table_id, t.dim, opt, t.lr, t.beta1,
                                     t.beta2, t.eps)
+            elif t.kind == "graph":
+                lib.pt_ps_add_graph(t.table_id, t.dim)
             else:
                 lib.pt_ps_add_sparse(t.table_id, t.dim, opt, t.lr, t.beta1,
                                      t.beta2, t.eps, t.init_range, t.seed)
