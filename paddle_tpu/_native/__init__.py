@@ -109,6 +109,7 @@ def _bind(lib):
                                     c.c_float, c.c_float, c.c_float,
                                     c.c_uint64]),
         "pt_ps_add_graph": (None, [c.c_uint32, I]),
+        "pt_ps_sparse_spill": (None, [c.c_uint32, c.c_uint64, CP]),
         "pt_ps_start": (I, [I]),
         "pt_ps_stop": (None, []),
         "pt_ps_port": (I, []),

@@ -27,6 +27,7 @@
 // The Python client (paddle_tpu/distributed/ps/client.py) shards sparse keys
 // across servers by key % nservers and dense tables by table % nservers.
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <condition_variable>
@@ -70,6 +71,7 @@ enum Op : uint8_t {
   kGraphNodeFeat = 24,        // n ids -> n*feat_dim f32
   kGraphRandomNodes = 25,     // u32 k | u64 seed -> <=k ids
   kGraphSize = 26,            // -> u64 node count
+  kSparseSpillInfo = 27,      // -> u64 in_mem_rows | u64 spilled_rows
 };
 
 enum OptKind : int32_t { kOptSum = 0, kOptSgd = 1, kOptAdam = 2 };
@@ -101,11 +103,144 @@ struct SparseTable {
   std::unordered_map<uint64_t, int64_t> steps;  // adam t per row
   std::mutex mu;
 
+  // Out-of-core spill (reference: table/ssd_sparse_table.cc — cold rows
+  // behind the in-memory map; rocksdb replaced by a fixed-record file +
+  // free-slot index, which a restartable PS on one host is all it needs).
+  uint64_t budget = 0;  // max in-memory rows; 0 = RAM-only
+  std::string spill_path;
+  FILE* spill_f = nullptr;
+  std::unordered_map<uint64_t, uint64_t> spill_off;  // key -> record slot
+  std::vector<uint64_t> free_slots;
+  uint64_t spill_slots = 0;
+  std::unordered_map<uint64_t, uint64_t> last_use;
+  uint64_t tick = 0;
+  uint64_t spill_failures = 0;  // surfaced via kSparseSpillInfo
+  bool spill_broken = false;    // a full evict batch failed: stop paying
+                                // the O(rows) scan per insert
+
+  SparseTable() = default;
+  SparseTable(const SparseTable&) = delete;
+  SparseTable& operator=(const SparseTable&) = delete;
+  ~SparseTable() {
+    if (spill_f) fclose(spill_f);
+  }
+
   int row_len() const { return opt.kind == kOptAdam ? 3 * dim : dim; }
+  size_t rec_bytes() const { return 16 + 4ull * row_len(); }
+
+  bool ensure_file() {
+    if (spill_f) return true;
+    if (spill_path.empty()) return false;
+    spill_f = fopen(spill_path.c_str(), "w+b");
+    return spill_f != nullptr;
+  }
+
+  // Returns false WITHOUT touching the in-memory row on any I/O
+  // failure — a failed spill must never destroy trained state (the row
+  // just stays resident; the budget is soft under disk errors).
+  bool spill_one(uint64_t key) {
+    auto it = rows.find(key);
+    if (it == rows.end()) return false;
+    if (!ensure_file()) {
+      ++spill_failures;
+      return false;
+    }
+    uint64_t slot;
+    if (!free_slots.empty()) {
+      slot = free_slots.back();
+      free_slots.pop_back();
+    } else {
+      slot = spill_slots++;
+    }
+    int64_t st = 0;
+    auto sit = steps.find(key);
+    if (sit != steps.end()) st = sit->second;
+    bool wok =
+        fseeko(spill_f, (off_t)(slot * rec_bytes()), SEEK_SET) == 0 &&
+        fwrite(&key, 8, 1, spill_f) == 1 &&
+        fwrite(&st, 8, 1, spill_f) == 1 &&
+        fwrite(it->second.data(), 4, row_len(), spill_f) ==
+            (size_t)row_len() &&
+        fflush(spill_f) == 0;  // catches ENOSPC before the row is erased
+    if (!wok) {
+      ++spill_failures;
+      free_slots.push_back(slot);
+      return false;
+    }
+    spill_off[key] = slot;
+    rows.erase(it);
+    steps.erase(key);
+    last_use.erase(key);
+    return true;
+  }
+
+  bool read_spilled(uint64_t slot, uint64_t* key, int64_t* st,
+                    float* vals) {
+    fseeko(spill_f, (off_t)(slot * rec_bytes()), SEEK_SET);
+    return fread(key, 8, 1, spill_f) == 1 &&
+           fread(st, 8, 1, spill_f) == 1 &&
+           fread(vals, 4, row_len(), spill_f) == (size_t)row_len();
+  }
+
+  bool fault_from_spill(uint64_t key) {
+    auto it = spill_off.find(key);
+    if (it == spill_off.end()) return false;
+    uint64_t k2;
+    int64_t st;
+    std::vector<float> vals(row_len());
+    if (!read_spilled(it->second, &k2, &st, vals.data())) {
+      // unreadable record: drop the stale index entry so the key never
+      // lives in both maps (double-counted sizes, duplicate snapshot
+      // rows, stale adam steps on load)
+      ++spill_failures;
+      free_slots.push_back(it->second);
+      spill_off.erase(it);
+      return false;
+    }
+    rows.emplace(key, std::move(vals));
+    if (st) steps[key] = st;
+    free_slots.push_back(it->second);
+    spill_off.erase(it);
+    return true;
+  }
+
+  // Batch eviction of the coldest rows down to 3/4 of the budget —
+  // amortizes the O(in-mem) age scan (the reference's shard-wise
+  // cache-threshold pass, ssd_sparse_table.cc Flush/Shrink).
+  void maybe_evict() {
+    if (!budget || spill_broken || rows.size() <= budget) return;
+    size_t target = budget - budget / 4;
+    if (target == 0) target = 1;
+    size_t n_evict = rows.size() - target;
+    std::vector<std::pair<uint64_t, uint64_t>> ages;  // (last_use, key)
+    ages.reserve(rows.size());
+    for (auto& kv : rows) {
+      auto lu = last_use.find(kv.first);
+      ages.emplace_back(lu == last_use.end() ? 0 : lu->second, kv.first);
+    }
+    std::nth_element(ages.begin(), ages.begin() + n_evict, ages.end());
+    size_t done = 0;
+    for (size_t i = 0; i < n_evict; ++i)
+      if (spill_one(ages[i].second)) ++done;
+    if (done == 0) {
+      // every write failed (bad path / full disk): keep serving from RAM
+      // but stop re-scanning per insert; the failure count tells on us
+      spill_broken = true;
+      fprintf(stderr,
+              "[paddle_tpu ps] sparse spill to '%s' is failing; table "
+              "continues RAM-only (budget not enforced)\n",
+              spill_path.c_str());
+    }
+  }
 
   std::vector<float>& row(uint64_t key) {
+    if (budget) last_use[key] = ++tick;
     auto it = rows.find(key);
     if (it != rows.end()) return it->second;
+    if (budget && fault_from_spill(key)) {
+      maybe_evict();  // only evicts colder keys; this ref stays valid
+      return rows.find(key)->second;
+    }
     std::vector<float> r(row_len(), 0.0f);
     if (init_range > 0.0f) {
       for (int i = 0; i < dim; ++i) {
@@ -114,7 +249,9 @@ struct SparseTable {
         r[i] = (2.0f * u - 1.0f) * init_range;
       }
     }
-    return rows.emplace(key, std::move(r)).first->second;
+    auto& ref = rows.emplace(key, std::move(r)).first->second;
+    maybe_evict();
+    return ref;
   }
 
   void apply_grad(uint64_t key, const float* g) {
@@ -323,7 +460,7 @@ bool save_tables(PsServer* ps, const std::string& path) {
     SparseTable& t = kv.second;
     std::lock_guard<std::mutex> lk(t.mu);
     uint32_t id = kv.first;
-    uint64_t rows = t.rows.size();
+    uint64_t rows = t.rows.size() + t.spill_off.size();
     uint32_t rl = t.row_len();
     fwrite(&id, 4, 1, f);
     fwrite(&rows, 8, 1, f);
@@ -335,6 +472,20 @@ bool save_tables(PsServer* ps, const std::string& path) {
       if (it != t.steps.end()) st = it->second;
       fwrite(&st, 8, 1, f);
       fwrite(r.second.data(), 4, rl, f);
+    }
+    // spilled rows belong to the snapshot too (the reference saves the
+    // ssd-resident part of the table the same way)
+    std::vector<float> vals(rl);
+    for (auto& so : t.spill_off) {
+      uint64_t key;
+      int64_t st;
+      if (!t.read_spilled(so.second, &key, &st, vals.data())) {
+        fclose(f);
+        return false;
+      }
+      fwrite(&key, 8, 1, f);
+      fwrite(&st, 8, 1, f);
+      fwrite(vals.data(), 4, rl, f);
     }
   }
   uint32_t ngr = ps->graph.size();
@@ -406,6 +557,10 @@ bool load_tables(PsServer* ps, const std::string& path) {
     std::lock_guard<std::mutex> lk(t.mu);
     t.rows.clear();
     t.steps.clear();
+    t.spill_off.clear();
+    t.free_slots.clear();
+    t.spill_slots = 0;
+    t.last_use.clear();
     for (uint64_t r = 0; r < rows; ++r) {
       uint64_t key;
       int64_t st;
@@ -417,6 +572,7 @@ bool load_tables(PsServer* ps, const std::string& path) {
       if (fread(vals.data(), 4, rl, f) != rl) { ok = false; break; }
       t.rows.emplace(key, std::move(vals));
       if (st) t.steps[key] = st;
+      t.maybe_evict();  // re-enforce the RAM budget while loading
     }
   }
   uint32_t ngr = 0;
@@ -771,8 +927,20 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
         if (!tp) { uint64_t z = 0; send_resp(fd, &z, 8); break; }
         SparseTable& t = *tp;
         std::lock_guard<std::mutex> lk(t.mu);
-        uint64_t sz = t.rows.size();
+        uint64_t sz = t.rows.size() + t.spill_off.size();
         send_resp(fd, &sz, 8);
+        break;
+      }
+      case kSparseSpillInfo: {
+        SparseTable* tp = find_sparse(ps, table);
+        uint64_t info[3] = {0, 0, 0};
+        if (tp) {
+          std::lock_guard<std::mutex> lk(tp->mu);
+          info[0] = tp->rows.size();
+          info[1] = tp->spill_off.size();
+          info[2] = tp->spill_failures;
+        }
+        send_resp(fd, info, 24);
         break;
       }
       default: {
@@ -847,6 +1015,17 @@ PT_API void pt_ps_add_sparse(uint32_t table, int32_t dim, int32_t opt_kind,
   t.opt = {opt_kind, lr, beta1, beta2, eps};
   t.init_range = init_range;
   t.seed = seed;
+}
+
+// Configure out-of-core spill for a sparse table (reference:
+// ssd_sparse_table.cc). Call after pt_ps_add_sparse, before start.
+PT_API void pt_ps_sparse_spill(uint32_t table, uint64_t budget_rows,
+                               const char* path) {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  if (!g_ps) g_ps = new PsServer();
+  SparseTable& t = g_ps->sparse[table];
+  t.budget = budget_rows;
+  t.spill_path = path ? path : "";
 }
 
 PT_API void pt_ps_add_graph(uint32_t table, int32_t feat_dim) {

@@ -27,6 +27,7 @@ OP_LOAD = 9
 OP_STOP = 10
 OP_SPARSE_SIZE = 11
 OP_PULL_DENSE_INIT = 12
+OP_SPARSE_SPILL_INFO = 27
 
 
 class PsClient:
@@ -241,6 +242,19 @@ class PsClient:
                 raise RuntimeError(
                     f"ps server {i} failed to load snapshot "
                     f"{path_prefix}.{i}")
+
+    def sparse_spill_info(self, table):
+        """Per-server (in_memory_rows, spilled_rows, spill_failures) for
+        an out-of-core sparse table (reference: ssd_sparse_table cache
+        stats). Non-zero failures mean the disk path is broken and the
+        budget is not being enforced."""
+        out = []
+        for i in range(self.n_servers):
+            raw = self._call(i, OP_SPARSE_SPILL_INFO, table, 0,
+                             idempotent=True)
+            out.append(tuple(int(x)
+                             for x in struct.unpack("<QQQ", raw)))
+        return out
 
     def sparse_size(self, table):
         total = 0

@@ -18,7 +18,8 @@ class TableConfig:
     """One PS table (reference: ps.proto TableParameter)."""
 
     def __init__(self, table_id, kind, dim, optimizer="sgd", lr=0.01,
-                 beta1=0.9, beta2=0.999, eps=1e-8, init_range=0.0, seed=0):
+                 beta1=0.9, beta2=0.999, eps=1e-8, init_range=0.0, seed=0,
+                 mem_budget_rows=0, spill_path=None):
         assert kind in ("dense", "sparse", "graph")  # graph: dim=feat_dim
         self.table_id = table_id
         self.kind = kind
@@ -30,6 +31,10 @@ class TableConfig:
         self.eps = eps
         self.init_range = init_range
         self.seed = seed
+        # out-of-core sparse (reference: ssd_sparse_table.cc): cap the
+        # in-memory rows; colder rows spill to `spill_path`
+        self.mem_budget_rows = mem_budget_rows
+        self.spill_path = spill_path
 
 
 class PsServer:
@@ -57,6 +62,17 @@ class PsServer:
             else:
                 lib.pt_ps_add_sparse(t.table_id, t.dim, opt, t.lr, t.beta1,
                                      t.beta2, t.eps, t.init_range, t.seed)
+                if t.mem_budget_rows:
+                    if not t.spill_path:
+                        raise ValueError(
+                            f"sparse table {t.table_id}: mem_budget_rows "
+                            f"requires a spill_path")
+                    # fail at startup, not at first eviction, when the
+                    # spill location is unwritable
+                    with open(t.spill_path, "ab"):
+                        pass
+                    lib.pt_ps_sparse_spill(t.table_id, t.mem_budget_rows,
+                                           t.spill_path.encode())
         port = lib.pt_ps_start(self.port)
         if port < 0:
             raise RuntimeError(f"ps server failed to bind port {self.port}")
