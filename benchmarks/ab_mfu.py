@@ -23,10 +23,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_step(k=16, batch=16, seq=512):
+def build_step(k=16, batch=16, seq=512, pure_bf16=False):
     """The flagship program, identical to bench.py: k unrolled training
     steps, optimization_barrier between backward and AdamW. Returns
-    (step_fn, args, model) with step_fn compiled via to_static."""
+    (step_fn, args, model) with step_fn compiled via to_static.
+
+    pure_bf16: params live in bf16 (halves the param-read HBM traffic the
+    O1 auto_cast pays per use) with fp32 master weights in the AdamW
+    update (multi_precision)."""
     import jax.lax as lax
 
     import paddle_tpu as paddle
@@ -37,8 +41,11 @@ def build_step(k=16, batch=16, seq=512):
     cfg = BertConfig(vocab_size=30720, hidden_dropout=0.0,
                      attention_dropout=0.0)
     model = BertForPretraining(cfg)
+    if pure_bf16:
+        model.to("bfloat16")
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
-                                 learning_rate=1e-4)
+                                 learning_rate=1e-4,
+                                 multi_precision=pure_bf16)
     params = list(model.parameters())
 
     def one_step(ids, tok, labels, nsp_labels):
@@ -67,9 +74,11 @@ def build_step(k=16, batch=16, seq=512):
     return step, args, model
 
 
-def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2):
+def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2,
+                pure_bf16=False):
     seq = 512
-    step, args, model = build_step(k=k, batch=batch, seq=seq)
+    step, args, model = build_step(k=k, batch=batch, seq=seq,
+                                   pure_bf16=pure_bf16)
     for _ in range(warmup):
         loss = step(*args)
     float(loss.numpy())
@@ -89,13 +98,15 @@ def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2):
 
 def main():
     for spec in sys.argv[1:] or ["k16"]:
-        k, batch = 16, 16
+        k, batch, bf16 = 16, 16, False
         for part in spec.split("_"):
-            if part.startswith("k"):
+            if part == "bf16":
+                bf16 = True
+            elif part.startswith("k"):
                 k = int(part[1:])
             elif part.startswith("b"):
                 batch = int(part[1:])
-        run_variant(spec, k=k, batch=batch)
+        run_variant(spec, k=k, batch=batch, pure_bf16=bf16)
 
 
 if __name__ == "__main__":

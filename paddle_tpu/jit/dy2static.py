@@ -22,10 +22,15 @@ helpers fall back to plain Python dispatch when nothing is traced):
 `return` inside `if` branches is lowered by moving the post-if statements
 into the non-returning branch (the reference return_transformer's
 flattening); `break`/`continue` lower to loop-carried flags with
-post-site guards (the reference break_continue_transformer's scheme).
-Not transformed (left as plain Python; traced predicates there still
-fail loudly): `return` inside loops, `while ... else`, `for` over
-tensors.
+post-site guards (the reference break_continue_transformer's scheme);
+`return` inside a loop lowers to a capture + break (the reference
+return_transformer's RETURN_VALUE/early-return-flag scheme) with an
+`if flag: return value` continuation after the loop; `for x in tensor`
+iterates the leading dim through the while lowering (the reference
+loop_transformer + convert_operators.convert_enumerate/iter).
+Not transformed: `while ... else` and `return` inside a NESTED loop —
+both are left as plain Python whose loop condition is wrapped in a
+loud, actionable rejection if a traced value ever reaches it.
 """
 import ast
 import functools
@@ -54,7 +59,10 @@ def _is_traced(v):
 
 class _Undef:
     """Placeholder for a name unbound before a transformed branch assigns
-    it (reference: dygraph_to_static UndefinedVar)."""
+    it (reference: dygraph_to_static UndefinedVar). The object is
+    POISONOUS: any attribute access, arithmetic, indexing, or call on it
+    raises an actionable NameError instead of a confusing
+    AttributeError/TypeError deep inside user code."""
 
     _inst = None
 
@@ -66,10 +74,21 @@ class _Undef:
     def __repr__(self):
         return "<undefined>"
 
-    def __bool__(self):
+    @staticmethod
+    def _raise(*_a, **_k):
         raise NameError(
-            "variable is only assigned in one branch of a transformed "
-            "if/while and was used where it may be undefined")
+            "value is undefined here: it was only assigned in one branch "
+            "of a transformed if, or is a per-iteration temporary not "
+            "carried by a traced loop — bind it before the branch/loop")
+
+    __bool__ = _raise
+
+    def __getattr__(self, name):
+        self._raise()
+
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __getitem__ = __call__ = __iter__ = _raise
+    __len__ = __neg__ = __lt__ = __le__ = __gt__ = __ge__ = _raise
 
 
 UNDEF = _Undef()
@@ -160,6 +179,71 @@ class _Jst:
         return _convert_callee(f)
 
     @staticmethod
+    def check_defined(v):
+        """Guard on a value re-derived after a loop early-return: loud
+        failure if it references a per-iteration temporary the traced
+        loop did not carry (expressions OVER such temps already explode
+        via the poisonous UNDEF dunders)."""
+        def scan(x):
+            if x is UNDEF:
+                raise NameError(
+                    "a value returned from inside a traced loop depends "
+                    "on a per-iteration temporary that is not "
+                    "loop-carried; bind it before the loop or return "
+                    "loop-carried state")
+            if isinstance(x, (tuple, list)):
+                for e in x:
+                    scan(e)
+        scan(v)
+        return v
+
+    @staticmethod
+    def reject_unsupported(kind, v):
+        """Loud failure for constructs the transform deliberately leaves
+        as plain Python: fine while concrete, a clear error (instead of
+        an opaque TracerBoolConversionError) once a traced value hits."""
+        if _is_traced(v):
+            raise NotImplementedError(
+                f"{kind} over a traced (data-dependent) condition or "
+                f"iterable is not supported by to_static; restructure "
+                f"the control flow (e.g. move the else-clause after the "
+                f"loop, or lift the return out of the nested loop)")
+        return v
+
+    @staticmethod
+    def convert_iterable(v):
+        """Normalize a for-loop iterable to an indexable (reference:
+        convert_operators.convert_iter/enumerate): Tensors/arrays index
+        their leading dim; sequences pass through; generators get a
+        LAZY buffering adapter — NOT list(v), which would hang on
+        unbounded readers and fire all side effects up front."""
+        from ..core.tensor import Tensor
+        if isinstance(v, (Tensor, np.ndarray, list, tuple, range, str)):
+            return v
+        import jax
+        if isinstance(v, jax.Array):
+            return v
+        return _LazySeq(v)
+
+    @staticmethod
+    def convert_iter_cont(v, i):
+        """Loop-continuation test for the indexed for-lowering."""
+        from ..core.tensor import Tensor
+        if isinstance(v, _LazySeq):
+            if _is_traced(i):
+                raise NotImplementedError(
+                    "iterating a python generator cannot be traced; "
+                    "materialize it (list(...)) or iterate a tensor")
+            return v.has(int(i))
+        n = (int(v.shape[0]) if isinstance(v, Tensor) or
+             hasattr(v, "shape") else len(v))
+        return i < n  # dispatches through Tensor compare when i traced
+
+    @staticmethod
+    def convert_index(v, i):
+        return v[i]
+
+    @staticmethod
     def convert_range_cont(i, stop, step):
         """Continuation test for a lowered `for ... in range(...)`:
         respects the step sign; rejects step == 0 like Python."""
@@ -173,6 +257,32 @@ class _Jst:
         from ..core.dispatch import unwrap, wrap
         iv, st, sp = (jnp.asarray(unwrap(v)) for v in (i, stop, step))
         return wrap(jnp.where(sp > 0, iv < st, iv > st))
+
+
+class _LazySeq:
+    """Incrementally-buffered view of a one-shot iterator: indexable like
+    a list, but items are pulled only as the loop reaches them (python
+    iteration semantics for side effects and early break)."""
+
+    def __init__(self, it):
+        self._it = iter(it)
+        self._buf = []
+        self._done = False
+
+    def _fill(self, i):
+        while not self._done and len(self._buf) <= i:
+            try:
+                self._buf.append(next(self._it))
+            except StopIteration:
+                self._done = True
+
+    def has(self, i):
+        self._fill(i)
+        return len(self._buf) > i
+
+    def __getitem__(self, i):
+        self._fill(i)
+        return self._buf[i]
 
 
 def _to_bool(v):
@@ -351,6 +461,40 @@ def _guard_break_continue(stmts, brk, cont, used):
     return out
 
 
+def _rewrite_returns(stmts, sites, mk_flag):
+    """Rewrite each `return X` at this loop level into
+    ``<flag_k> = True; break`` and record ``(flag_k, X)`` in `sites`
+    (the reference return_transformer's early-return-flag scheme). The
+    VALUE is not carried through the loop — a per-return boolean flag is
+    (bools always unify across cond branches) — and X is re-evaluated
+    after the loop from the preserved loop-carried state, which equals
+    its value at break time because break exits with the current carry.
+    Descends into if/with/try but NOT nested loops or function defs.
+    Mutates in place."""
+    for st in stmts:
+        if isinstance(st, (ast.For, ast.While, ast.FunctionDef,
+                           ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(st, attr, None)
+            if sub:
+                _rewrite_returns(sub, sites, mk_flag)
+        for h in getattr(st, "handlers", []) or []:
+            _rewrite_returns(h.body, sites, mk_flag)
+    out = []
+    for st in stmts:
+        if isinstance(st, ast.Return):
+            flag = mk_flag()
+            sites.append((flag, st.value if st.value is not None
+                          else ast.Constant(None)))
+            out.append(ast.Assign(targets=[_name(flag, ast.Store())],
+                                  value=ast.Constant(True)))
+            out.append(ast.Break())
+            break  # rest of the block is unreachable
+        out.append(st)
+    stmts[:] = out
+
+
 def _make_fdef(name, args, body):
     """ast.FunctionDef with every required field (incl. py3.12
     type_params) populated."""
@@ -420,9 +564,48 @@ class _Transformer(ast.NodeTransformer):
                     _contains(st.body + st.orelse, (ast.Return,)):
                 res.extend(self._lower_return_if(st, stmts[i + 1:]))
                 return res
+            if isinstance(st, (ast.While, ast.For)) and not st.orelse \
+                    and _contains([st], (ast.Return,)):
+                lowered = self._lower_return_loop(st)
+                if lowered is not None:
+                    # last element is `if rf: return rv`; flatten it with
+                    # the statements after the loop as the continuation
+                    res.extend(lowered[:-1])
+                    res.extend(self._lower_return_if(lowered[-1],
+                                                     stmts[i + 1:]))
+                    return res
             v = self.visit(st)
             res.extend(v if isinstance(v, list) else [v])
         return res
+
+    def _lower_return_loop(self, node):
+        """Lower a loop whose body returns: each return site becomes a
+        flag + break, the loop lowers normally, and a trailing
+        ``if flag_k: return <expr_k>`` chain re-derives the returned
+        value from the preserved carry. Returns None (caller falls back
+        to plain python) when a return sits inside a NESTED loop — that
+        residual is rejected loudly at runtime."""
+        sites = []
+
+        def mk_flag():
+            return f"_jst_rf_{self._uid()}"
+
+        _rewrite_returns(node.body, sites, mk_flag)
+        if _contains(node.body, (ast.Return,)):
+            return None  # return inside a nested loop
+        prologue = [ast.Assign(targets=[_name(flag, ast.Store())],
+                               value=ast.Constant(False))
+                    for flag, _ in sites]
+        res = self.visit(node)
+        out = prologue + (res if isinstance(res, list) else [res])
+        chain = None
+        for flag, expr in reversed(sites):
+            ret = ast.Return(value=ast.Call(
+                func=_jst_attr("check_defined"), args=[expr], keywords=[]))
+            chain = ast.If(test=_name(flag), body=[ret],
+                           orelse=[chain] if chain is not None else [])
+        out.append(chain)
+        return out
 
     def _lower_return_if(self, node, suffix):
         def ends_with_return(body):
@@ -490,8 +673,16 @@ class _Transformer(ast.NodeTransformer):
     # -- while ------------------------------------------------------------
     def visit_While(self, node, tail_stmts=None):
         if node.orelse or _contains(node.body, (ast.Return,)):
+            # while-else / return-in-a-nested-loop stay plain python, but
+            # the condition is wrapped so a traced value produces an
+            # actionable error instead of a TracerBoolConversionError
+            kind = ("while...else" if node.orelse
+                    else "return inside a nested loop")
             self.generic_visit(node)
-            return node  # while-else / return-in-loop: plain python
+            node.test = ast.Call(func=_jst_attr("reject_unsupported"),
+                                 args=[ast.Constant(kind), node.test],
+                                 keywords=[])
+            return node
         if _contains_break_continue(node.body):
             uid_f = self._uid()
             brk = f"_jst_brk_{uid_f}"
@@ -589,7 +780,48 @@ class _Transformer(ast.NodeTransformer):
             res = self.visit_While(loop, tail_stmts=[inc])
             out.extend(res if isinstance(res, list) else [res])
             return out
+        if (not node.orelse
+                and isinstance(node.target, ast.Name)
+                and not _contains(node.body, (ast.Return,))):
+            # generic iterable — `for x in tensor` iterates the leading
+            # dim (reference: loop_transformer + convert_enumerate/iter);
+            # other iterables are materialized so the same indexed
+            # lowering applies
+            uid = self._uid()
+            seq_name = f"_jst_seq_{uid}"
+            it_name = f"_jst_it_{uid}"
+            init = [
+                ast.Assign(targets=[_name(seq_name, ast.Store())],
+                           value=ast.Call(func=_jst_attr("convert_iterable"),
+                                          args=[node.iter], keywords=[])),
+                ast.Assign(targets=[_name(it_name, ast.Store())],
+                           value=ast.Constant(0)),
+            ]
+            test = ast.Call(func=_jst_attr("convert_iter_cont"),
+                            args=[_name(seq_name), _name(it_name)],
+                            keywords=[])
+            bind = ast.Assign(
+                targets=[_name(node.target.id, ast.Store())],
+                value=ast.Call(func=_jst_attr("convert_index"),
+                               args=[_name(seq_name), _name(it_name)],
+                               keywords=[]))
+            inc = ast.AugAssign(target=_name(it_name, ast.Store()),
+                                op=ast.Add(), value=ast.Constant(1))
+            loop = ast.While(test=test, body=[bind] + node.body, orelse=[])
+            out = list(init)
+            res = self.visit_While(loop, tail_stmts=[inc])
+            out.extend(res if isinstance(res, list) else [res])
+            return out
+        # untransformable for-forms stay plain python, but iterating a
+        # TRACED iterable there must fail with an actionable message
+        kind = ("for...else" if node.orelse
+                else "return inside a nested loop"
+                if _contains(node.body, (ast.Return,))
+                else "for with tuple unpacking")
         self.generic_visit(node)
+        node.iter = ast.Call(func=_jst_attr("reject_unsupported"),
+                             args=[ast.Constant(kind), node.iter],
+                             keywords=[])
         return node
 
     @staticmethod

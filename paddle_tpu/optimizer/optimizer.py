@@ -194,6 +194,24 @@ class Optimizer:
     def _get_accumulator(self, slot, param):
         return self._accumulators[(slot, id(param))]
 
+    def _maybe_master(self, param):
+        """Create the fp32 master copy for a low-precision parameter
+        (reference: multi_precision in adam/adamw/momentum ops — the O2
+        mixed-precision contract: params live in bf16/f16 for fwd/bwd
+        HBM traffic, the optimizer updates an fp32 master and casts)."""
+        if not getattr(self, "_multi_precision", False):
+            return None
+        if param._value.dtype not in (jnp.bfloat16, jnp.float16):
+            return None
+        key = ("master", id(param))
+        t = self._accumulators.get(key)
+        if t is None:
+            t = Tensor(param._value.astype(jnp.float32))
+            t.persistable = True
+            t._mark_stateful()
+            self._accumulators[key] = t
+        return t
+
     def _create_accumulators(self, param):
         pass  # subclasses pre-create slots here
 
@@ -245,15 +263,36 @@ class Optimizer:
         for p, g in dense:
             if g is None:
                 continue
-            g = g.astype(jnp.float32) if g.dtype == jnp.bfloat16 else g
+            if g.dtype in (jnp.bfloat16, jnp.float16):
+                g = g.astype(jnp.float32)
             plr = lr * p.__dict__.get("optimize_attr", {}).get("learning_rate", 1.0)
-            new_val = self._apply_one(p, g, plr)
-            p._value = new_val.astype(p._value.dtype)
+            master = self._maybe_master(p)
+            if master is not None:
+                # run the update math on the fp32 master; the bf16 param
+                # only receives the cast result
+                saved_dtype = p._value.dtype
+                p._value = master._value
+                new_val = self._apply_one(p, g, plr)
+                master._value = new_val
+                p._value = new_val.astype(saved_dtype)
+            else:
+                new_val = self._apply_one(p, g, plr)
+                p._value = new_val.astype(p._value.dtype)
         for store in self._flat_stores.values():
             store.flush()
         for p, g in sparse:
             plr = lr * p.__dict__.get("optimize_attr", {}).get("learning_rate", 1.0)
-            self._apply_sparse(p, g, plr)
+            master = self._maybe_master(p)
+            if master is not None:
+                # sparse rows update the fp32 master too, or the next
+                # dense step would reset the param from a stale master
+                saved_dtype = p._value.dtype
+                p._value = master._value
+                self._apply_sparse(p, g, plr)
+                master._value = p._value
+                p._value = master._value.astype(saved_dtype)
+            else:
+                self._apply_sparse(p, g, plr)
         for store in self._flat_stores.values():
             store.flush()
 
@@ -412,12 +451,14 @@ class Adam(Optimizer):
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  name=None, fuse_accumulators=False):
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          fuse_accumulators=fuse_accumulators)
 
     def _create_accumulators(self, param):
         self._add_accumulator("moment1", param)
         self._add_accumulator("moment2", param)
+        self._maybe_master(param)
 
     def _bias_corrected_lr(self, lr):
         t = self._step_count._value.astype(jnp.float32)
@@ -446,7 +487,8 @@ class AdamW(Adam):
                        else getattr(weight_decay, "coeff", 0.01))
         self._decay_fn = apply_decay_param_fun
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, fuse_accumulators=fuse_accumulators)
+                         None, grad_clip, multi_precision=multi_precision,
+                         fuse_accumulators=fuse_accumulators)
 
     def _apply_one(self, p, g, lr):
         m = self._get_accumulator("moment1", p)

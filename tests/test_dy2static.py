@@ -317,3 +317,165 @@ def test_break_inside_with_does_not_recurse():
 
     g = convert_to_static(f)
     assert g(6) == f(6) == 0 + 1 + 2
+
+
+# ---------------------------------------------------------------- round 4:
+# return-in-loop, for-over-tensor, while...else rejection (reference:
+# return_transformer.py RETURN_VALUE flags, loop_transformer.py
+# convert_enumerate/iter)
+
+def test_return_inside_while_early_exit_on_eos():
+    """Decode loop that RETURNS from inside the loop when EOS is hit —
+    the return lowers to a capture + break and an `if flag: return`
+    continuation, all inside one traced program."""
+    def decode(h, eos_at):
+        i = paddle.to_tensor(0)
+        acc = h * 0.0
+        while i < 8:
+            acc = acc + h
+            if acc.sum() > eos_at:
+                return acc.sum() * 10.0, i   # early return, traced pred
+            i = i + 1
+        return acc.sum(), i
+
+    h = paddle.to_tensor(np.ones((2, 2), np.float32))
+    with paddle.no_grad():
+        static = paddle.jit.to_static(decode)
+        for eos in (6.0, 1e9):  # early-return path and run-to-end path
+            ev, ei = decode(h, paddle.to_tensor(eos))
+            gv, gi = static(h, paddle.to_tensor(eos))
+            np.testing.assert_allclose(float(gv.numpy()),
+                                       float(ev.numpy()), rtol=1e-5)
+            assert int(gi.numpy()) == int(ei.numpy())
+        assert len(static._cache) == 1  # both paths share one program
+
+
+def test_return_inside_for_range():
+    def f(x, n):
+        for i in range(n):
+            x = x + 1.0
+            if x.sum() > 5.0:
+                return x * 100.0
+        return x
+
+    x = paddle.to_tensor(np.zeros(2, np.float32))
+    with paddle.no_grad():
+        static = paddle.jit.to_static(f)
+        for n in (2, 10):
+            np.testing.assert_allclose(
+                np.asarray(static(x, paddle.to_tensor(n)).numpy()),
+                np.asarray(f(x, n).numpy()), rtol=1e-5)
+
+
+def test_for_over_tensor_rows_matches_eager():
+    """`for row in tensor:` iterates the leading dim through the while
+    lowering and matches eager row-by-row accumulation."""
+    proj = nn.Linear(4, 4)
+    for p in proj.parameters():
+        p.stop_gradient = True
+
+    def fold(xs):
+        acc = paddle.to_tensor(np.zeros(4, np.float32))
+        for row in xs:
+            acc = acc + paddle.nn.functional.relu(proj(row))
+        return acc.sum()
+
+    xs = paddle.to_tensor(rng.rand(6, 4).astype("float32"))
+    with paddle.no_grad():
+        ev = float(fold(xs).numpy())
+        static = paddle.jit.to_static(fold)
+        gv = float(static(xs).numpy())
+    np.testing.assert_allclose(gv, ev, rtol=1e-5)
+
+
+def test_for_over_tensor_with_traced_break():
+    def first_big(xs, thresh):
+        total = paddle.to_tensor(0.0)
+        for row in xs:
+            if row.sum() > thresh:
+                break
+            total = total + row.sum()
+        return total
+
+    xs = paddle.to_tensor(rng.rand(5, 3).astype("float32"))
+    th = paddle.to_tensor(1.2)
+    with paddle.no_grad():
+        ev = float(first_big(xs, th).numpy())
+        static = paddle.jit.to_static(first_big)
+        gv = float(static(xs, th).numpy())
+    np.testing.assert_allclose(gv, ev, rtol=1e-5)
+
+
+def test_for_over_python_list_still_works_transformed():
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(items):
+        total = 0
+        for x in items:
+            total += x
+        return total
+
+    g = convert_to_static(f)
+    assert g([1, 2, 3]) == 6
+    assert g((4, 5)) == 9
+
+
+def test_while_else_rejected_loudly_when_traced():
+    """while...else stays plain python; a traced condition must raise an
+    actionable NotImplementedError, not an opaque tracer error."""
+    def f(x):
+        i = 0
+        while x.sum() > 0:
+            x = x - 1.0
+            i += 1
+        else:
+            i = -1
+        return x, i
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    static = paddle.jit.to_static(f)
+    with pytest.raises(NotImplementedError, match="while...else"):
+        with paddle.no_grad():
+            static(x)
+
+
+def test_while_else_concrete_still_runs():
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(n):
+        i = 0
+        while i < n:
+            i += 1
+        else:
+            i = i + 100
+        return i
+
+    g = convert_to_static(f)
+    assert g(3) == f(3) == 103
+
+
+def test_for_over_generator_stays_lazy():
+    """Generators must NOT be materialized up front: an early break
+    stops pulling, and an unbounded generator terminates."""
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    pulled = []
+
+    def gen():
+        i = 0
+        while True:  # unbounded
+            pulled.append(i)
+            yield i
+            i += 1
+
+    def f():
+        total = 0
+        for x in gen():
+            if x >= 3:
+                break
+            total += x
+        return total
+
+    g = convert_to_static(f)
+    assert g() == 0 + 1 + 2
+    assert len(pulled) <= 5  # lazy: did not try to drain the stream

@@ -218,3 +218,38 @@ def test_fuse_accumulators_unsupported_compositions_raise():
         shard_optimizer_state)
     with _pytest.raises(NotImplementedError):
         shard_optimizer_state(opt, mesh=None)
+
+
+def test_adamw_multi_precision_master_weights():
+    """multi_precision (reference: adamw op's master-weight path): bf16
+    params update through an fp32 master, so tiny updates that bf16
+    rounding would swallow still accumulate."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    m = nn.Linear(8, 8)
+    m.to("bfloat16")
+    opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                 learning_rate=1e-4, multi_precision=True)
+    masters = [k for k in opt._accumulators if k[0] == "master"]
+    assert len(masters) == 2  # weight + bias
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    w_before_master = np.asarray(
+        opt._accumulators[("master", id(m.weight))]._value)
+    for _ in range(3):
+        out = m(x.astype("bfloat16"))
+        loss = (out.astype("float32") ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    master = np.asarray(opt._accumulators[("master", id(m.weight))]._value)
+    # master moved in fp32 and param is its bf16 cast
+    assert master.dtype == np.float32
+    assert not np.array_equal(master, w_before_master)
+    assert m.weight.dtype == paddle.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(m.weight._value.astype("float32")),
+        np.asarray(paddle.to_tensor(master).astype("bfloat16")._value
+                   .astype("float32")))
