@@ -16,6 +16,7 @@ from . import utils  # noqa: F401
 from . import elastic  # noqa: F401
 from .elastic import ElasticManager  # noqa: F401
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .index_dataset import TreeIndex, LayerWiseSampler  # noqa: F401
 from .utils import recompute  # noqa: F401
 
 from .base import fleet_base as _fb

@@ -1,0 +1,232 @@
+"""TDM tree index (reference: `distributed/index_dataset/
+index_wrapper.{h,cc}` TreeIndex/IndexWrapper — heap-coded retrieval
+trees — and `index_sampler.cc` LayerWiseSampler).
+
+A TreeIndex arranges items as the leaves of a complete ``branch``-ary
+tree; every node carries an embedding id. Codes are heap positions
+(root = 0, children of c = c*branch+1 .. c*branch+branch), so ancestor/
+child/layer arithmetic is pure integer math — no pointers, and every
+query returns fixed-shape numpy arrays ready for a jitted tower step.
+
+Matches the reference API surface: get_travel_codes / get_layer_codes /
+get_ancestor_codes / get_children_codes / get_nodes / get_all_leafs +
+the LayerWiseSampler's per-layer positive-plus-negatives emission.
+"""
+import numpy as np
+
+__all__ = ["TreeIndex", "LayerWiseSampler"]
+
+
+class TreeIndex:
+    """Heap-coded retrieval tree over item ids.
+
+    ``from_items`` builds a balanced tree: leaves sit on the last layer
+    (left-packed), item ids map to leaves in the given order, and
+    internal nodes get fresh ids after the largest item id (the
+    reference's tree-building tools assign ids the same way).
+    """
+
+    def __init__(self, branch, height, id_of_code, code_of_item):
+        self.branch = int(branch)
+        self.height = int(height)          # layers, root layer = 0
+        self._id_of_code = dict(id_of_code)      # heap code -> emb id
+        self._code_of_item = dict(code_of_item)  # item id -> leaf code
+        self._item_of_code = {c: i for i, c in code_of_item.items()}
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_items(cls, item_ids, branch=2):
+        item_ids = [int(x) for x in np.asarray(item_ids).ravel()]
+        n = len(item_ids)
+        if n == 0:
+            raise ValueError("cannot build a tree over zero items")
+        if branch < 2:
+            raise ValueError("branch must be >= 2 (a 1-ary tree is a "
+                             "path, not a retrieval index)")
+        if min(item_ids) <= 0:
+            raise ValueError(
+                "item ids must be positive: 0 is the absent/padding "
+                "sentinel in travel arrays and tdm_child leaf masks")
+        if len(set(item_ids)) != n:
+            raise ValueError("duplicate item ids in from_items")
+        if max(item_ids) > max(1024, 8 * n):
+            raise ValueError(
+                f"max item id {max(item_ids)} is far larger than the "
+                f"item count {n}; travel/emb tables are indexed by raw "
+                f"id (like the reference's Travel tensor) — densify ids "
+                f"to a contiguous range first")
+        height = 1
+        while branch ** (height - 1) < n:
+            height += 1
+        first_leaf = (branch ** (height - 1) - 1) // (branch - 1) \
+            if branch > 1 else height - 1
+        id_of_code = {}
+        code_of_item = {}
+        next_internal = max(item_ids) + 1
+        for i, item in enumerate(item_ids):
+            code = first_leaf + i
+            code_of_item[item] = code
+            id_of_code[code] = item
+        # ancestors of every leaf get internal ids, breadth-consistent
+        seen = set()
+        for leaf in sorted(code_of_item.values()):
+            c = leaf
+            while c > 0:
+                c = (c - 1) // branch
+                if c in seen:
+                    break
+                seen.add(c)
+        for c in sorted(seen):
+            id_of_code[c] = next_internal
+            next_internal += 1
+        return cls(branch, height, id_of_code, code_of_item)
+
+    # -- code arithmetic (reference: index_wrapper.cc) --------------------
+    def layer_of(self, code):
+        lvl, first = 0, 0
+        while True:
+            last = first + self.branch ** lvl - 1 if self.branch == 1 \
+                else (self.branch ** (lvl + 1) - 1) // (self.branch - 1) - 1
+            if code <= last:
+                return lvl
+            lvl += 1
+            first = last + 1
+
+    def get_travel_codes(self, item_id, start_level=0):
+        """Leaf-to-root ancestor codes of `item_id`, deepest first,
+        stopping at `start_level` (GetTravelCodes)."""
+        code = self._code_of_item[int(item_id)]
+        out = []
+        lvl = self.height - 1
+        while lvl >= start_level:
+            out.append(code)
+            code = (code - 1) // self.branch
+            lvl -= 1
+        return out
+
+    def get_layer_codes(self, level):
+        """Codes PRESENT in the tree at `level` (GetLayerCodes)."""
+        if self.branch == 1:
+            first, last = level, level
+        else:
+            first = (self.branch ** level - 1) // (self.branch - 1)
+            last = (self.branch ** (level + 1) - 1) // (self.branch - 1) - 1
+        return [c for c in range(first, last + 1) if c in self._id_of_code]
+
+    def get_ancestor_codes(self, item_ids, level):
+        out = []
+        for it in item_ids:
+            code = self._code_of_item[int(it)]
+            lvl = self.height - 1
+            while lvl > level:
+                code = (code - 1) // self.branch
+                lvl -= 1
+            out.append(code)
+        return out
+
+    def get_children_codes(self, ancestor_code, level=None):
+        """Direct children codes present in the tree (GetChildrenCodes;
+        `level` kept for reference-signature parity)."""
+        first = ancestor_code * self.branch + 1
+        return [c for c in range(first, first + self.branch)
+                if c in self._id_of_code]
+
+    def get_nodes(self, codes):
+        """Embedding ids for `codes` (GetNodes); 0 for absent codes."""
+        return [self._id_of_code.get(int(c), 0) for c in codes]
+
+    def get_all_leafs(self):
+        return [self._item_of_code[c]
+                for c in sorted(self._item_of_code)]
+
+    def emb_id_count(self):
+        return max(self._id_of_code.values()) + 1
+
+    # -- op-shaped exports (feeds for tdm_sampler / tdm_child) -----------
+    def travel_array(self, start_level=1):
+        """(n_items, height - start_level) per-item ancestor EMB IDS,
+        deepest-last — the `Travel` input of tdm_sampler_op (rows are
+        root-side first, like the reference's layer ordering)."""
+        items = self.get_all_leafs()
+        depth = self.height - start_level
+        out = np.zeros((max(items) + 1, depth), np.int64)
+        for it in items:
+            codes = self.get_travel_codes(it, start_level)  # deepest 1st
+            ids = self.get_nodes(codes)[::-1]               # root-side 1st
+            out[it, :len(ids)] = ids
+        return out
+
+    def layer_array(self, start_level=1):
+        """(flat layer emb ids, per-layer offsets) — the `Layer` input of
+        tdm_sampler_op."""
+        flat, offsets = [], [0]
+        for lvl in range(start_level, self.height):
+            flat.extend(self.get_nodes(self.get_layer_codes(lvl)))
+            offsets.append(len(flat))
+        return np.asarray(flat, np.int64), np.asarray(offsets, np.int64)
+
+    def tree_info_array(self):
+        """(n_emb_ids, 3 + branch) rows of [item_id, layer, parent_id,
+        child ids...] — the `TreeInfo` input of tdm_child_op."""
+        n = self.emb_id_count()
+        info = np.zeros((n, 3 + self.branch), np.int64)
+        for code, emb in self._id_of_code.items():
+            layer = self.layer_of(code)
+            parent = self._id_of_code.get((code - 1) // self.branch, 0) \
+                if code > 0 else 0
+            item = self._item_of_code.get(code, 0)
+            row = [item, layer, parent]
+            row += self.get_nodes(self.get_children_codes(code))
+            row += [0] * (3 + self.branch - len(row))
+            info[emb] = row
+        return info
+
+
+class LayerWiseSampler:
+    """Per-layer positive + uniform negatives for TDM training
+    (reference: index_sampler.cc LayerWiseSampler::sample). Deterministic
+    under `seed` — collisions with the positive re-sample, exactly like
+    the reference's do/while."""
+
+    def __init__(self, tree, layer_counts, start_sample_layer=1, seed=0):
+        self.tree = tree
+        self.layer_counts = list(layer_counts)
+        self.start = start_sample_layer
+        self.seed = seed
+        depth = tree.height - start_sample_layer
+        if len(self.layer_counts) != depth:
+            raise ValueError(
+                f"layer_counts must have one entry per sampled layer "
+                f"({depth}), got {len(self.layer_counts)}")
+
+    def sample(self, user_inputs, target_ids, with_hierarchy=False):
+        """Returns rows of [user features..., node_id, label]; one
+        positive + layer_counts[j] negatives per layer per target."""
+        rng = np.random.RandomState(self.seed)
+        tree = self.tree
+        rows = []
+        for i, tid in enumerate(target_ids):
+            codes = tree.get_travel_codes(int(tid), self.start)
+            path = tree.get_nodes(codes)          # deepest first
+            path = path[::-1]                     # root-side first
+            for j, pos in enumerate(path):
+                lvl = self.start + j
+                if with_hierarchy and j > 0:
+                    user = tree.get_nodes(tree.get_ancestor_codes(
+                        user_inputs[i], lvl))
+                else:
+                    user = list(user_inputs[i])
+                layer_ids = tree.get_nodes(tree.get_layer_codes(lvl))
+                if self.layer_counts[j] > len(layer_ids) - 1:
+                    raise ValueError(
+                        f"layer_counts[{j}]={self.layer_counts[j]} "
+                        f"exceeds layer {lvl} size {len(layer_ids)} - 1 "
+                        f"(the positive is excluded; the resample loop "
+                        f"would never terminate)")
+                rows.append(user + [pos, 1])
+                for _ in range(self.layer_counts[j]):
+                    neg = pos
+                    while neg == pos:
+                        neg = layer_ids[rng.randint(len(layer_ids))]
+                    rows.append(user + [neg, 0])
+        return np.asarray(rows, np.int64)

@@ -10,6 +10,7 @@ from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from .ctr_tail import *  # noqa: F401,F403  (pslib/CTR-serving op tail)
+from .tdm import tdm_child, tdm_sampler  # noqa: F401  (tree-index retrieval)
 from .random import rand, randn, randint, randperm, normal, uniform, bernoulli, multinomial  # noqa: F401
 from . import sequence  # noqa: F401
 

@@ -1,0 +1,129 @@
+"""TDM retrieval ops (reference: `operators/tdm_sampler_op.{cc,h}` and
+`operators/tdm_child_op.{cc,h}` — the tree-index training/serving pair
+behind `fluid.contrib.layers.tdm_sampler/tdm_child`).
+
+Host-side numpy ops by design: they run in the input pipeline (like the
+reference's CPU-only kernels) and emit fixed-shape int arrays that feed
+the jitted tower step — sampling stays off the TPU, the math on it.
+"""
+import numpy as np
+
+from ..core.dispatch import unwrap, wrap
+
+__all__ = ["tdm_sampler", "tdm_child"]
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, travel,
+                layer, layer_offsets=None, output_positive=True, seed=0,
+                dtype="int64"):
+    """Layer-wise negative sampling over a TDM tree
+    (tdm_sampler_op.h:49 TDMSamplerInner).
+
+    ``x``: (batch, 1) or (batch,) leaf ITEM ids.
+    ``travel``: (n_items, n_layers) per-item ancestor emb ids, root-side
+    first, 0-padded (TreeIndex.travel_array).
+    ``layer``/``layer_offsets``: flattened per-layer emb ids + offsets
+    (TreeIndex.layer_array); ``layer_node_num_list`` must match the
+    per-layer counts, like the reference validates.
+
+    Returns (out, labels, mask), each
+    (batch, sum(neg_i + output_positive)): positives carry label 1,
+    uniform negatives (resampled on collision, reference's do/while)
+    label 0; mask 0 marks padding rows from trees where this item's
+    path is shorter.
+    """
+    x_np = np.asarray(unwrap(x)).astype(np.int64).ravel()
+    travel = np.asarray(unwrap(travel)).astype(np.int64)
+    layer_flat = np.asarray(unwrap(layer)).astype(np.int64).ravel()
+    if layer_offsets is None:
+        offsets = np.cumsum([0] + list(layer_node_num_list))
+    else:
+        offsets = np.asarray(layer_offsets).astype(np.int64)
+    n_layers = len(neg_samples_num_list)
+    if travel.shape[1] != n_layers or len(offsets) != n_layers + 1:
+        raise ValueError(
+            f"neg_samples_num_list ({n_layers} layers) must match "
+            f"travel width {travel.shape[1]} and layer offsets "
+            f"{len(offsets) - 1}")
+    for li, want in enumerate(layer_node_num_list):
+        have = int(offsets[li + 1] - offsets[li])
+        if have != int(want):
+            raise ValueError(
+                f"layer_node_num_list[{li}]={want} but layer data has "
+                f"{have} nodes")
+        if int(neg_samples_num_list[li]) > have - 1:
+            raise ValueError(
+                f"neg_samples_num_list[{li}]={neg_samples_num_list[li]} "
+                f"exceeds layer size {have} - 1")
+    pos = 1 if output_positive else 0
+    per_layer = [int(n) + pos for n in neg_samples_num_list]
+    width = int(sum(per_layer))
+    batch = x_np.size
+    out = np.zeros((batch, width), np.int64)
+    labels = np.zeros((batch, width), np.int64)
+    mask = np.ones((batch, width), np.int64)
+    rng = np.random.RandomState(seed)
+    for i, item in enumerate(x_np):
+        if not 0 <= item < travel.shape[0]:
+            raise ValueError(
+                f"tdm_sampler input id {item} outside travel table "
+                f"[0, {travel.shape[0]})")
+        col = 0
+        for li in range(n_layers):
+            positive = int(travel[item, li])
+            ids = layer_flat[offsets[li]:offsets[li + 1]]
+            if positive == 0:  # padded path: emit masked zeros
+                w = per_layer[li]
+                mask[i, col:col + w] = 0
+                col += w
+                continue
+            if output_positive:
+                out[i, col] = positive
+                labels[i, col] = 1
+                col += 1
+            for _ in range(int(neg_samples_num_list[li])):
+                neg = positive
+                while neg == positive:
+                    neg = int(ids[rng.randint(ids.size)])
+                out[i, col] = neg
+                col += 1
+    import jax.numpy as jnp
+    dt = jnp.int64 if dtype == "int64" else jnp.int32
+    return (wrap(jnp.asarray(out, dt)), wrap(jnp.asarray(labels, dt)),
+            wrap(jnp.asarray(mask, dt)))
+
+
+def tdm_child(x, tree_info, child_nums, dtype="int64"):
+    """Children lookup over a TDM tree (tdm_child_op.h:34 TDMChildInner).
+
+    ``x``: node EMB ids, any shape. ``tree_info``: (n_emb_ids, 3+branch)
+    rows of [item_id, layer, parent, child ids...] 0-padded
+    (TreeIndex.tree_info_array). Returns (child, leaf_mask) shaped
+    ``x.shape + (child_nums,)``: absent children are 0; leaf_mask is 1
+    where the child exists AND is a leaf (item_id != 0), matching the
+    reference's leaf-flag output.
+    """
+    x_np = np.asarray(unwrap(x)).astype(np.int64)
+    info = np.asarray(unwrap(tree_info)).astype(np.int64)
+    branch = info.shape[1] - 3
+    if child_nums > branch:
+        raise ValueError(
+            f"child_nums {child_nums} exceeds tree branch {branch}")
+    flat = x_np.ravel()
+    child = np.zeros((flat.size, child_nums), np.int64)
+    leaf_mask = np.zeros((flat.size, child_nums), np.int64)
+    for i, nid in enumerate(flat):
+        if not 0 <= nid < info.shape[0]:
+            raise ValueError(
+                f"tdm_child input id {nid} outside tree_info "
+                f"[0, {info.shape[0]})")
+        kids = info[nid, 3:3 + child_nums]
+        child[i] = kids
+        for j, k in enumerate(kids):
+            if k != 0 and info[k, 0] != 0:  # exists and is a leaf
+                leaf_mask[i, j] = 1
+    import jax.numpy as jnp
+    dt = jnp.int64 if dtype == "int64" else jnp.int32
+    shape = x_np.shape + (child_nums,)
+    return (wrap(jnp.asarray(child.reshape(shape), dt)),
+            wrap(jnp.asarray(leaf_mask.reshape(shape), dt)))

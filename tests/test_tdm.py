@@ -1,0 +1,229 @@
+"""TDM tree-index retrieval tests (reference:
+`distributed/index_dataset/index_wrapper.cc` TreeIndex,
+`index_sampler.cc` LayerWiseSampler, `operators/tdm_sampler_op.cc`,
+`operators/tdm_child_op.cc`; driven like the reference's
+test_tdm_sampler_op / test_tdm_child_op + the tree-based retrieval
+demo flow)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet import LayerWiseSampler, TreeIndex
+
+
+def _tree(n_items=8, branch=2):
+    return TreeIndex.from_items(np.arange(1, n_items + 1), branch=branch)
+
+
+class TestTreeIndex:
+    def test_structure_and_code_arithmetic(self):
+        t = _tree(8)  # items 1..8 -> complete binary tree, height 4
+        assert t.height == 4
+        assert t.branch == 2
+        assert t.get_all_leafs() == list(range(1, 9))
+        # travel path: leaf -> root, one code per layer
+        codes = t.get_travel_codes(1)
+        assert len(codes) == 4 and codes[-1] == 0
+        for deeper, upper in zip(codes, codes[1:]):
+            assert (deeper - 1) // 2 == upper
+        # layer codes partition the tree
+        total = sum(len(t.get_layer_codes(lv)) for lv in range(t.height))
+        assert total == 8 + 4 + 2 + 1
+        # children of the root cover layer 1
+        assert t.get_children_codes(0) == t.get_layer_codes(1)
+        # ancestors at level: walking item 1 and item 8 to layer 1 lands
+        # on different subtrees
+        a1, a8 = t.get_ancestor_codes([1, 8], 1)
+        assert a1 != a8
+        assert {a1, a8} <= set(t.get_layer_codes(1))
+        # emb ids: leaves keep item ids, internals are fresh
+        leaf_ids = t.get_nodes(t.get_layer_codes(3))
+        assert leaf_ids == list(range(1, 9))
+        internal = t.get_nodes(t.get_layer_codes(1))
+        assert all(i > 8 for i in internal)
+
+    def test_uneven_item_count_pads_layers(self):
+        t = _tree(5)
+        assert t.height == 4
+        assert len(t.get_layer_codes(3)) == 5
+        assert sorted(t.get_all_leafs()) == [1, 2, 3, 4, 5]
+
+    def test_layerwise_sampler_matches_contract(self):
+        t = _tree(8)
+        s = LayerWiseSampler(t, layer_counts=[1, 1, 2],
+                             start_sample_layer=1, seed=3)
+        rows = s.sample([[7], [9]], [3, 6])
+        # per target: (1+1) + (1+1) + (1+2) = 7 rows
+        assert rows.shape == (14, 3)
+        for tgt_i, tgt in enumerate((3, 6)):
+            block = rows[tgt_i * 7:(tgt_i + 1) * 7]
+            path = t.get_nodes(t.get_travel_codes(tgt, 1))[::-1]
+            # positives appear in order with label 1
+            positives = block[block[:, 2] == 1]
+            assert positives[:, 1].tolist() == path
+            # negatives: right layer, never the positive
+            negs = block[block[:, 2] == 0]
+            for row in negs:
+                lvl = next(lv for lv in range(1, t.height)
+                           if row[1] in t.get_nodes(t.get_layer_codes(lv)))
+                assert row[1] != path[lvl - 1]
+        # determinism
+        rows2 = LayerWiseSampler(t, [1, 1, 2], 1, seed=3).sample(
+            [[7], [9]], [3, 6])
+        np.testing.assert_array_equal(rows, rows2)
+
+
+class TestTdmOps:
+    def test_tdm_sampler_labels_negatives_and_determinism(self):
+        t = _tree(8)
+        travel = t.travel_array(start_level=1)
+        layer_flat, offsets = t.layer_array(start_level=1)
+        counts = np.diff(offsets).tolist()
+        negs = [1, 2, 3]
+        x = np.array([[1], [5], [8]], np.int64)
+        out, labels, mask = paddle.ops.tdm_sampler(
+            paddle.to_tensor(x), negs, counts, travel, layer_flat,
+            layer_offsets=offsets, seed=7)
+        out, labels, mask = (np.asarray(v.numpy())
+                             for v in (out, labels, mask))
+        width = sum(n + 1 for n in negs)
+        assert out.shape == (3, width)
+        np.testing.assert_array_equal(mask, np.ones_like(mask))
+        col = 0
+        for li, n in enumerate(negs):
+            ids = set(layer_flat[offsets[li]:offsets[li + 1]].tolist())
+            for bi, item in enumerate(x.ravel()):
+                pos = travel[item, li]
+                assert out[bi, col] == pos and labels[bi, col] == 1
+                for j in range(1, n + 1):
+                    assert out[bi, col + j] in ids
+                    assert out[bi, col + j] != pos
+                    assert labels[bi, col + j] == 0
+            col += n + 1
+        out2 = paddle.ops.tdm_sampler(
+            paddle.to_tensor(x), negs, counts, travel, layer_flat,
+            layer_offsets=offsets, seed=7)[0]
+        np.testing.assert_array_equal(out, np.asarray(out2.numpy()))
+
+    def test_tdm_sampler_padded_path_masks(self):
+        t = _tree(5)  # uneven tree: some layers padded in travel
+        travel = t.travel_array(start_level=1)
+        # give item 1 a hole at the deepest layer to simulate a shorter
+        # path (the reference masks rows whose travel id is 0)
+        travel = travel.copy()
+        travel[1, -1] = 0
+        layer_flat, offsets = t.layer_array(start_level=1)
+        counts = np.diff(offsets).tolist()
+        out, labels, mask = paddle.ops.tdm_sampler(
+            paddle.to_tensor(np.array([1], np.int64)), [1, 1, 1], counts,
+            travel, layer_flat, layer_offsets=offsets, seed=0)
+        mask = np.asarray(mask.numpy())
+        assert mask[0, -2:].tolist() == [0, 0]  # padded deepest layer
+        assert mask[0, :-2].tolist() == [1] * (mask.shape[1] - 2)
+
+    def test_tdm_child_children_and_leaf_mask(self):
+        t = _tree(8)
+        info = t.tree_info_array()
+        root_emb = t.get_nodes([0])[0]
+        child, leaf = paddle.ops.tdm_child(
+            paddle.to_tensor(np.array([root_emb], np.int64)), info, 2)
+        child = np.asarray(child.numpy())
+        leaf = np.asarray(leaf.numpy())
+        want = t.get_nodes(t.get_children_codes(0))
+        assert child[0].tolist() == want
+        assert leaf[0].tolist() == [0, 0]  # layer-1 nodes: not leaves
+        # a parent of leaves reports leaf_mask 1
+        parent_code = t.get_travel_codes(3)[1]
+        parent_emb = t.get_nodes([parent_code])[0]
+        child2, leaf2 = paddle.ops.tdm_child(
+            paddle.to_tensor(np.array([parent_emb], np.int64)), info, 2)
+        kids = np.asarray(child2.numpy())[0]
+        assert 3 in kids.tolist()
+        assert np.asarray(leaf2.numpy())[0].tolist() == [1, 1]
+
+
+class TestTdmRetrievalEndToEnd:
+    def test_two_tower_trains_and_beam_retrieves(self):
+        """TDM training loop: user tower dot node embeddings, BCE over
+        tdm_sampler positives/negatives, then beam retrieval down the
+        tree via tdm_child recovers each user's preferred item."""
+        n_items, dim = 16, 8
+        t = TreeIndex.from_items(np.arange(1, n_items + 1), branch=2)
+        travel = t.travel_array(start_level=1)
+        layer_flat, offsets = t.layer_array(start_level=1)
+        counts = np.diff(offsets).tolist()
+        negs = [min(2, c - 1) for c in counts]
+        info = t.tree_info_array()
+        n_emb = t.emb_id_count()
+
+        paddle.seed(0)
+        node_emb = nn.Embedding(n_emb, dim)
+        user_emb = nn.Embedding(n_items + 1, dim)
+        opt = paddle.optimizer.Adam(
+            parameters=list(node_emb.parameters())
+            + list(user_emb.parameters()), learning_rate=0.05)
+
+        # each user u prefers item u (identity ground truth)
+        users = np.arange(1, n_items + 1, dtype=np.int64)
+        losses = []
+        for step in range(60):
+            batch = users.copy()
+            out, labels, mask = paddle.ops.tdm_sampler(
+                paddle.to_tensor(batch[:, None]), negs, counts, travel,
+                layer_flat, layer_offsets=offsets, seed=step)
+            u = user_emb(paddle.to_tensor(batch))          # (B, d)
+            nodes = node_emb(out)                          # (B, W, d)
+            logits = paddle.ops.sum(nodes * u.unsqueeze(1), axis=-1)
+            m = mask.astype("float32")
+            loss = paddle.ops.sum(
+                paddle.nn.functional.binary_cross_entropy_with_logits(
+                    logits, labels.astype("float32"), reduction="none")
+                * m) / paddle.ops.sum(m)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+        # beam-search retrieval (beam 4) down the tree
+        def retrieve(uid):
+            uv = np.asarray(user_emb(
+                paddle.to_tensor(np.array([uid]))).numpy())[0]
+            ne = np.asarray(node_emb.weight.numpy())
+            frontier = np.asarray(t.get_nodes(t.get_children_codes(0)),
+                                  np.int64)
+            while True:
+                child, leaf = paddle.ops.tdm_child(
+                    paddle.to_tensor(frontier), info, 2)
+                child = np.asarray(child.numpy()).ravel()
+                leaf = np.asarray(leaf.numpy()).ravel()
+                kids = child[child != 0]
+                if kids.size == 0:
+                    break
+                scores = ne[kids] @ uv
+                keep = kids[np.argsort(-scores)[:4]]
+                if leaf[child != 0].all():
+                    return keep
+                frontier = keep
+            return frontier
+
+        hits = sum(1 for uid in users[:8] if uid in retrieve(int(uid)))
+        assert hits >= 6, f"retrieval hits {hits}/8"
+
+
+class TestTreeIndexValidation:
+    def test_rejects_bad_inputs(self):
+        import pytest
+        with pytest.raises(ValueError, match="positive"):
+            TreeIndex.from_items([0, 1, 2])
+        with pytest.raises(ValueError, match="branch"):
+            TreeIndex.from_items([1, 2], branch=1)
+        with pytest.raises(ValueError, match="duplicate"):
+            TreeIndex.from_items([1, 1, 2])
+        with pytest.raises(ValueError, match="densify"):
+            TreeIndex.from_items([5, 10**9])
+        t = _tree(4)
+        with pytest.raises(ValueError, match="never terminate"):
+            # start at the root layer (size 1): no negative exists
+            LayerWiseSampler(t, [1, 1, 1], start_sample_layer=0,
+                             seed=0).sample([[1]], [2])
