@@ -7,6 +7,12 @@ The reference publishes no numbers (BASELINE.md); the north-star target is
 Program structure (each measured on v5e, kept because it won):
 - ONE compiled program per k training steps (k-unroll amortizes the
   per-execute dispatch/tunnel overhead, ~5 ms/step on the axon tunnel).
+  k=20 beat k=16 by ~2.2% in the round-4 back-to-back A/B (k=32 compiles
+  >10 min; don't).
+- PURE-bf16 parameters with fp32 master weights in AdamW
+  (multi_precision): halves the param-read HBM traffic the O1 auto_cast
+  paid per use; +0.5% back-to-back, composes with k=20 (0.511→0.525 MFU
+  in the round-4 A/B, benchmarks/ab_mfu.py k16 vs k20_bf16).
 - jax.lax.optimization_barrier between the backward and the AdamW update:
   without it XLA interleaves the update fusions with the backward matmuls
   and their HBM throughput drops ~3x (the round-2 fix was a separate
@@ -41,7 +47,7 @@ def main():
     if on_tpu:
         cfg = BertConfig(vocab_size=30720, hidden_dropout=0.0,
                          attention_dropout=0.0)  # base, vocab padded to 128x
-        batch, seq, k, iters, warmup, windows = 16, 512, 16, 1, 1, 6
+        batch, seq, k, iters, warmup, windows = 16, 512, 20, 1, 1, 6
     else:
         cfg = BertConfig(vocab_size=2048, hidden_size=128, num_layers=2,
                          num_heads=4, intermediate_size=512,
@@ -49,8 +55,11 @@ def main():
         batch, seq, k, iters, warmup, windows = 4, 128, 2, 2, 1, 1
 
     model = BertForPretraining(cfg)
+    if on_tpu:
+        model.to("bfloat16")  # pure-bf16 params, fp32 masters in AdamW
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
-                                 learning_rate=1e-4)
+                                 learning_rate=1e-4,
+                                 multi_precision=on_tpu)
     params = list(model.parameters())
 
     def one_step(ids, tok, labels, nsp_labels):

@@ -10,7 +10,8 @@ from .parallel_env import (  # noqa: F401
     set_mesh, current_mesh, make_mesh,
 )
 from .collective import (  # noqa: F401
-    all_reduce, all_gather, reduce, broadcast, scatter, alltoall, send, recv,
+    all_reduce, all_gather, reduce, reduce_scatter, broadcast, scatter,
+    alltoall, send, recv,
     p2p_transfer,
     barrier, new_group, wait, split, ReduceOp,
 )

@@ -182,6 +182,45 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op=op, group=group)
 
 
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Sum the per-rank lists elementwise and keep this rank's shard
+    (reference: c_reducescatter_op.cc). Traced path rides
+    lax.psum_scatter over the mesh axis; single-process eager reduces
+    the local list (the degenerate world, like all_reduce above)."""
+    if op != ReduceOp.SUM:
+        raise NotImplementedError(
+            "reduce_scatter supports ReduceOp.SUM (the reference op is "
+            "sum-only too)")
+    ax = _axis(group)
+    if _in_named_trace(ax):
+        _check_subgroup_in_trace(group, ax)
+
+        def _rs(*vs):
+            return jax.lax.psum_scatter(jnp.stack(vs), ax,
+                                        scatter_dimension=0, tiled=False)
+
+        out = call_op(_rs, *tensor_list, op_name="c_reducescatter")
+        tensor._value = out._value
+        return tensor
+    if jax.process_count() > 1:
+        member, ranks = _eager_subgroup(group)
+        stacked = np.stack([np.asarray(unwrap(t)) for t in tensor_list])
+        gathered = _process_gather(stacked)  # (world, n, ...)
+        if not member:
+            return tensor
+        idxs = list(ranks) if ranks is not None else \
+            list(range(gathered.shape[0]))
+        me = idxs.index(get_rank()) if get_rank() in idxs else None
+        if me is None:
+            return tensor
+        summed = gathered[idxs].sum(axis=0)  # (n, ...)
+        tensor.set_value(summed[me])
+        return tensor
+    tensor.set_value(np.asarray(unwrap(tensor_list[0])))
+    return tensor
+
+
 def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):

@@ -11,7 +11,9 @@ from .manipulation import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
 from .ctr_tail import *  # noqa: F401,F403  (pslib/CTR-serving op tail)
 from .tdm import tdm_child, tdm_sampler  # noqa: F401  (tree-index retrieval)
-from .random import rand, randn, randint, randperm, normal, uniform, bernoulli, multinomial  # noqa: F401
+from .misc_tail import *  # noqa: F401,F403  (round-4 residual op tail)
+from .random import (rand, randn, randint, randperm, normal, uniform,  # noqa: F401
+                     bernoulli, multinomial, truncated_normal)
 from . import sequence  # noqa: F401
 
 from ..core.tensor import Tensor

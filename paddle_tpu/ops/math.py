@@ -38,6 +38,22 @@ def zeros_like(x, dtype=None):
     return Tensor(jnp.zeros_like(unwrap(x), dtype=convert_dtype(dtype)))
 
 
+
+def empty(shape, dtype="float32"):
+    """reference: empty_op.cc — uninitialized-allocation semantics are
+    meaningless under XLA's functional arrays; zeros keep the shape/dtype
+    contract deterministic."""
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def is_empty(x):
+    """reference: is_empty_op.cc — true iff the tensor has zero elements."""
+    return Tensor(jnp.asarray(unwrap(x).size == 0))
+
 def ones_like(x, dtype=None):
     return Tensor(jnp.ones_like(unwrap(x), dtype=convert_dtype(dtype)))
 

@@ -1,0 +1,440 @@
+"""Residual dense-op tail (round 4): segmentation/sequence metrics,
+linear-algebra composites, sharding helpers, and vision IO.
+
+References: `operators/mean_iou_op.{cc,h}`, `operators/chunk_eval_op.{cc,h}`,
+`operators/diag_embed_op.cc`, `operators/bilinear_tensor_product_op.{cc,h}`,
+`operators/shard_index_op.cc`, `operators/sampling_id_op.cc`,
+`operators/match_matrix_tensor_op.{cc,h}` and
+`python/paddle/vision/ops.py` read_file/decode_jpeg (nvjpeg on the
+reference GPU path; PIL-backed host decode here — image IO is input
+pipeline work, not TPU work).
+"""
+import numpy as np
+
+from ..core.dispatch import call_op, call_op_nograd, unwrap, wrap
+
+__all__ = ["mean_iou", "chunk_eval", "diag_embed",
+           "bilinear_tensor_product", "shard_index", "sampling_id",
+           "read_file", "decode_jpeg", "match_matrix_tensor",
+           "add_position_encoding", "batch_fc", "polygon_box_transform",
+           "correlation", "sequence_topk_avg_pooling",
+           "positive_negative_pair"]
+
+
+def mean_iou(input, label, num_classes):  # noqa: A002
+    """Mean intersection-over-union (mean_iou_op.h): per-class
+    correct/wrong counts from the prediction/label pair, IoU averaged
+    over classes that appear. Returns (mean_iou, out_wrong, out_correct)
+    exactly like the reference op."""
+    import jax.numpy as jnp
+
+    def _mi(pred, lab):
+        pred = pred.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        hit = pred == lab
+        correct = jnp.zeros(num_classes, jnp.int32).at[lab].add(
+            hit.astype(jnp.int32))
+        wrong = jnp.zeros(num_classes, jnp.int32)
+        wrong = wrong.at[pred].add((~hit).astype(jnp.int32))
+        wrong = wrong.at[lab].add((~hit).astype(jnp.int32))
+        denom = correct + wrong
+        valid = denom > 0
+        iou = jnp.where(valid, correct / jnp.maximum(denom, 1), 0.0)
+        miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+        return (miou.astype(jnp.float32), wrong, correct)
+
+    return call_op_nograd(_mi, input, label, op_name="mean_iou")
+
+
+def _extract_chunks(tags, scheme, num_chunk_types, excluded):
+    """Chunk segments as {(begin, end, type)} (chunk_eval_op.h
+    ChunkEvalKernel::GetSegments). Tag encoding follows the reference:
+    label = chunk_type * tags_per_type + tag_position."""
+    chunks = set()
+    n = len(tags)
+    if scheme == "plain":
+        i = 0
+        while i < n:
+            t = tags[i]
+            if 0 <= t < num_chunk_types:
+                j = i
+                while j + 1 < n and tags[j + 1] == t:
+                    j += 1
+                chunks.add((i, j, int(t)))
+                i = j + 1
+            else:
+                i += 1
+    elif scheme in ("IOB", "IOE"):
+        # IOB: type*2 = B, type*2+1 = I;  IOE: type*2 = I, type*2+1 = E
+        i = 0
+        while i < n:
+            t = tags[i]
+            ctype, pos = divmod(int(t), 2)
+            if not 0 <= ctype < num_chunk_types:
+                i += 1
+                continue
+            j = i
+            if scheme == "IOB":
+                # chunk starts at B (or stray I, like the reference's
+                # lenient begin detection) and runs through same-type I
+                while j + 1 < n and tags[j + 1] == ctype * 2 + 1:
+                    j += 1
+            else:  # IOE: runs through same-type I, ends at E
+                while j + 1 < n and tags[j] == ctype * 2 and \
+                        tags[j + 1] in (ctype * 2, ctype * 2 + 1):
+                    j += 1
+            chunks.add((i, j, ctype))
+            i = j + 1
+    elif scheme == "IOBES":
+        i = 0
+        while i < n:
+            t = tags[i]
+            ctype, pos = divmod(int(t), 4)  # B, I, E, S
+            if not 0 <= ctype < num_chunk_types:
+                i += 1
+                continue
+            if pos == 3:  # S: singleton
+                chunks.add((i, i, ctype))
+                i += 1
+                continue
+            j = i
+            while j + 1 < n and tags[j + 1] in (ctype * 4 + 1,
+                                                ctype * 4 + 2):
+                end_pos = tags[j + 1] % 4
+                j += 1
+                if end_pos == 2:  # E closes the chunk
+                    break
+            chunks.add((i, j, ctype))
+            i = j + 1
+    else:
+        raise ValueError(f"unknown chunk_scheme {scheme!r} "
+                         f"(IOB, IOE, IOBES, plain)")
+    if excluded:
+        chunks = {c for c in chunks if c[2] not in excluded}
+    return chunks
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk detection precision/recall/F1 (chunk_eval_op.cc — the NER
+    metric). Host-side like the reference's CPU-only kernel. Returns
+    (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks)."""
+    inp = np.asarray(unwrap(input)).astype(np.int64)
+    lab = np.asarray(unwrap(label)).astype(np.int64)
+    if inp.ndim == 1:
+        inp, lab = inp[None, :], lab[None, :]
+    excluded = set(excluded_chunk_types or [])
+    lengths = (np.asarray(unwrap(seq_length)).astype(np.int64).ravel()
+               if seq_length is not None
+               else np.full(inp.shape[0], inp.shape[1], np.int64))
+    n_infer = n_label = n_correct = 0
+    for b in range(inp.shape[0]):
+        L = int(lengths[b])
+        infer = _extract_chunks(inp[b, :L].tolist(), chunk_scheme,
+                                num_chunk_types, excluded)
+        gold = _extract_chunks(lab[b, :L].tolist(), chunk_scheme,
+                               num_chunk_types, excluded)
+        n_infer += len(infer)
+        n_label += len(gold)
+        n_correct += len(infer & gold)
+    precision = n_correct / n_infer if n_infer else 0.0
+    recall = n_correct / n_label if n_label else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if precision + recall else 0.0)
+    import jax.numpy as jnp
+    mk = lambda v, dt: wrap(jnp.asarray(v, dt))  # noqa: E731
+    return (mk(precision, jnp.float32), mk(recall, jnp.float32),
+            mk(f1, jnp.float32), mk(n_infer, jnp.int32),
+            mk(n_label, jnp.int32), mk(n_correct, jnp.int32))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):  # noqa: A002
+    """Embed the last dim as a diagonal of a new square matrix
+    (diag_embed_op.cc): output gains one dim; the diagonal at `offset`
+    along (dim1, dim2) holds the input."""
+    import jax.numpy as jnp
+
+    def _de(x):
+        n = x.shape[-1]
+        m = n + abs(offset)
+        rows = jnp.arange(n) + max(-offset, 0)
+        cols = jnp.arange(n) + max(offset, 0)
+        out = jnp.zeros(x.shape[:-1] + (m, m), x.dtype)
+        out = out.at[..., rows, cols].set(x)
+        nd = out.ndim
+        d1 = dim1 if dim1 >= 0 else nd + dim1
+        d2 = dim2 if dim2 >= 0 else nd + dim2
+        return jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+
+    return call_op(_de, input, op_name="diag_embed")
+
+
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """out[b, k] = x[b]ᵀ W[k] y[b] (+ bias)
+    (bilinear_tensor_product_op.h) — one einsum on the MXU instead of
+    the reference's per-k GEMM loop."""
+    import jax.numpy as jnp
+
+    def _btp(xv, yv, wv, *bv):
+        out = jnp.einsum("bi,kij,bj->bk", xv, wv, yv)
+        if bv:
+            out = out + bv[0]
+        return out
+
+    args = (x, y, weight) + ((bias,) if bias is not None else ())
+    return call_op(_btp, *args, op_name="bilinear_tensor_product")
+
+
+def shard_index(input, index_num, nshards, shard_id,  # noqa: A002
+                ignore_value=-1):
+    """Map global ids onto one shard's local range (shard_index_op.cc):
+    ids owned by `shard_id` become `id % shard_size`, others
+    `ignore_value`."""
+    import jax.numpy as jnp
+
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} outside [0, {nshards})")
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def _si(ids):
+        owner = ids // shard_size
+        return jnp.where(owner == shard_id, ids % shard_size,
+                         ignore_value)
+
+    return call_op_nograd(_si, input, op_name="shard_index")
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0):  # noqa: A002
+    """Sample one column index per row of a probability matrix
+    (sampling_id_op.cc): u ~ U(min, max), index = first j with
+    cumsum(x[i]) > u. Deterministic under `seed` like the reference's
+    seeded engine; seed=0 draws from the global generator."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import random as core_random
+
+    def _sid(xv, key):
+        u = jax.random.uniform(key, (xv.shape[0],), jnp.float32,
+                               minval=min, maxval=max)
+        cs = jnp.cumsum(xv, axis=1)
+        idx = jnp.sum((cs <= u[:, None]).astype(jnp.int64), axis=1)
+        return jnp.minimum(idx, xv.shape[1] - 1)
+
+    key = jax.random.PRNGKey(seed) if seed else core_random.next_key()
+    return call_op_nograd(_sid, x, key, op_name="sampling_id")
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 tensor (python/paddle/vision/ops.py
+    read_file; the reference reads via CPU tensor too)."""
+    import jax.numpy as jnp
+    with open(filename, "rb") as f:
+        data = f.read()
+    return wrap(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (vision/ops.py
+    decode_jpeg; nvjpeg on the reference GPU path — host PIL decode
+    here, image IO belongs to the input pipeline, not the TPU)."""
+    import io
+
+    import jax.numpy as jnp
+    from PIL import Image
+
+    raw = bytes(np.asarray(unwrap(x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)  # HWC -> CHW like the reference
+    return wrap(jnp.asarray(arr))
+
+
+def match_matrix_tensor(x, y, w, x_lens=None, y_lens=None):
+    """Semantic-match tensor (match_matrix_tensor_op.h, the text-match
+    contrib op): for each pair, out[b, t, i, j] = x[b,i]ᵀ W[t] y[b,j].
+
+    The reference consumes LoD pairs and emits a flattened LoD result;
+    the TPU-native form is padded: x (B, Lx, Dx), y (B, Ly, Dy),
+    w (Dx, T, Dy) -> (out (B, T, Lx, Ly), mask (B, 1, Lx, Ly)) with the
+    mask zeroing padded positions from `x_lens`/`y_lens`.
+    """
+    import jax.numpy as jnp
+
+    def _mmt(xv, yv, wv):
+        return jnp.einsum("bid,dtm,bjm->btij", xv, wv, yv)
+
+    out = call_op(_mmt, x, y, w, op_name="match_matrix_tensor")
+    xv = unwrap(x)
+    yv = unwrap(y)
+    b, lx = xv.shape[0], xv.shape[1]
+    ly = yv.shape[1]
+    if x_lens is None and y_lens is None:
+        mask = jnp.ones((b, 1, lx, ly), jnp.float32)
+    else:
+        xl = (jnp.asarray(unwrap(x_lens)).reshape(b, 1)
+              if x_lens is not None else jnp.full((b, 1), lx))
+        yl = (jnp.asarray(unwrap(y_lens)).reshape(b, 1)
+              if y_lens is not None else jnp.full((b, 1), ly))
+        mx = (jnp.arange(lx)[None, :] < xl).astype(jnp.float32)
+        my = (jnp.arange(ly)[None, :] < yl).astype(jnp.float32)
+        mask = (mx[:, :, None] * my[:, None, :])[:, None, :, :]
+    return out, wrap(mask)
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """out = alpha·x + beta·PE (add_position_encoding_op.h): even feature
+    size; first half sin(pos / 10000^(i/half)), second half the matching
+    cos — the Transformer sinusoid the reference implements."""
+    import jax.numpy as jnp
+
+    def _ape(xv):
+        B, L, D = xv.shape
+        if D % 2:
+            raise ValueError("feature size must be even")
+        half = D // 2
+        pos = jnp.arange(L, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32)
+                        / half)
+        pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)],
+                             axis=1)
+        return alpha * xv + beta * pe[None, :, :]
+
+    return call_op(_ape, x, op_name="add_position_encoding")
+
+
+def batch_fc(input, w, bias=None):  # noqa: A002
+    """Per-slot batched FC (batch_fc_op.cc, the rank-aware CTR layer):
+    input (S, B, I) @ w (S, I, O) + bias (S, 1, O) per slot S — one
+    batched MXU matmul instead of the reference's per-slot GEMM loop."""
+    import jax.numpy as jnp
+
+    def _bfc(xv, wv, *bv):
+        out = jnp.einsum("sbi,sio->sbo", xv, wv)
+        if bv:
+            out = out + bv[0]
+        return out
+
+    args = (input, w) + ((bias,) if bias is not None else ())
+    return call_op(_bfc, *args, op_name="batch_fc")
+
+
+def polygon_box_transform(input):  # noqa: A002
+    """EAST geometry-map decode (detection/polygon_box_transform_op.cc):
+    even channels become 4·x_index − v, odd channels 4·y_index − v."""
+    import jax.numpy as jnp
+
+    def _pbt(xv):
+        B, G, H, W = xv.shape
+        xs = jnp.arange(W, dtype=xv.dtype)[None, None, None, :] * 4.0
+        ys = jnp.arange(H, dtype=xv.dtype)[None, None, :, None] * 4.0
+        even = jnp.arange(G) % 2 == 0
+        grid = jnp.where(even[None, :, None, None], xs, ys)
+        return grid - xv
+
+    return call_op(_pbt, input, op_name="polygon_box_transform")
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement,
+                stride1=1, stride2=1):
+    """FlowNet correlation volume (operators/correlation_op.cc): mean
+    over channels of x1 · shift(x2, d) for every displacement d in the
+    (2·max_displacement/stride2 + 1)² window. The displacement loop is a
+    compile-time constant, so XLA sees a fixed stack of fused
+    multiply-reduce ops (the reference hand-writes a CUDA kernel)."""
+    import jax.numpy as jnp
+
+    if kernel_size != 1:
+        raise NotImplementedError(
+            "correlation with kernel_size != 1 (the common FlowNet "
+            "config) is not implemented")
+    d = max_displacement // stride2
+    shifts = [(dy * stride2, dx * stride2)
+              for dy in range(-d, d + 1) for dx in range(-d, d + 1)]
+
+    def _corr(a, b):
+        C = a.shape[1]
+        outs = []
+        for dy, dx in shifts:
+            shifted = jnp.roll(b, (dy, dx), axis=(2, 3))
+            # zero out wrapped rows/cols (roll is circular; the op pads)
+            H, W = a.shape[2], a.shape[3]
+            ymask = (jnp.arange(H) >= dy) & (jnp.arange(H) < H + dy)
+            xmask = (jnp.arange(W) >= dx) & (jnp.arange(W) < W + dx)
+            m = ymask[:, None] & xmask[None, :]
+            outs.append(jnp.sum(a * shifted * m[None, None], axis=1) / C)
+        out = jnp.stack(outs, axis=1)
+        if stride1 > 1:
+            out = out[:, :, ::stride1, ::stride1]
+        return out
+
+    return call_op(_corr, x1, x2, op_name="correlation")
+
+
+def sequence_topk_avg_pooling(x, lengths, topks, channel_num=1):
+    """Top-k average pooling over the sequence axis (operators/
+    sequence_topk_avg_pooling_op.cc, the pyramid text-match pooling).
+    Padded form: x (B, C, L) scores with per-sample `lengths`; for each
+    k in `topks`, the mean of the top-k in-length scores. Returns
+    (B, C, len(topks))."""
+    import jax.numpy as jnp
+
+    topks = list(topks)
+    kmax = max(topks)
+
+    def _tap(xv, lens):
+        L = xv.shape[-1]
+        mask = jnp.arange(L)[None, None, :] < lens[:, None, None]
+        neg = jnp.asarray(-3.4e38, xv.dtype)
+        vals = jnp.where(mask, xv, neg)
+        import jax
+        top = jax.lax.top_k(vals, kmax)[0]
+        outs = []
+        for k in topks:
+            valid = jnp.minimum(lens, k)[:, None].astype(xv.dtype)
+            picked = jnp.where(jnp.arange(kmax)[None, None, :] < valid[
+                :, :, None], top, 0.0)
+            outs.append(jnp.sum(picked, axis=-1)
+                        / jnp.maximum(valid, 1.0))
+        return jnp.stack(outs, axis=-1)
+
+    return call_op(_tap, x, lengths, op_name="sequence_topk_avg_pooling")
+
+
+def positive_negative_pair(score, label, query_id):
+    """Ranking-pair metric (operators/positive_negative_pair_op.cc):
+    within each query, count ordered pairs where the higher-labeled item
+    out-scores the lower one (pos), the reverse (neg), and ties (neu).
+    Returns (positive, negative, neutral) float32 scalars."""
+    import jax.numpy as jnp
+
+    s = np.asarray(unwrap(score), np.float64).ravel()
+    l = np.asarray(unwrap(label), np.float64).ravel()
+    q = np.asarray(unwrap(query_id)).ravel()
+    pos = neg = neu = 0.0
+    for qid in np.unique(q):
+        idx = np.nonzero(q == qid)[0]
+        for a in range(idx.size):
+            for b in range(a + 1, idx.size):
+                i, j = idx[a], idx[b]
+                if l[i] == l[j]:
+                    continue
+                hi, lo = (i, j) if l[i] > l[j] else (j, i)
+                if s[hi] > s[lo]:
+                    pos += 1
+                elif s[hi] < s[lo]:
+                    neg += 1
+                else:
+                    neu += 1
+    return (wrap(jnp.asarray(pos, jnp.float32)),
+            wrap(jnp.asarray(neg, jnp.float32)),
+            wrap(jnp.asarray(neu, jnp.float32)))

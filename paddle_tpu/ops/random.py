@@ -80,3 +80,12 @@ def multinomial(x, num_samples=1, replacement=False):
         g = jax.random.gumbel(key, p.shape)
         _, out = jax.lax.top_k(logits + g, num_samples)
     return Tensor(out.astype(jnp.int64))
+
+
+def truncated_normal(shape, mean=0.0, std=1.0, dtype="float32"):
+    """reference: truncated_gaussian_random_op.cc — normal draw truncated
+    to two standard deviations, rescaled by mean/std."""
+    key = core_random.next_key()
+    z = jax.random.truncated_normal(key, -2.0, 2.0, _shape(shape),
+                                    convert_dtype(dtype))
+    return Tensor(z * std + mean)

@@ -2,7 +2,8 @@
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Adadelta,
-    Adamax, Lamb, Lars,
+    Adamax, Lamb, Lars, DecayedAdagrad, ProximalGD, ProximalAdagrad,
+    Ftrl, Dpsgd,
 )
 from .averaging import (  # noqa: F401
     ModelAverage, ExponentialMovingAverage, LookAhead,

@@ -655,3 +655,117 @@ class Lars(Momentum):
             g + self._lars_wd * p._value)
         v._value = new_v
         return p._value - new_v
+
+
+class DecayedAdagrad(Optimizer):
+    """reference: operators/optimizers/decayed_adagrad_op.h:
+    acc = decay*acc + (1-decay)*g²; p -= lr * g / (sqrt(acc) + eps)."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        self._decay, self._eps = decay, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment", param)
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        acc = self._get_accumulator("moment", p)
+        new_acc = self._decay * acc._value + (1 - self._decay) * \
+            jnp.square(g)
+        acc._value = new_acc
+        return p._value - lr * g / (jnp.sqrt(new_acc) + self._eps)
+
+
+class ProximalGD(Optimizer):
+    """reference: operators/optimizers/proximal_gd_op.h — gradient step
+    followed by the l1/l2 proximal operator."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        self._l1, self._l2 = l1, l2
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _prox(self, prox, step_lr):
+        return (jnp.sign(prox)
+                * jnp.maximum(jnp.abs(prox) - step_lr * self._l1, 0.0)
+                / (1.0 + step_lr * self._l2))
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        return self._prox(p._value - lr * g, lr)
+
+
+class ProximalAdagrad(ProximalGD):
+    """reference: operators/optimizers/proximal_adagrad_op.h — the
+    proximal step with an adagrad-scaled learning rate."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, epsilon=1e-10,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        self._eps = epsilon
+        super().__init__(learning_rate, l1, l2, parameters, weight_decay,
+                         grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("moment", param)
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        acc = self._get_accumulator("moment", p)
+        new_acc = acc._value + jnp.square(g)
+        acc._value = new_acc
+        lr_t = lr / (jnp.sqrt(new_acc) + self._eps)
+        return self._prox(p._value - lr_t * g, lr_t)
+
+
+class Ftrl(Optimizer):
+    """reference: operators/optimizers/ftrl_op.h (lr_power branch
+    folded: the general-power update with the -0.5 shortcut's math)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _create_accumulators(self, param):
+        self._add_accumulator("squared", param)
+        self._add_accumulator("linear", param)
+
+    def _apply_one(self, p, g, lr):
+        g = self._decayed_grad(p, g)
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        new_sq = sq._value + jnp.square(g)
+        pw = -self._lr_power
+        sigma = (new_sq ** pw - sq._value ** pw) / lr
+        new_lin = lin._value + g - sigma * p._value
+        sq._value, lin._value = new_sq, new_lin
+        x = self._l1 * jnp.sign(new_lin) - new_lin
+        y = new_sq ** pw / lr + 2.0 * self._l2
+        return jnp.where(jnp.abs(new_lin) > self._l1, x / y, 0.0)
+
+
+class Dpsgd(Optimizer):
+    """reference: operators/optimizers/dpsgd_op.h — differentially
+    private SGD: per-step l2 clip to `clip`, gaussian noise of scale
+    sigma/batch_size, then the sgd step. Noise draws ride the global
+    functional RNG, so runs are reproducible under paddle.seed."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, grad_clip=None, name=None):
+        self._clip, self._bs, self._sigma = clip, batch_size, sigma
+        super().__init__(learning_rate, parameters, None, grad_clip)
+
+    def _apply_one(self, p, g, lr):
+        import jax
+
+        from ..core import random as core_random
+        norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.minimum(1.0, self._clip / (norm + 1e-12))
+        noise = jax.random.normal(core_random.next_key(), g.shape,
+                                  jnp.float32) * (self._sigma / self._bs)
+        return p._value - lr * (g * scale + noise)

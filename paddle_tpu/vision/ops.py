@@ -17,7 +17,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["yolo_box", "prior_box", "box_coder", "nms", "multiclass_nms",
            "roi_align", "distribute_fpn_proposals", "psroi_pool",
-           "generate_proposals"]
+           "generate_proposals", "bipartite_match", "target_assign",
+           "density_prior_box", "matrix_nms"]
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
@@ -741,3 +742,183 @@ def prroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
     return roi_align(x, boxes, boxes_num, output_size,
                      spatial_scale=spatial_scale, sampling_ratio=4,
                      aligned=False, _clamp_min=False)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5):
+    """Greedy bipartite matching (reference: detection/
+    bipartite_match_op.cc): repeatedly take the global max of the
+    (rows=gt, cols=pred) distance matrix, bind that pair, and remove
+    both; 'per_prediction' then argmax-assigns leftover columns above
+    `dist_threshold`. Host numpy — a data-prep op, like the reference's
+    CPU-only kernel. Input (B, N, M) or (N, M); returns
+    (match_indices (B, M) int64 with -1 for unmatched,
+    match_dist (B, M) float32)."""
+    dm = np.asarray(unwrap(dist_matrix)).astype(np.float32)
+    squeeze = dm.ndim == 2
+    if squeeze:
+        dm = dm[None]
+    B, N, M = dm.shape
+    match_idx = np.full((B, M), -1, np.int64)
+    match_dist = np.zeros((B, M), np.float32)
+    for b in range(B):
+        d = dm[b].copy()
+        for _ in range(min(N, M)):
+            r, c = np.unravel_index(np.argmax(d), d.shape)
+            if d[r, c] <= 0:
+                break
+            match_idx[b, c] = r
+            match_dist[b, c] = d[r, c]
+            d[r, :] = -1.0
+            d[:, c] = -1.0
+        if match_type == "per_prediction":
+            for c in range(M):
+                if match_idx[b, c] >= 0:
+                    continue
+                r = int(np.argmax(dm[b, :, c]))
+                if dm[b, r, c] >= dist_threshold:
+                    match_idx[b, c] = r
+                    match_dist[b, c] = dm[b, r, c]
+    if squeeze:
+        match_idx, match_dist = match_idx[0], match_dist[0]
+    return wrap(jnp.asarray(match_idx)), wrap(jnp.asarray(match_dist))
+
+
+def target_assign(input, match_indices, negative_indices=None,  # noqa: A002
+                  mismatch_value=0):
+    """Assign per-prediction targets by match index (reference:
+    target_assign_op.h): out[b, m] = input[b, match[b, m]] with
+    `mismatch_value` and weight 0 where match is -1; entries named in
+    `negative_indices` get weight 1 (their target stays
+    mismatch_value)."""
+    import jax
+
+    def _ta(x, match):
+        safe = jnp.maximum(match, 0)
+        gathered = jax.vmap(lambda xb, mb: xb[mb])(x, safe)
+        matched = (match >= 0)
+        out = jnp.where(matched[..., None] if gathered.ndim == 3
+                        else matched, gathered,
+                        jnp.asarray(mismatch_value, gathered.dtype))
+        wt = matched.astype(jnp.float32)
+        return out, wt
+
+    out, wt = call_op_nograd(_ta, input, match_indices,
+                             op_name="target_assign")
+    if negative_indices is not None:
+        neg = np.asarray(unwrap(negative_indices)).astype(np.int64)
+        wt_np = np.asarray(unwrap(wt)).copy()
+        for b in range(wt_np.shape[0]):
+            wt_np[b, neg[b][neg[b] >= 0]] = 1.0
+        wt = wrap(jnp.asarray(wt_np))
+    return out, wt
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,  # noqa: A002
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      step=(0.0, 0.0), offset=0.5):
+    """Density prior boxes (reference: detection/density_prior_box_op.h
+    — SSD-style priors laid on a density-refined subgrid per cell).
+    Returns (boxes (H, W, P, 4), variances (H, W, P, 4)) with
+    P = sum(density² per (fixed_size, fixed_ratio))."""
+    feat = unwrap(input)
+    img = unwrap(image)
+    H, W = int(feat.shape[2]), int(feat.shape[3])
+    img_h, img_w = int(img.shape[2]), int(img.shape[3])
+    step_w = step[0] or img_w / W
+    step_h = step[1] or img_h / H
+    boxes = []
+    for s, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = s * np.sqrt(ratio)
+            bh = s / np.sqrt(ratio)
+            shift = 1.0 / density
+            for di in range(density):
+                for dj in range(density):
+                    cx_off = (dj + 0.5) * shift - 0.5
+                    cy_off = (di + 0.5) * shift - 0.5
+                    boxes.append((cx_off, cy_off, bw, bh))
+    P = len(boxes)
+    ys, xs = np.mgrid[0:H, 0:W]
+    cx = (xs + offset)[:, :, None] * step_w \
+        + np.array([b[0] for b in boxes]) * step_w
+    cy = (ys + offset)[:, :, None] * step_h \
+        + np.array([b[1] for b in boxes]) * step_h
+    bw = np.broadcast_to(np.array([b[2] for b in boxes]) / 2.0,
+                         (H, W, P))
+    bh = np.broadcast_to(np.array([b[3] for b in boxes]) / 2.0,
+                         (H, W, P))
+    out = np.stack([(cx - bw) / img_w, (cy - bh) / img_h,
+                    (cx + bw) / img_w, (cy + bh) / img_h],
+                   axis=-1).astype(np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          (H, W, P, 4)).copy()
+    return wrap(jnp.asarray(out)), wrap(jnp.asarray(var))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """Matrix NMS (reference: detection/matrix_nms_op.cc, the SOLOv2
+    parallel soft-suppression): per class, scores decay by the best
+    IoU against higher-scored peers — no sequential suppression loop,
+    so the whole thing is sorting + one IoU matrix per class.
+    bboxes (B, N, 4), scores (B, C, N); returns (out (K, 8) rows of
+    [batch, class, score, x1, y1, x2, y2, 0], rois_num (B,))."""
+    bb = np.asarray(unwrap(bboxes)).astype(np.float32)
+    sc = np.asarray(unwrap(scores)).astype(np.float32)
+    B, C, N = sc.shape
+    rows, per_batch = [], []
+    for b in range(B):
+        cand = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            keep = np.nonzero(sc[b, c] > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[b, c, keep])]
+            if nms_top_k > 0:  # -1 = keep all (paddle convention)
+                order = order[:nms_top_k]
+            boxes = bb[b, order]
+            s = sc[b, c, order].copy()
+            n = order.size
+            # pairwise IoU of the score-sorted boxes
+            x1 = np.maximum(boxes[:, None, 0], boxes[None, :, 0])
+            y1 = np.maximum(boxes[:, None, 1], boxes[None, :, 1])
+            x2 = np.minimum(boxes[:, None, 2], boxes[None, :, 2])
+            y2 = np.minimum(boxes[:, None, 3], boxes[None, :, 3])
+            inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+            area = (boxes[:, 2] - boxes[:, 0]) * \
+                (boxes[:, 3] - boxes[:, 1])
+            iou = inter / np.maximum(area[:, None] + area[None, :]
+                                     - inter, 1e-10)
+            iou = np.triu(iou, 1)  # iou[i, j], i < j (higher score i)
+            # compensation for row i = its own best IoU against HIGHER
+            # scored boxes (matrix_nms_op.cc's compensate_iou)
+            comp = iou.max(axis=0, initial=0.0)
+            if use_gaussian:
+                # reference: exp((comp² − iou²)·sigma), sigma MULTIPLIES
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / np.maximum(1.0 - comp[:, None],
+                                                 1e-10)
+            decay_j = np.where(np.triu(np.ones((n, n), bool), 1),
+                               decay, np.inf).min(axis=0)
+            decay_j = np.where(np.isinf(decay_j), 1.0, decay_j)
+            s = s * decay_j
+            for j in range(n):
+                if s[j] > post_threshold:
+                    cand.append((c, s[j], *boxes[j]))
+        cand.sort(key=lambda r: -r[1])
+        if keep_top_k > 0:  # -1 = keep all
+            cand = cand[:keep_top_k]
+        per_batch.append(len(cand))
+        for c, sval, x1, y1, x2, y2 in cand:
+            rows.append((b, c, sval, x1, y1, x2, y2, 0.0))
+    out = (np.asarray(rows, np.float32) if rows
+           else np.zeros((0, 8), np.float32))
+    return (wrap(jnp.asarray(out)),
+            wrap(jnp.asarray(np.asarray(per_batch, np.int64))))

@@ -1,0 +1,442 @@
+"""Round-4 residual op tail (reference: mean_iou_op.cc, chunk_eval_op.cc,
+diag_embed_op.cc, bilinear_tensor_product_op.cc, shard_index_op.cc,
+sampling_id_op.cc, match_matrix_tensor_op.cc, vision read_file/
+decode_jpeg) — numpy-mirror OpTest-style cases."""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(4)
+
+
+class TestMeanIou:
+    def test_matches_confusion_mirror(self):
+        C = 4
+        pred = rng.randint(0, C, (6, 5)).astype(np.int64)
+        lab = rng.randint(0, C, (6, 5)).astype(np.int64)
+        miou, wrong, correct = paddle.ops.mean_iou(
+            paddle.to_tensor(pred), paddle.to_tensor(lab), C)
+        w = np.zeros(C, np.int64)
+        c = np.zeros(C, np.int64)
+        for p, l in zip(pred.ravel(), lab.ravel()):
+            if p == l:
+                c[l] += 1
+            else:
+                w[p] += 1
+                w[l] += 1
+        denom = w + c
+        valid = denom > 0
+        want = (c[valid] / denom[valid]).mean()
+        np.testing.assert_allclose(float(miou.numpy()), want, rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(wrong.numpy()), w)
+        np.testing.assert_array_equal(np.asarray(correct.numpy()), c)
+
+    def test_perfect_prediction(self):
+        lab = rng.randint(0, 3, (4, 4)).astype(np.int64)
+        miou, _, _ = paddle.ops.mean_iou(
+            paddle.to_tensor(lab), paddle.to_tensor(lab), 3)
+        assert float(miou.numpy()) == pytest.approx(1.0)
+
+
+class TestChunkEval:
+    def test_iob_ner_case(self):
+        # 2 chunk types; IOB: type*2=B, type*2+1=I, 4=Outside
+        #         B0 I0 O  B1 I1 I1 O
+        label = [[0, 1, 4, 2, 3, 3, 4]]
+        #         B0 I0 O  B1 O  O  B0   (2nd chunk cut short + spurious)
+        infer = [[0, 1, 4, 2, 4, 4, 0]]
+        p, r, f1, ni, nl, nc = paddle.ops.chunk_eval(
+            paddle.to_tensor(np.array(infer, np.int64)),
+            paddle.to_tensor(np.array(label, np.int64)),
+            "IOB", 2)
+        assert int(ni.numpy()) == 3
+        assert int(nl.numpy()) == 2
+        assert int(nc.numpy()) == 1  # only the B0 I0 chunk matches
+        assert float(p.numpy()) == pytest.approx(1 / 3)
+        assert float(r.numpy()) == pytest.approx(1 / 2)
+        assert float(f1.numpy()) == pytest.approx(2 * (1/3) * 0.5 / (1/3 + 0.5))
+
+    def test_plain_scheme_and_seq_length(self):
+        label = [[0, 0, 1, 1, 2, 2]]
+        infer = [[0, 0, 1, 1, 2, 2]]
+        # truncate at 4: the type-2 chunk is outside the sequence
+        p, r, f1, ni, nl, nc = paddle.ops.chunk_eval(
+            paddle.to_tensor(np.array(infer, np.int64)),
+            paddle.to_tensor(np.array(label, np.int64)),
+            "plain", 3, seq_length=paddle.to_tensor(
+                np.array([4], np.int64)))
+        assert int(ni.numpy()) == int(nl.numpy()) == int(nc.numpy()) == 2
+        assert float(f1.numpy()) == pytest.approx(1.0)
+
+    def test_iobes_singletons_and_excluded(self):
+        # type 0: B=0 I=1 E=2 S=3; type 1: B=4 I=5 E=6 S=7; O=8
+        label = [[3, 8, 4, 5, 6, 8, 7]]
+        infer = [[3, 8, 4, 5, 6, 8, 8]]
+        _, _, _, ni, nl, nc = paddle.ops.chunk_eval(
+            paddle.to_tensor(np.array(infer, np.int64)),
+            paddle.to_tensor(np.array(label, np.int64)), "IOBES", 2)
+        assert int(nl.numpy()) == 3 and int(ni.numpy()) == 2
+        assert int(nc.numpy()) == 2
+        # excluding type 1 drops its chunks from all counts
+        _, _, _, ni2, nl2, nc2 = paddle.ops.chunk_eval(
+            paddle.to_tensor(np.array(infer, np.int64)),
+            paddle.to_tensor(np.array(label, np.int64)), "IOBES", 2,
+            excluded_chunk_types=[1])
+        assert int(nl2.numpy()) == 1 and int(nc2.numpy()) == 1
+
+
+class TestDiagEmbed:
+    @pytest.mark.parametrize("offset", [0, 1, -2])
+    def test_matches_torch_semantics(self, offset):
+        import torch
+        x = rng.randn(2, 3, 4).astype(np.float32)
+        got = np.asarray(paddle.ops.diag_embed(
+            paddle.to_tensor(x), offset=offset).numpy())
+        want = torch.diag_embed(torch.tensor(x), offset=offset).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_dims_and_grad(self):
+        x = paddle.to_tensor(rng.randn(3).astype(np.float32))
+        x.stop_gradient = False
+        out = paddle.ops.diag_embed(x, offset=0, dim1=0, dim2=1)
+        assert tuple(out.shape) == (3, 3)
+        paddle.ops.sum(out * out).backward()
+        np.testing.assert_allclose(np.asarray(x._grad),
+                                   2 * np.asarray(x.numpy()), rtol=1e-6)
+
+
+class TestBilinearTensorProduct:
+    def test_matches_einsum_mirror_with_grad(self):
+        B, I, J, K = 4, 3, 5, 2
+        x = paddle.to_tensor(rng.randn(B, I).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(B, J).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(K, I, J).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(K).astype(np.float32))
+        for t in (x, y, w, b):
+            t.stop_gradient = False
+        out = paddle.ops.bilinear_tensor_product(x, y, w, b)
+        want = np.einsum("bi,kij,bj->bk", np.asarray(x.numpy()),
+                         np.asarray(w.numpy()), np.asarray(y.numpy())) \
+            + np.asarray(b.numpy())
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-5)
+        paddle.ops.sum(out).backward()
+        assert x._grad is not None and w._grad is not None
+
+
+class TestShardIndex:
+    def test_reference_example(self):
+        # shard_index_op doc example: 20 ids, 2 shards
+        ids = np.array([[1], [6], [12], [19]], np.int64)
+        got0 = np.asarray(paddle.ops.shard_index(
+            paddle.to_tensor(ids), 20, 2, 0).numpy())
+        got1 = np.asarray(paddle.ops.shard_index(
+            paddle.to_tensor(ids), 20, 2, 1).numpy())
+        np.testing.assert_array_equal(got0, [[1], [6], [-1], [-1]])
+        np.testing.assert_array_equal(got1, [[-1], [-1], [2], [9]])
+        with pytest.raises(ValueError):
+            paddle.ops.shard_index(paddle.to_tensor(ids), 20, 2, 5)
+
+
+class TestSamplingId:
+    def test_deterministic_and_distributed(self):
+        probs = np.tile(np.array([[0.05, 0.05, 0.8, 0.1]], np.float32),
+                        (512, 1))
+        out = np.asarray(paddle.ops.sampling_id(
+            paddle.to_tensor(probs), seed=3).numpy())
+        out2 = np.asarray(paddle.ops.sampling_id(
+            paddle.to_tensor(probs), seed=3).numpy())
+        np.testing.assert_array_equal(out, out2)
+        assert out.min() >= 0 and out.max() <= 3
+        # the 0.8 column dominates
+        assert (out == 2).mean() > 0.6
+
+    def test_degenerate_onehot(self):
+        probs = np.eye(4, dtype=np.float32)
+        out = np.asarray(paddle.ops.sampling_id(
+            paddle.to_tensor(probs), seed=1).numpy())
+        np.testing.assert_array_equal(out, [0, 1, 2, 3])
+
+
+class TestVisionIO:
+    def test_read_file_and_decode_jpeg(self, tmp_path):
+        from PIL import Image
+        # smooth gradient: JPEG-friendly content (noise is the codec's
+        # worst case and would fail any content check)
+        yy, xx = np.mgrid[0:10, 0:12]
+        img = np.stack([yy * 20, xx * 20, (yy + xx) * 10],
+                       axis=-1).astype(np.uint8)
+        path = str(tmp_path / "t.jpg")
+        Image.fromarray(img).save(path, quality=95)
+        raw = paddle.ops.read_file(path)
+        assert raw.dtype == paddle.uint8
+        decoded = np.asarray(paddle.ops.decode_jpeg(raw).numpy())
+        assert decoded.shape == (3, 10, 12)
+        # lossy codec: approximate content match
+        assert np.abs(decoded.transpose(1, 2, 0).astype(int)
+                      - img.astype(int)).mean() < 12
+        gray = np.asarray(paddle.ops.decode_jpeg(raw, mode="gray").numpy())
+        assert gray.shape == (1, 10, 12)
+
+
+class TestMatchMatrixTensor:
+    def test_matches_mirror_and_masks(self):
+        B, Lx, Ly, Dx, Dy, T = 2, 4, 5, 3, 3, 2
+        x = rng.randn(B, Lx, Dx).astype(np.float32)
+        y = rng.randn(B, Ly, Dy).astype(np.float32)
+        w = rng.randn(Dx, T, Dy).astype(np.float32)
+        xl = np.array([4, 2], np.int64)
+        yl = np.array([5, 3], np.int64)
+        out, mask = paddle.ops.match_matrix_tensor(
+            paddle.to_tensor(x), paddle.to_tensor(y), paddle.to_tensor(w),
+            x_lens=paddle.to_tensor(xl), y_lens=paddle.to_tensor(yl))
+        want = np.einsum("bid,dtm,bjm->btij", x, w, y)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-4, atol=1e-5)
+        m = np.asarray(mask.numpy())
+        assert m.shape == (B, 1, Lx, Ly)
+        assert m[1, 0, 2:, :].sum() == 0 and m[1, 0, :, 3:].sum() == 0
+        assert m[0].sum() == Lx * Ly
+
+
+class TestNewOptimizers:
+    def _train(self, opt_cls, steps=5, **kw):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        m = nn.Linear(6, 1)
+        opt = opt_cls(learning_rate=0.1, parameters=m.parameters(), **kw)
+        x = paddle.to_tensor(rng.rand(16, 6).astype(np.float32))
+        y = paddle.to_tensor(rng.rand(16, 1).astype(np.float32))
+        losses = []
+        for _ in range(steps):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses
+
+    @pytest.mark.parametrize("name,kw", [
+        ("DecayedAdagrad", {}), ("ProximalGD", {"l1": 0.001}),
+        ("ProximalAdagrad", {"l1": 0.001}), ("Ftrl", {"l1": 0.001}),
+        ("Dpsgd", {"clip": 100.0, "sigma": 0.0}),
+    ])
+    def test_reduces_loss(self, name, kw):
+        import paddle_tpu.optimizer as O
+        losses = self._train(getattr(O, name), steps=12, **kw)
+        assert losses[-1] < losses[0], (name, losses)
+
+    def test_ftrl_matches_numpy_rule(self):
+        import paddle_tpu.optimizer as O
+        from paddle_tpu.core.tensor import Parameter
+        import jax.numpy as jnp
+        p0 = np.array([0.5, -0.4, 0.3], np.float32)
+        param = Parameter(jnp.asarray(p0))
+        opt = O.Ftrl(learning_rate=0.1, l1=0.01, l2=0.02,
+                     parameters=[param])
+        gseq = [rng.randn(3).astype(np.float32) for _ in range(3)]
+        # numpy mirror of ftrl_op.h (lr_power=-0.5)
+        p, sq, lin = p0.copy(), np.zeros(3), np.zeros(3)
+        lr = 0.1
+        for g in gseq:
+            new_sq = sq + g * g
+            sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / lr
+            lin = lin + g - sigma * p
+            x = 0.01 * np.sign(lin) - lin
+            y = np.sqrt(new_sq) / lr + 2 * 0.02
+            p = np.where(np.abs(lin) > 0.01, x / y, 0.0)
+            sq = new_sq
+        for g in gseq:
+            param._grad = jnp.asarray(g)
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(np.asarray(param.numpy()), p,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestDetectionTail:
+    def test_bipartite_match_greedy(self):
+        import paddle_tpu.vision.ops as V
+        d = np.array([[[0.9, 0.2, 0.1],
+                       [0.8, 0.7, 0.3]]], np.float32)
+        idx, dist = V.bipartite_match(paddle.to_tensor(d))
+        # global max 0.9 binds (row0,col0); next best among remaining is
+        # (row1,col1)=0.7; col2 unmatched
+        np.testing.assert_array_equal(np.asarray(idx.numpy()),
+                                      [[0, 1, -1]])
+        idx2, _ = V.bipartite_match(paddle.to_tensor(d),
+                                    match_type="per_prediction",
+                                    dist_threshold=0.25)
+        np.testing.assert_array_equal(np.asarray(idx2.numpy()),
+                                      [[0, 1, 1]])
+
+    def test_target_assign_gather_and_weights(self):
+        import paddle_tpu.vision.ops as V
+        x = paddle.to_tensor(rng.rand(1, 3, 4).astype(np.float32))
+        match = paddle.to_tensor(np.array([[2, -1, 0]], np.int64))
+        out, wt = V.target_assign(x, match, mismatch_value=0)
+        xn = np.asarray(x.numpy())
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0], xn[0, 2])
+        np.testing.assert_array_equal(np.asarray(out.numpy())[0, 1],
+                                      np.zeros(4))
+        np.testing.assert_array_equal(np.asarray(wt.numpy()), [[1, 0, 1]])
+
+    def test_density_prior_box_geometry(self):
+        import paddle_tpu.vision.ops as V
+        boxes, var = V.density_prior_box(
+            paddle.to_tensor(np.zeros((1, 3, 2, 2), np.float32)),
+            paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32)),
+            densities=[2], fixed_sizes=[8.0], fixed_ratios=[1.0])
+        b = np.asarray(boxes.numpy())
+        assert b.shape == (2, 2, 4, 4)
+        # away from edges nothing clips: all widths = fixed_size/img
+        w = b[1, 1, :, 2] - b[1, 1, :, 0]
+        np.testing.assert_allclose(w, 8.0 / 64.0, rtol=1e-5)
+        # density 2 puts 4 distinct centers per cell on a half-step grid
+        cx = (b[0, 0, :, 0] + b[0, 0, :, 2]) / 2
+        cy = (b[0, 0, :, 1] + b[0, 0, :, 3]) / 2
+        assert len({(round(float(a), 5), round(float(c), 5))
+                    for a, c in zip(cx, cy)}) == 4
+        v = np.asarray(var.numpy())
+        np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_matrix_nms_suppresses_duplicates(self):
+        import paddle_tpu.vision.ops as V
+        # two near-identical boxes + one distant: the duplicate's score
+        # must decay hard, the distant box must survive untouched
+        bb = np.array([[[0, 0, 10, 10], [0, 0, 10, 10.5],
+                        [50, 50, 60, 60]]], np.float32)
+        sc = np.array([[[0.9, 0.85, 0.8]]], np.float32)
+        out, num = V.matrix_nms(paddle.to_tensor(bb),
+                                paddle.to_tensor(sc), 0.01,
+                                background_label=-1)
+        o = np.asarray(out.numpy())
+        assert int(num.numpy()[0]) == 3
+        s = np.sort(o[:, 2])
+        assert np.isclose(s[-1], 0.9, atol=1e-5)   # top box untouched
+        assert np.isclose(s[-2], 0.8, atol=1e-5)   # distant box kept
+        assert s[0] < 0.2                          # duplicate decayed
+
+
+class TestMiscTailOps:
+    def test_add_position_encoding_mirror(self):
+        x = rng.rand(2, 5, 8).astype(np.float32)
+        got = np.asarray(paddle.ops.add_position_encoding(
+            paddle.to_tensor(x), alpha=0.5, beta=2.0).numpy())
+        half = 4
+        pos = np.arange(5)[:, None]
+        div = 10000.0 ** (np.arange(half) / half)
+        pe = np.concatenate([np.sin(pos / div), np.cos(pos / div)], 1)
+        np.testing.assert_allclose(got, 0.5 * x + 2.0 * pe[None],
+                                   rtol=1e-5)
+
+    def test_batch_fc_mirror(self):
+        x = rng.rand(3, 4, 5).astype(np.float32)
+        w = rng.rand(3, 5, 6).astype(np.float32)
+        b = rng.rand(3, 1, 6).astype(np.float32)
+        got = np.asarray(paddle.ops.batch_fc(
+            paddle.to_tensor(x), paddle.to_tensor(w),
+            paddle.to_tensor(b)).numpy())
+        np.testing.assert_allclose(got,
+                                   np.einsum("sbi,sio->sbo", x, w) + b,
+                                   rtol=1e-5)
+
+    def test_polygon_box_transform_formula(self):
+        x = rng.rand(1, 2, 3, 4).astype(np.float32)
+        got = np.asarray(paddle.ops.polygon_box_transform(
+            paddle.to_tensor(x)).numpy())
+        xs = np.arange(4)[None, None, None, :] * 4.0
+        ys = np.arange(3)[None, None, :, None] * 4.0
+        np.testing.assert_allclose(got[:, 0], (xs - x[:, 0:1])[:, 0],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(got[:, 1], (ys - x[:, 1:2])[:, 0],
+                                   rtol=1e-6)
+
+    def test_correlation_center_is_mean_product(self):
+        a = rng.rand(1, 4, 6, 6).astype(np.float32)
+        b = rng.rand(1, 4, 6, 6).astype(np.float32)
+        out = np.asarray(paddle.ops.correlation(
+            paddle.to_tensor(a), paddle.to_tensor(b), 2, 1, 2).numpy())
+        assert out.shape == (1, 25, 6, 6)
+        # center displacement (0,0) = channel-mean of a*b
+        np.testing.assert_allclose(out[0, 12], (a * b).mean(1)[0],
+                                   rtol=1e-5)
+
+    def test_sequence_topk_avg_pooling_mirror(self):
+        x = rng.rand(2, 3, 7).astype(np.float32)
+        lens = np.array([7, 4], np.int64)
+        got = np.asarray(paddle.ops.sequence_topk_avg_pooling(
+            paddle.to_tensor(x), paddle.to_tensor(lens), [1, 3]).numpy())
+        for bi in range(2):
+            L = lens[bi]
+            for c in range(3):
+                vals = np.sort(x[bi, c, :L])[::-1]
+                np.testing.assert_allclose(got[bi, c, 0], vals[:1].mean(),
+                                           rtol=1e-5)
+                np.testing.assert_allclose(
+                    got[bi, c, 1], vals[:min(3, L)].mean(), rtol=1e-5)
+
+    def test_positive_negative_pair_counts(self):
+        s = paddle.to_tensor(np.array([0.9, 0.1, 0.8, 0.2], np.float32))
+        l = paddle.to_tensor(np.array([1, 0, 0, 1], np.float32))
+        q = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        pos, neg, neu = paddle.ops.positive_negative_pair(s, l, q)
+        assert (float(pos.numpy()), float(neg.numpy()),
+                float(neu.numpy())) == (1.0, 1.0, 0.0)
+
+    def test_truncated_normal_bounds(self):
+        v = np.asarray(paddle.ops.truncated_normal(
+            [5000], mean=1.0, std=0.5).numpy())
+        assert v.min() >= 1.0 - 2 * 0.5 - 1e-5
+        assert v.max() <= 1.0 + 2 * 0.5 + 1e-5
+        assert abs(v.mean() - 1.0) < 0.05
+
+
+def test_reduce_scatter_on_mesh():
+    """reduce_scatter lowers to psum_scatter inside shard_map (the
+    c_reducescatter analog): each rank ends with the rank-th elementwise
+    sum of the per-rank lists."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.core.tensor import Tensor
+
+    mesh = dist.make_mesh({"dp": 8})
+    dist.set_mesh(mesh)
+    group = dist.new_group(axis_name="dp")
+
+    def body(x):
+        # every rank contributes a list of 8 chunks; chunk r of the
+        # result = sum over ranks of their r-th chunk
+        t = Tensor(x[:1] * 0.0)
+        lst = [Tensor(x[:1] + float(r)) for r in range(8)]
+        dist.reduce_scatter(t, lst, group=group)
+        return t._value
+
+    x = np.arange(8, dtype=np.float32)
+    out = jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                        out_specs=P("dp"))(x)
+    # rank k holds x[k]; chunk r result = sum_k (x[k] + r) = 28 + 8r;
+    # rank r keeps chunk r
+    np.testing.assert_allclose(np.asarray(out),
+                               28.0 + 8.0 * np.arange(8))
+
+
+def test_matrix_nms_gaussian_and_keep_all():
+    import paddle_tpu.vision.ops as V
+    bb = np.array([[[0, 0, 10, 10], [0, 0, 10, 10.5],
+                    [50, 50, 60, 60]]], np.float32)
+    sc = np.array([[[0.9, 0.85, 0.8]]], np.float32)
+    out, num = V.matrix_nms(paddle.to_tensor(bb), paddle.to_tensor(sc),
+                            0.01, background_label=-1, use_gaussian=True,
+                            gaussian_sigma=2.0, nms_top_k=-1,
+                            keep_top_k=-1)
+    o = np.asarray(out.numpy())
+    assert int(num.numpy()[0]) == 3  # -1 = keep all
+    s = np.sort(o[:, 2])
+    # gaussian decay with sigma MULTIPLYING: near-duplicate crushed
+    assert s[0] < 0.2 and np.isclose(s[-1], 0.9, atol=1e-5)
