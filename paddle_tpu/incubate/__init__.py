@@ -7,6 +7,7 @@ from ..nn.functional.activation import softmax  # noqa: F401
 from . import auto_checkpoint  # noqa: F401
 from .custom_op import load_custom_op  # noqa: F401
 from . import moe  # noqa: F401
+from . import fleet as fleet1x  # noqa: F401  (legacy fleet 1.x facade)
 from ..optimizer.averaging import (  # noqa: F401
     ModelAverage, LookAhead,
 )

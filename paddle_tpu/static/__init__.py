@@ -16,6 +16,8 @@ from .program import (  # noqa: F401
 )
 from ..jit.to_static import InputSpec  # noqa: F401
 from .passes import apply_pass, register_pass, list_passes, prune  # noqa: F401
+from .transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig, PsServerProgram)
 from .. import nn as _nn  # re-export for paddle.static.nn style usage
 
 _STATIC_MODE = [False]

@@ -58,6 +58,7 @@ class Program:
         self._buffer_updates = {}  # buffer slot -> producing out slot
         self._optimizer = None
         self._loss_slot = None
+        self._ps_ctx = None  # set by DistributeTranspiler.transpile()
         self._compiled = {}
         self.random_seed = None
 
@@ -273,6 +274,15 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
         prog = program or default_main_program()
+        from .transpiler import PsServerProgram
+        if isinstance(prog, PsServerProgram):  # listen_and_serv analog
+            prog.run_server()
+            return []
+        if getattr(prog, "_ps_ctx", None) is not None:
+            # transpiled trainer half: grads on device, optimizer on the
+            # parameter servers (static/transpiler.py)
+            return prog._ps_ctx.run_step(prog, feed, fetch_list,
+                                         return_numpy)
         if not prog.ops:  # startup program: params already initialized eagerly
             return []
         feed = feed or {}
