@@ -18,7 +18,8 @@ from ..core.tensor import Tensor
 __all__ = ["yolo_box", "prior_box", "box_coder", "nms", "multiclass_nms",
            "roi_align", "distribute_fpn_proposals", "psroi_pool",
            "generate_proposals", "bipartite_match", "target_assign",
-           "density_prior_box", "matrix_nms"]
+           "density_prior_box", "matrix_nms", "rpn_target_assign",
+           "mine_hard_examples", "detection_map"]
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
@@ -922,3 +923,186 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
            else np.zeros((0, 8), np.float32))
     return (wrap(jnp.asarray(out)),
             wrap(jnp.asarray(np.asarray(per_batch, np.int64))))
+
+
+def _iou_xyxy(a, b):
+    """Pairwise IoU of (N, 4) vs (M, 4) corner boxes."""
+    x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter,
+                              1e-10)
+
+
+def rpn_target_assign(anchors, gt_boxes, is_crowd=None,
+                      rpn_batch_size_per_im=256, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      use_random=False, seed=0):
+    """RPN anchor sampling (reference: detection/rpn_target_assign_op.cc
+    + layers/detection.py:312): positives = best-anchor-per-gt plus any
+    anchor with IoU > rpn_positive_overlap; negatives sampled from
+    IoU < rpn_negative_overlap down to the batch-size budget. Host
+    numpy (data-prep op, CPU-only in the reference too); `use_random`
+    draws deterministically from `seed`, else takes the first K (the
+    reference unit tests' mode). Returns (loc_index, score_index,
+    tgt_bbox_targets, tgt_labels) for ONE image."""
+    A = np.asarray(unwrap(anchors), np.float32).reshape(-1, 4)
+    G = np.asarray(unwrap(gt_boxes), np.float32).reshape(-1, 4)
+    crowd = (np.asarray(unwrap(is_crowd)).reshape(-1).astype(bool)
+             if is_crowd is not None else np.zeros(len(G), bool))
+    G_use = G[~crowd]
+    iou = _iou_xyxy(A, G_use) if len(G_use) else np.zeros((len(A), 1))
+    best_gt = iou.argmax(axis=1)
+    best_iou = iou.max(axis=1) if iou.size else np.zeros(len(A))
+    labels = np.full(len(A), -1, np.int64)  # -1 = ignore
+    if len(G_use):
+        # (1) best anchor for each gt is positive (incl. ties) — but a
+        # gt no anchor overlaps at all must not poison every anchor
+        per_gt_best = iou.max(axis=0)
+        for g in range(iou.shape[1]):
+            if per_gt_best[g] > 0:
+                labels[iou[:, g] >= per_gt_best[g] - 1e-9] = 1
+        # (2) high-overlap anchors are positive
+        labels[best_iou >= rpn_positive_overlap] = 1
+    neg_cand = np.nonzero(best_iou < rpn_negative_overlap)[0]
+    neg_cand = neg_cand[labels[neg_cand] != 1]
+    rng = np.random.RandomState(seed)
+    n_fg = int(rpn_batch_size_per_im * rpn_fg_fraction)
+    fg = np.nonzero(labels == 1)[0]
+    if len(fg) > n_fg:
+        drop = (rng.choice(fg, len(fg) - n_fg, replace=False)
+                if use_random else fg[n_fg:])
+        labels[drop] = -1
+        fg = np.nonzero(labels == 1)[0]
+    n_bg = rpn_batch_size_per_im - len(fg)
+    if len(neg_cand) > n_bg:
+        bg = (rng.choice(neg_cand, n_bg, replace=False)
+              if use_random else neg_cand[:n_bg])
+    else:
+        bg = neg_cand
+    labels[bg] = 0
+    loc_index = np.nonzero(labels == 1)[0]
+    score_index = np.concatenate([loc_index,
+                                  np.nonzero(labels == 0)[0]])
+    # bbox regression targets of the positives vs their matched gt
+    # (box_coder encode_center_size, like the reference)
+    tgt = np.zeros((len(loc_index), 4), np.float32)
+    if len(loc_index) and len(G_use):
+        a = A[loc_index]
+        g = G_use[best_gt[loc_index]]
+        aw, ah = a[:, 2] - a[:, 0], a[:, 3] - a[:, 1]
+        ax, ay = a[:, 0] + aw / 2, a[:, 1] + ah / 2
+        gw, gh = g[:, 2] - g[:, 0], g[:, 3] - g[:, 1]
+        gx, gy = g[:, 0] + gw / 2, g[:, 1] + gh / 2
+        tgt = np.stack([(gx - ax) / np.maximum(aw, 1e-6),
+                        (gy - ay) / np.maximum(ah, 1e-6),
+                        np.log(np.maximum(gw, 1e-6)
+                               / np.maximum(aw, 1e-6)),
+                        np.log(np.maximum(gh, 1e-6)
+                               / np.maximum(ah, 1e-6))],
+                       axis=1).astype(np.float32)
+    tgt_labels = labels[score_index].astype(np.int64)
+    return (wrap(jnp.asarray(loc_index)), wrap(jnp.asarray(score_index)),
+            wrap(jnp.asarray(tgt)), wrap(jnp.asarray(tgt_labels)))
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       mining_type="max_negative", sample_size=None):
+    """SSD hard-negative mining (reference: detection/
+    mine_hard_examples_op.cc, max_negative mode): per image, keep the
+    highest-loss negatives up to neg_pos_ratio x positives (or
+    sample_size). Returns neg_indices (B, max_neg) padded with -1."""
+    if mining_type != "max_negative":
+        raise NotImplementedError(
+            "mine_hard_examples: only max_negative mining is implemented "
+            "(hard_example mode needs the full loss, like the reference)")
+    loss = np.asarray(unwrap(cls_loss), np.float32)
+    match = np.asarray(unwrap(match_indices), np.int64)
+    B, P = match.shape
+    per_img = []
+    for b in range(B):
+        pos = int((match[b] >= 0).sum())
+        # zero positives -> zero negatives (reference: num_pos * ratio)
+        budget = (int(sample_size) if sample_size is not None
+                  else int(neg_pos_ratio * pos))
+        negs = np.nonzero(match[b] < 0)[0]
+        order = negs[np.argsort(-loss[b, negs])][:budget]
+        per_img.append(np.sort(order))
+    width = max((len(x) for x in per_img), default=0)
+    out = np.full((B, max(width, 1)), -1, np.int64)
+    for b, idx in enumerate(per_img):
+        out[b, :len(idx)] = idx
+    return wrap(jnp.asarray(out))
+
+
+def detection_map(detect_res, gt_label_box, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral"):
+    """Detection mAP metric (reference: detection/detection_map_op.cc;
+    '11point' and 'integral' AP). Host numpy metric op.
+
+    ``detect_res``: rows of [image_id, class, score, x1, y1, x2, y2].
+    ``gt_label_box``: rows of [image_id, class, difficult, x1, y1, x2, y2].
+    Returns the scalar mAP over non-background classes present in gt."""
+    det = np.asarray(unwrap(detect_res), np.float32).reshape(-1, 7)
+    gt = np.asarray(unwrap(gt_label_box), np.float32).reshape(-1, 7)
+    if len(gt) and gt[:, 1].max() >= class_num:
+        raise ValueError(
+            f"gt class id {int(gt[:, 1].max())} >= class_num {class_num}")
+    aps = []
+    for c in np.unique(gt[:, 1]).astype(int):
+        if c == background_label:
+            continue
+        gt_c = gt[gt[:, 1] == c]
+        difficult = gt_c[:, 2] != 0
+        # VOC semantics: difficult gts stay MATCHABLE, but a detection
+        # matching one counts as neither TP nor FP, and they don't
+        # count toward the recall denominator
+        n_gt = int((~difficult).sum()) if not evaluate_difficult \
+            else len(gt_c)
+        det_c = det[det[:, 1] == c]
+        det_c = det_c[np.argsort(-det_c[:, 2])]
+        matched = set()
+        tp = np.zeros(len(det_c))
+        fp = np.zeros(len(det_c))
+        for i, d in enumerate(det_c):
+            cand = gt_c[gt_c[:, 0] == d[0]]
+            cand_idx = np.nonzero(gt_c[:, 0] == d[0])[0]
+            if len(cand) == 0:
+                fp[i] = 1
+                continue
+            iou = _iou_xyxy(d[None, 3:7], cand[:, 3:7])[0]
+            j = int(iou.argmax())
+            if iou[j] >= overlap_threshold:
+                if not evaluate_difficult and difficult[cand_idx[j]]:
+                    continue  # skip: neither TP nor FP
+                if (d[0], cand_idx[j]) not in matched:
+                    tp[i] = 1
+                    matched.add((d[0], cand_idx[j]))
+                else:
+                    fp[i] = 1
+            else:
+                fp[i] = 1
+        if n_gt == 0:
+            continue
+        ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+        recall = ctp / n_gt
+        precision = ctp / np.maximum(ctp + cfp, 1e-10)
+        if ap_version == "11point":
+            ap = float(np.mean([
+                precision[recall >= t].max() if (recall >= t).any()
+                else 0.0 for t in np.linspace(0, 1, 11)]))
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for p, r in zip(precision, recall):
+                ap += p * (r - prev_r)
+                prev_r = r
+            ap = float(ap)
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    return wrap(jnp.asarray(m, jnp.float32))

@@ -440,3 +440,106 @@ def test_matrix_nms_gaussian_and_keep_all():
     s = np.sort(o[:, 2])
     # gaussian decay with sigma MULTIPLYING: near-duplicate crushed
     assert s[0] < 0.2 and np.isclose(s[-1], 0.9, atol=1e-5)
+
+
+class TestDetectionTraining:
+    def test_rpn_target_assign_contract(self):
+        import paddle_tpu.vision.ops as V
+        anchors = np.array([
+            [0, 0, 10, 10],     # ~gt0
+            [1, 1, 11, 11],     # high overlap with gt0
+            [40, 40, 50, 50],   # ~gt1
+            [100, 100, 110, 110],  # background
+            [200, 200, 210, 210],  # background
+        ], np.float32)
+        gts = np.array([[0, 0, 10, 10], [40, 40, 50, 50]], np.float32)
+        loc, score, tgt, lab = V.rpn_target_assign(
+            paddle.to_tensor(anchors), paddle.to_tensor(gts),
+            rpn_batch_size_per_im=4, rpn_positive_overlap=0.7,
+            rpn_negative_overlap=0.3)
+        loc = np.asarray(loc.numpy())
+        lab = np.asarray(lab.numpy())
+        # exact-match anchors are positive (best-per-gt rule)
+        assert 0 in loc and 2 in loc
+        # backgrounds fill the rest of the budget as label 0
+        assert (lab == 1).sum() == len(loc)
+        assert (lab == 0).sum() >= 1
+        # perfect-overlap positives have ~zero regression targets
+        t = np.asarray(tgt.numpy())
+        row0 = list(loc).index(0)
+        np.testing.assert_allclose(t[row0], np.zeros(4), atol=1e-5)
+
+    def test_mine_hard_examples_max_negative(self):
+        import paddle_tpu.vision.ops as V
+        loss = np.array([[0.1, 0.9, 0.5, 0.8, 0.2, 0.7]], np.float32)
+        match = np.array([[0, -1, -1, -1, 1, -1]], np.int64)  # 2 pos
+        neg = V.mine_hard_examples(paddle.to_tensor(loss),
+                                   paddle.to_tensor(match),
+                                   neg_pos_ratio=1.5)
+        got = np.asarray(neg.numpy())[0]
+        got = got[got >= 0]
+        # budget = 1.5 * 2 = 3 hardest negatives: losses 0.9, 0.8, 0.7
+        assert set(got.tolist()) == {1, 3, 5}
+
+    def test_detection_map_perfect_and_partial(self):
+        import paddle_tpu.vision.ops as V
+        gt = np.array([
+            [0, 1, 0, 0, 0, 10, 10],
+            [0, 2, 0, 20, 20, 30, 30],
+            [1, 1, 0, 5, 5, 15, 15],
+        ], np.float32)
+        perfect = np.array([
+            [0, 1, 0.9, 0, 0, 10, 10],
+            [0, 2, 0.8, 20, 20, 30, 30],
+            [1, 1, 0.7, 5, 5, 15, 15],
+        ], np.float32)
+        m = V.detection_map(paddle.to_tensor(perfect),
+                            paddle.to_tensor(gt), class_num=3)
+        assert float(m.numpy()) == pytest.approx(1.0)
+        # one class fully missed -> its AP 0; mAP = mean(1, 0) = 0.5
+        partial = perfect[perfect[:, 1] == 1]
+        m2 = V.detection_map(paddle.to_tensor(partial),
+                             paddle.to_tensor(gt), class_num=3)
+        assert float(m2.numpy()) == pytest.approx(0.5)
+        # 11point mode agrees on the perfect case
+        m3 = V.detection_map(paddle.to_tensor(perfect),
+                             paddle.to_tensor(gt), class_num=3,
+                             ap_version="11point")
+        assert float(m3.numpy()) == pytest.approx(1.0)
+
+
+class TestDetectionTrainingRegressions:
+    def test_rpn_off_grid_gt_does_not_poison(self):
+        import paddle_tpu.vision.ops as V
+        anchors = np.array([[0, 0, 10, 10], [40, 40, 50, 50]], np.float32)
+        gts = np.array([[0, 0, 10, 10],
+                        [1000, 1000, 1010, 1010]], np.float32)
+        loc, score, tgt, lab = V.rpn_target_assign(
+            paddle.to_tensor(anchors), paddle.to_tensor(gts),
+            rpn_batch_size_per_im=4)
+        loc = np.asarray(loc.numpy())
+        assert 0 in loc and 1 not in loc  # off-grid gt labels nothing
+        lab = np.asarray(lab.numpy())
+        assert (lab == 0).sum() >= 1      # negatives still sampled
+
+    def test_mine_hard_examples_zero_positives(self):
+        import paddle_tpu.vision.ops as V
+        loss = np.array([[0.9, 0.8, 0.7]], np.float32)
+        match = np.array([[-1, -1, -1]], np.int64)
+        neg = np.asarray(V.mine_hard_examples(
+            paddle.to_tensor(loss), paddle.to_tensor(match),
+            neg_pos_ratio=3.0).numpy())[0]
+        assert (neg >= 0).sum() == 0  # no positives -> no negatives
+
+    def test_detection_map_difficult_skipped_not_fp(self):
+        import paddle_tpu.vision.ops as V
+        gt = np.array([[0, 1, 0, 0, 0, 10, 10],
+                       [0, 1, 1, 20, 20, 30, 30]], np.float32)  # 2nd hard
+        det = np.array([[0, 1, 0.9, 20, 20, 30, 30],   # matches difficult
+                        [0, 1, 0.8, 0, 0, 10, 10]], np.float32)
+        m = V.detection_map(paddle.to_tensor(det), paddle.to_tensor(gt),
+                            class_num=2, evaluate_difficult=False)
+        assert float(m.numpy()) == pytest.approx(1.0)
+        with pytest.raises(ValueError, match="class_num"):
+            V.detection_map(paddle.to_tensor(det), paddle.to_tensor(gt),
+                            class_num=1)
