@@ -18,7 +18,7 @@ __all__ = ["mean_iou", "chunk_eval", "diag_embed",
            "read_file", "decode_jpeg", "match_matrix_tensor",
            "add_position_encoding", "batch_fc", "polygon_box_transform",
            "correlation", "sequence_topk_avg_pooling",
-           "positive_negative_pair"]
+           "positive_negative_pair", "similarity_focus"]
 
 
 def mean_iou(input, label, num_classes):  # noqa: A002
@@ -438,3 +438,49 @@ def positive_negative_pair(score, label, query_id):
     return (wrap(jnp.asarray(pos, jnp.float32)),
             wrap(jnp.asarray(neg, jnp.float32)),
             wrap(jnp.asarray(neu, jnp.float32)))
+
+
+def similarity_focus(x, axis, indexes):
+    """Similarity-focus attention mask (operators/similarity_focus_op.h,
+    the text-matching focus layer): for each selected slice along `axis`,
+    greedily pick maxima whose two free coordinates are both unused, and
+    set the mask 1 across the whole `axis` fiber at those coordinates
+    (a greedy bipartite matching over the slice). Host numpy, like the
+    reference's CPU-only kernel. x: 4-D (N, d1, d2, d3); axis in 1..3."""
+    import jax.numpy as jnp
+
+    xv = np.asarray(unwrap(x), np.float32)
+    if xv.ndim != 4:
+        raise ValueError("similarity_focus expects a 4-D input")
+    if axis not in (1, 2, 3):
+        raise ValueError("axis must be 1, 2 or 3")
+    if not indexes:
+        raise ValueError("indexes must be non-empty")
+    if max(indexes) >= xv.shape[axis]:
+        raise ValueError(
+            f"index {max(indexes)} out of range for axis {axis} "
+            f"(size {xv.shape[axis]})")
+    free = [a for a in (1, 2, 3) if a != axis]
+    out = np.zeros_like(xv)
+    for b in range(xv.shape[0]):
+        for index in indexes:
+            sl = np.take(xv[b], index, axis=axis - 1)  # (dA, dB)
+            dA, dB = sl.shape
+            order = np.argsort(-sl.ravel(), kind="stable")
+            usedA = np.zeros(dA, bool)
+            usedB = np.zeros(dB, bool)
+            picked = 0
+            for flat in order:
+                ia, ib = divmod(int(flat), dB)
+                if usedA[ia] or usedB[ib]:
+                    continue
+                usedA[ia] = usedB[ib] = True
+                sel = [b, None, None, None]
+                sel[free[0]] = ia
+                sel[free[1]] = ib
+                sel[axis] = slice(None)
+                out[tuple(sel)] = 1.0
+                picked += 1
+                if picked == min(dA, dB):
+                    break
+    return wrap(jnp.asarray(out))

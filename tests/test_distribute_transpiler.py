@@ -268,3 +268,70 @@ class TestTranspilerEdgeCases:
         with pytest.raises(NotImplementedError, match="LRScheduler"):
             static.DistributeTranspiler().transpile(
                 0, program=prog, pservers="127.0.0.1:1")
+
+
+_WORKER_SCRIPT = """
+import os, sys
+import jax; jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+sys.path.insert(0, %r)
+import test_distribute_transpiler as T
+wid = int(os.environ["PADDLE_TRAINER_ID"])
+prog, loss = T._build_program()
+t = static.DistributeTranspiler()
+t.transpile(trainer_id=wid, program=prog,
+            pservers=os.environ["PADDLE_PSERVER_ENDPOINTS"], trainers=2)
+exe = static.Executor()
+for step, (x, y) in enumerate(T._batches(10, seed=100 + wid)):
+    (lv,) = exe.run(t.get_trainer_program(), feed={"x": x, "y": y},
+                    fetch_list=[loss])
+    print("LOSS %%d %%.6f" %% (step, float(np.asarray(lv))), flush=True)
+prog._ps_ctx.comm.client.barrier(2)
+if wid == 0:
+    prog._ps_ctx.stop()
+"""
+
+
+class TestTwoTrainerCluster:
+    def test_two_sync_trainers_converge(self):
+        """2 trainer processes x 1 pserver: sync-mode transpiled training
+        runs the push/2 + barrier + pull protocol across real processes
+        and both workers converge on shared parameters."""
+        from test_parameter_server import _free_port
+
+        port = _free_port()
+        srv = TestDistributeTranspiler()._spawn_server(port)
+        workers = []
+        try:
+            for wid in range(2):
+                env = dict(os.environ)
+                env["PYTHONPATH"] = (REPO + os.pathsep
+                                     + env.get("PYTHONPATH", ""))
+                env["JAX_PLATFORMS"] = "cpu"
+                env["PADDLE_TRAINER_ID"] = str(wid)
+                env["PADDLE_PSERVER_ENDPOINTS"] = f"127.0.0.1:{port}"
+                workers.append(subprocess.Popen(
+                    [sys.executable, "-c",
+                     _WORKER_SCRIPT % os.path.join(REPO, "tests")],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, env=env, cwd=REPO))
+            outs = []
+            for w in workers:
+                out, err = w.communicate(timeout=300)
+                assert w.returncode == 0, err[-3000:]
+                outs.append(out)
+            for out in outs:
+                losses = [float(line.split()[2])
+                          for line in out.splitlines()
+                          if line.startswith("LOSS")]
+                assert len(losses) == 10
+                assert losses[-1] < losses[0]  # shared params converge
+        finally:
+            for p in workers:
+                if p.poll() is None:
+                    p.kill()
+            srv.wait(timeout=30)
+            if srv.poll() is None:
+                srv.kill()

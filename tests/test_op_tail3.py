@@ -543,3 +543,22 @@ class TestDetectionTrainingRegressions:
         with pytest.raises(ValueError, match="class_num"):
             V.detection_map(paddle.to_tensor(det), paddle.to_tensor(gt),
                             class_num=1)
+
+
+def test_similarity_focus_axis1_mirror():
+    """Greedy row/column-exclusive maxima across the selected channel
+    (similarity_focus_op.h axis=1 loop), fiber set across all channels."""
+    x = np.zeros((1, 2, 2, 3), np.float32)
+    x[0, 0] = [[0.9, 0.1, 0.2],
+               [0.3, 0.8, 0.1]]
+    out = np.asarray(paddle.ops.similarity_focus(
+        paddle.to_tensor(x), axis=1, indexes=[0]).numpy())
+    # maxima: (0,0)=0.9 then (1,1)=0.8 (rows/cols exclusive) -> mask at
+    # those (h,w) across BOTH channels
+    want = np.zeros((2, 3), np.float32)
+    want[0, 0] = want[1, 1] = 1.0
+    np.testing.assert_array_equal(out[0, 0], want)
+    np.testing.assert_array_equal(out[0, 1], want)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="out of range"):
+        paddle.ops.similarity_focus(paddle.to_tensor(x), 1, [5])
