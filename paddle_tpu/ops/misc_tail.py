@@ -456,10 +456,11 @@ def similarity_focus(x, axis, indexes):
         raise ValueError("axis must be 1, 2 or 3")
     if not indexes:
         raise ValueError("indexes must be non-empty")
-    if max(indexes) >= xv.shape[axis]:
+    if min(indexes) < 0 or max(indexes) >= xv.shape[axis]:
         raise ValueError(
-            f"index {max(indexes)} out of range for axis {axis} "
-            f"(size {xv.shape[axis]})")
+            f"indexes {list(indexes)} out of range for axis {axis} "
+            f"(size {xv.shape[axis]}; negatives rejected like the "
+            f"reference op)")
     free = [a for a in (1, 2, 3) if a != axis]
     out = np.zeros_like(xv)
     for b in range(xv.shape[0]):

@@ -559,6 +559,7 @@ def test_similarity_focus_axis1_mirror():
     want[0, 0] = want[1, 1] = 1.0
     np.testing.assert_array_equal(out[0, 0], want)
     np.testing.assert_array_equal(out[0, 1], want)
-    import pytest as _pytest
-    with _pytest.raises(ValueError, match="out of range"):
+    with pytest.raises(ValueError, match="out of range"):
         paddle.ops.similarity_focus(paddle.to_tensor(x), 1, [5])
+    with pytest.raises(ValueError, match="out of range"):
+        paddle.ops.similarity_focus(paddle.to_tensor(x), 1, [-1])
