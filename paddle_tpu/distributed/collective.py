@@ -203,6 +203,14 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
         out = call_op(_rs, *tensor_list, op_name="c_reducescatter")
         tensor._value = out._value
         return tensor
+    # eager: one list entry per group rank, like the reference op's shape
+    # check — a wrong-length list would otherwise select the wrong shard
+    nranks = (len(group.ranks) if group is not None and
+              group.ranks is not None else jax.process_count())
+    if len(tensor_list) != nranks:
+        raise ValueError(
+            f"reduce_scatter needs len(tensor_list) == group size "
+            f"({nranks}), got {len(tensor_list)}")
     if jax.process_count() > 1:
         member, ranks = _eager_subgroup(group)
         stacked = np.stack([np.asarray(unwrap(t)) for t in tensor_list])

@@ -190,6 +190,12 @@ class HbmEmbeddingCache:
             resident = (pos < res.size) & (res[np.minimum(
                 pos, res.size - 1)] == uniq)
             missing = uniq[~resident]
+            # LRU-refresh already-resident keys of this pass (coldest
+            # first, so the hottest end up most recently used): without
+            # this, mid-pass faulting under capacity pressure could evict
+            # a hot resident key before the cold staged tail
+            for key in uniq[resident][::-1]:
+                self._slots.move_to_end(int(key))
         else:
             missing = uniq
         room = len(self._free)

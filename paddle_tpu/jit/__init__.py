@@ -1,4 +1,5 @@
 """paddle_tpu.jit — to_static + save/load (reference: `python/paddle/jit/`)."""
 from .to_static import StaticFunction, InputSpec, to_static, not_to_static, in_tracing  # noqa: F401
 from .io import save, load, TranslatedLayer  # noqa: F401
+from .traced_layer import TracedLayer  # noqa: F401
 from . import dy2static  # noqa: F401  (reference: paddle.jit.dy2static)

@@ -1,0 +1,96 @@
+"""TracedLayer — trace-and-serve surface over the trace-first compiler.
+
+Reference: `python/paddle/fluid/dygraph/jit.py:1136` TracedLayer (backed
+by `paddle/fluid/imperative/jit/program_desc_tracer.h:54`): trace a
+dygraph Layer once into a static program, run it, and export an
+inference model with feed/fetch index selection.
+
+TPU mapping: `to_static`'s StaticFunction IS a program-desc tracer (one
+abstract trace -> one jitted XLA program), so TracedLayer is a thin
+veneer: `trace` compiles the layer's forward, `__call__` replays the
+compiled program, and `save_inference_model` re-exports through
+`jit.save`'s StableHLO artifact with the requested feed/fetch subset.
+"""
+from ..nn.layer.layers import Layer
+
+__all__ = ["TracedLayer"]
+
+
+class _FeedFetchWrapper(Layer):
+    """Forward over the fed subset of the traced example inputs; non-fed
+    inputs are frozen at their trace-time values (the reference prunes
+    the program to the feed set the same way)."""
+
+    def __init__(self, inner, examples, feed_idx, fetch_idx):
+        super().__init__()
+        self.inner = inner
+        self._examples = list(examples)
+        self._feed_idx = list(feed_idx)
+        self._fetch_idx = list(fetch_idx)
+
+    def forward(self, *fed):
+        full = list(self._examples)
+        for i, t in zip(self._feed_idx, fed):
+            full[i] = t
+        outs = self.inner(*full)
+        flat = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        sel = [flat[i] for i in self._fetch_idx]
+        return sel[0] if len(sel) == 1 else sel
+
+
+class TracedLayer:
+    """Use :meth:`trace` to construct; do not call ``__init__`` directly
+    (reference raises the same way, jit.py:1199)."""
+
+    def __init__(self, layer, static_fn, examples, n_outs):
+        self._layer = layer
+        self._static = static_fn
+        self._examples = examples
+        self._n_outs = n_outs
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Returns ``(outputs, traced_layer)``: outputs of one traced run
+        plus the replayable TracedLayer (reference jit.py:1223)."""
+        from .to_static import to_static
+        if not isinstance(layer, Layer):
+            raise TypeError(
+                f"TracedLayer.trace expects a Layer, got {type(layer)}")
+        examples = list(inputs)
+        static_fn = to_static(lambda *xs: layer(*xs))
+        outs = static_fn(*examples)
+        n_outs = len(outs) if isinstance(outs, (list, tuple)) else 1
+        return outs, TracedLayer(layer, static_fn, examples, n_outs)
+
+    def __call__(self, inputs):
+        return self._static(*inputs)
+
+    def set_strategy(self, build_strategy=None, exec_strategy=None):
+        """Accepted for API parity; pass scheduling/placement strategy is
+        XLA's job on TPU (reference jit.py:1259 wires these into
+        ParallelExecutor, which has no analog here — GSPMD + the jit
+        cache replace it)."""
+        self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy
+
+    def save_inference_model(self, path, feed=None, fetch=None, **config):
+        """Export the traced program as a serveable artifact, keeping only
+        the ``feed``-indexed inputs and ``fetch``-indexed outputs
+        (reference jit.py:1295 prunes the program the same way)."""
+        from . import io as jit_io
+        feed_idx = list(feed) if feed is not None else \
+            list(range(len(self._examples)))
+        fetch_idx = list(fetch) if fetch is not None else \
+            list(range(self._n_outs))
+        for i in feed_idx:
+            if not 0 <= i < len(self._examples):
+                raise ValueError(
+                    f"feed index {i} outside [0, {len(self._examples)})")
+        for i in fetch_idx:
+            if not 0 <= i < self._n_outs:
+                raise ValueError(
+                    f"fetch index {i} outside [0, {self._n_outs})")
+        wrapper = _FeedFetchWrapper(self._layer, self._examples,
+                                    feed_idx, fetch_idx)
+        specs = [self._examples[i] for i in feed_idx]
+        return jit_io.save(wrapper, path, input_spec=specs, **config)

@@ -13,6 +13,23 @@ from ..core.dispatch import unwrap, wrap
 __all__ = ["tdm_sampler", "tdm_child"]
 
 
+def _wrap_ids(arr, dtype):
+    """Emit id arrays at the framework's id width. dtype='int64' means
+    int64 when JAX x64 is on; otherwise int32 WITH an overflow check —
+    ids beyond int32 raise loudly instead of silently truncating (trees
+    that large need jax.config.update('jax_enable_x64', True))."""
+    import jax
+    import jax.numpy as jnp
+    if dtype == "int64" and not jax.config.jax_enable_x64:
+        if arr.size and int(arr.max()) > np.iinfo(np.int32).max:
+            raise ValueError(
+                "tdm ids exceed int32 range and JAX x64 is off; enable "
+                "jax_enable_x64 for true int64 ids")
+        return wrap(jnp.asarray(arr.astype(np.int32)))
+    dt = jnp.int64 if dtype == "int64" else jnp.int32
+    return wrap(jnp.asarray(arr, dt))
+
+
 def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, travel,
                 layer, layer_offsets=None, output_positive=True, seed=0,
                 dtype="int64"):
@@ -87,10 +104,8 @@ def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, travel,
                     neg = int(ids[rng.randint(ids.size)])
                 out[i, col] = neg
                 col += 1
-    import jax.numpy as jnp
-    dt = jnp.int64 if dtype == "int64" else jnp.int32
-    return (wrap(jnp.asarray(out, dt)), wrap(jnp.asarray(labels, dt)),
-            wrap(jnp.asarray(mask, dt)))
+    return (_wrap_ids(out, dtype), _wrap_ids(labels, dtype),
+            _wrap_ids(mask, dtype))
 
 
 def tdm_child(x, tree_info, child_nums, dtype="int64"):
@@ -122,8 +137,6 @@ def tdm_child(x, tree_info, child_nums, dtype="int64"):
         for j, k in enumerate(kids):
             if k != 0 and info[k, 0] != 0:  # exists and is a leaf
                 leaf_mask[i, j] = 1
-    import jax.numpy as jnp
-    dt = jnp.int64 if dtype == "int64" else jnp.int32
     shape = x_np.shape + (child_nums,)
-    return (wrap(jnp.asarray(child.reshape(shape), dt)),
-            wrap(jnp.asarray(leaf_mask.reshape(shape), dt)))
+    return (_wrap_ids(child.reshape(shape), dtype),
+            _wrap_ids(leaf_mask.reshape(shape), dtype))

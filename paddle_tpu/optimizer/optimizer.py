@@ -424,13 +424,15 @@ class Momentum(Optimizer):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 name=None):
+                 multi_precision=False, name=None):
         self._momentum = momentum
         self._nesterov = use_nesterov
+        self._multi_precision = multi_precision
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
 
     def _create_accumulators(self, param):
         self._add_accumulator("velocity", param)
+        self._maybe_master(param)
 
     def _apply_one(self, p, g, lr):
         g = self._decayed_grad(p, g)
@@ -637,11 +639,12 @@ class Lars(Momentum):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, parameters=None, grad_clip=None,
-                 exclude_from_weight_decay=None, name=None):
+                 exclude_from_weight_decay=None, multi_precision=False,
+                 name=None):
         self._lars_coeff = lars_coeff
         self._lars_wd = lars_weight_decay
         super().__init__(learning_rate, momentum, parameters, False, None,
-                         grad_clip)
+                         grad_clip, multi_precision=multi_precision)
 
     def _apply_one(self, p, g, lr):
         w_norm = jnp.sqrt(jnp.sum(jnp.square(p._value)))

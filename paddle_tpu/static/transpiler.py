@@ -26,12 +26,47 @@ class DistributeTranspilerConfig:
     """reference: transpiler config knobs. Variable slicing across
     servers happens by table sharding (table_id % n_servers) instead of
     block slicing, so `slice_var_up`/`min_block_size` are accepted for
-    API parity and recorded but have no separate behavior."""
+    API parity and recorded but have no separate behavior — tuning them
+    warns once instead of silently doing nothing."""
+
+    _warned = False
 
     def __init__(self):
-        self.slice_var_up = True
-        self.min_block_size = 8192
+        self._slice_var_up = True
+        self._min_block_size = 8192
         self.mode = "pserver"
+
+    @staticmethod
+    def _warn_noop(name):
+        if not DistributeTranspilerConfig._warned:
+            DistributeTranspilerConfig._warned = True
+            import warnings
+            warnings.warn(
+                f"DistributeTranspilerConfig.{name} has no effect here: "
+                "parameters are sharded across servers per-table "
+                "(table_id % n_servers), not block-sliced, so "
+                "slice_var_up/min_block_size are API-parity knobs only",
+                UserWarning, stacklevel=3)
+
+    @property
+    def slice_var_up(self):
+        return self._slice_var_up
+
+    @slice_var_up.setter
+    def slice_var_up(self, v):
+        if bool(v) != self._slice_var_up:
+            self._warn_noop("slice_var_up")
+        self._slice_var_up = bool(v)
+
+    @property
+    def min_block_size(self):
+        return self._min_block_size
+
+    @min_block_size.setter
+    def min_block_size(self, v):
+        if int(v) != self._min_block_size:
+            self._warn_noop("min_block_size")
+        self._min_block_size = int(v)
 
 
 def _server_rule(opt):
