@@ -10,6 +10,15 @@ Configs (BASELINE.md / SURVEY.md §6):
 Run: `python benchmarks/run_all.py [--configs resnet,gpt,allreduce,detection]`
 Prints one JSON line per config. On a host without TPU the numbers are
 CPU-smoke only (marked "backend": "cpu").
+
+Perf-regression gate (observability/gate.py):
+  python benchmarks/run_all.py --out results.json            # record a run
+  python benchmarks/run_all.py --write-baseline BASELINE     # pin a baseline
+  python benchmarks/run_all.py --gate BASELINE [--tolerance 0.1]
+  python benchmarks/run_all.py --results results.json --gate BASELINE
+The last form gates a previously recorded results file without re-running
+the ladder (CI can bench once and gate many baselines). Exit codes:
+0 ok, 1 a bench errored, 2 gate regression.
 """
 import argparse
 import json
@@ -327,19 +336,64 @@ BENCHES = {"resnet": bench_resnet50, "gpt": bench_gpt_sharding_pp,
            "hbm_cache": bench_hbm_cache}
 
 
+def run_benches(configs):
+    """Run the named configs, printing one JSON record per line (errors
+    become ``{"metric": name, "error": ...}`` records so the rest of the
+    ladder still runs). Returns ``(records, any_errored)`` — the single
+    bench-loop implementation shared with tools/perf_gate.py."""
+    results, failed = [], False
+    for name in configs.split(","):
+        name = name.strip()
+        try:
+            rec = BENCHES[name]()
+        except Exception as e:
+            rec = {"metric": name, "error": str(e)[:300]}
+            failed = True
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+    return results, failed
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="resnet,gpt,allreduce,detection")
+    ap.add_argument("--out", help="write the run's records as a JSON file")
+    ap.add_argument("--results", help="gate a previously recorded results "
+                    "JSON instead of running the ladder")
+    ap.add_argument("--gate", help="baseline JSON to gate against "
+                    "(exit 2 on regression)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="fractional noise allowance (default 0.10)")
+    ap.add_argument("--write-baseline", dest="write_baseline",
+                    help="store this run's records as a gate baseline")
     args = ap.parse_args()
+    from paddle_tpu.observability import gate as gate_mod
+
     failed = False
-    for name in args.configs.split(","):
-        try:
-            print(json.dumps(BENCHES[name.strip()]()), flush=True)
-        except Exception as e:
-            # keep running the rest of the ladder; report per-config errors
-            print(json.dumps({"metric": name, "error": str(e)[:300]}),
-                  flush=True)
-            failed = True
+    if args.results:
+        results = list(gate_mod.load_results(args.results).values())
+    else:
+        results, failed = run_benches(args.configs)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"results": results}, f, indent=1)
+    if args.write_baseline:
+        n = gate_mod.write_baseline(results, args.write_baseline)
+        print(f"wrote {n} baseline metrics to {args.write_baseline}",
+              flush=True)
+    if args.gate:
+        tol = (args.tolerance if args.tolerance is not None
+               else gate_mod.DEFAULT_TOLERANCE)
+        ok, report = gate_mod.compare(
+            gate_mod.load_results(args.gate),
+            {r["metric"]: r for r in results if "metric" in r},
+            tolerance=tol)
+        print(gate_mod.format_report(report), flush=True)
+        if not ok:
+            print("PERF GATE: FAIL", flush=True)
+            return 2
+        print("PERF GATE: PASS", flush=True)
     return 1 if failed else 0
 
 

@@ -8,14 +8,53 @@ regions the ops lower to lax.psum/all_gather/ppermute/all_to_all over ICI
 order replaces stream order). Called eagerly on replicated single-process
 state the ops degenerate to their mathematical identities.
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..core.dispatch import call_op, unwrap
 from ..core.tensor import Tensor
+from ..observability import tracing as _obs
 
 _barrier_count = 0
+
+
+def _payload_nbytes(args, kwargs):
+    """Bytes of the first tensor-ish operand (tensor or tensor_list)."""
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, Tensor):
+            v = unwrap(a)
+            return int(getattr(v, "nbytes", 0))
+        if isinstance(a, (list, tuple)) and a and isinstance(a[0], Tensor):
+            v = unwrap(a[0])
+            return int(getattr(v, "nbytes", 0)) * len(a)
+    return 0
+
+
+def _instrumented(fn):
+    """Per-collective telemetry: call/byte counters + a latency span.
+    Eager collectives block (the wire time is on this thread); traced
+    ones only record the lowering cost — device time lives in the XLA
+    profile, as with the reference's stream-ordered c_* ops."""
+    name = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _obs.enabled("collective"):
+            return fn(*args, **kwargs)
+        nbytes = _payload_nbytes(args, kwargs)
+        t0 = _obs.now_ns()
+        with _obs.trace_span(f"collective/{name}", cat="collective",
+                             nbytes=nbytes):
+            out = fn(*args, **kwargs)
+        _obs.count(f"collective_{name}_calls")
+        _obs.count(f"collective_{name}_bytes", nbytes)
+        _obs.count(f"collective_{name}_ns", _obs.now_ns() - t0)
+        return out
+
+    return wrapper
 
 
 def _process_gather(value):
@@ -119,6 +158,7 @@ def _axis(group):
     return group.axis_name if group is not None else None
 
 
+@_instrumented
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
@@ -155,6 +195,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return tensor  # replicated: allreduce(sum over 1 copy) == identity
 
 
+@_instrumented
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
@@ -182,6 +223,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op=op, group=group)
 
 
+@_instrumented
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """Sum the per-rank lists elementwise and keep this rank's shard
@@ -204,17 +246,24 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
         tensor._value = out._value
         return tensor
     # eager: one list entry per group rank, like the reference op's shape
-    # check — a wrong-length list would otherwise select the wrong shard
-    nranks = (len(group.ranks) if group is not None and
-              group.ranks is not None else jax.process_count())
-    if len(tensor_list) != nranks:
-        raise ValueError(
-            f"reduce_scatter needs len(tensor_list) == group size "
-            f"({nranks}), got {len(tensor_list)}")
+    # check — a wrong-length list would otherwise select the wrong shard.
+    # nranks comes from Group.nranks for explicit groups; the global
+    # group counts TRAINER (process) ranks — the eager path's rank space
+    # (_eager_subgroup enforces device ranks == process ranks when the
+    # two could diverge)
+    nranks = (group.nranks if group is not None and group.ranks is not None
+              else jax.process_count())
     if jax.process_count() > 1:
         member, ranks = _eager_subgroup(group)
         stacked = np.stack([np.asarray(unwrap(t)) for t in tensor_list])
         gathered = _process_gather(stacked)  # (world, n, ...)
+        # validate AFTER the gather (broadcast's convention): raising
+        # before it on this rank only would leave the other ranks
+        # stranded inside the global collective
+        if len(tensor_list) != nranks:
+            raise ValueError(
+                f"reduce_scatter needs len(tensor_list) == group size "
+                f"({nranks}), got {len(tensor_list)}")
         if not member:
             return tensor
         idxs = list(ranks) if ranks is not None else \
@@ -225,10 +274,15 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
         summed = gathered[idxs].sum(axis=0)  # (n, ...)
         tensor.set_value(summed[me])
         return tensor
+    if len(tensor_list) != nranks:
+        raise ValueError(
+            f"reduce_scatter needs len(tensor_list) == group size "
+            f"({nranks}), got {len(tensor_list)}")
     tensor.set_value(np.asarray(unwrap(tensor_list[0])))
     return tensor
 
 
+@_instrumented
 def broadcast(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
@@ -261,6 +315,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
@@ -282,6 +337,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
@@ -300,6 +356,7 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     return out_tensor_list
 
 
+@_instrumented
 def p2p_transfer(tensor, src, dst, group=None):
     """SPMD point-to-point: every rank executes this; the value held by
     `src` lands on `dst` (other ranks receive zeros). This is the ppermute
@@ -354,6 +411,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented
 def barrier(group=None):
     if group is not None and group.ranks is not None and \
             len(group.ranks) < jax.process_count():

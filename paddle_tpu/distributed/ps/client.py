@@ -13,6 +13,8 @@ import time
 
 import numpy as np
 
+from ...observability import tracing as _obs
+
 MAGIC = 0x31535450  # b"PTS1": protocol magic/version (ps_service.cc kMagic)
 
 OP_PULL_DENSE = 1
@@ -28,6 +30,16 @@ OP_STOP = 10
 OP_SPARSE_SIZE = 11
 OP_PULL_DENSE_INIT = 12
 OP_SPARSE_SPILL_INFO = 27
+
+_OP_NAMES = {
+    OP_PULL_DENSE: "pull_dense", OP_PUSH_DENSE_GRAD: "push_dense_grad",
+    OP_PULL_SPARSE: "pull_sparse", OP_PUSH_SPARSE_GRAD: "push_sparse_grad",
+    OP_PUSH_SPARSE_DELTA: "push_sparse_delta",
+    OP_PUSH_DENSE_DELTA: "push_dense_delta", OP_BARRIER: "barrier",
+    OP_SAVE: "save", OP_LOAD: "load", OP_STOP: "stop",
+    OP_SPARSE_SIZE: "sparse_size", OP_PULL_DENSE_INIT: "pull_dense_init",
+    OP_SPARSE_SPILL_INFO: "sparse_spill_info",
+}
 
 
 class PsClient:
@@ -93,6 +105,25 @@ class PsClient:
                 pass
 
     def _call(self, server, op, table, n, payload=b"", idempotent=False):
+        if not _obs.enabled("ps"):
+            return self._call_impl(server, op, table, n, payload, idempotent)
+        # RPC telemetry: per-op round-trips + payload bytes both ways
+        # (the brpc-side latency/qps vars of the reference's PSClient)
+        op_name = _OP_NAMES.get(op, str(op))
+        t0 = _obs.now_ns()
+        with _obs.trace_span(f"ps/{op_name}", cat="ps", table=table,
+                             server=server, bytes_out=len(payload)):
+            reply = self._call_impl(server, op, table, n, payload,
+                                    idempotent)
+        _obs.count("ps_client_calls")
+        _obs.count(f"ps_client_{op_name}_calls")
+        _obs.count("ps_client_bytes_out", len(payload) + 21)  # hdr+frame
+        _obs.count("ps_client_bytes_in", len(reply))
+        _obs.count("ps_client_rtt_ns", _obs.now_ns() - t0)
+        return reply
+
+    def _call_impl(self, server, op, table, n, payload=b"",
+                   idempotent=False):
         body = struct.pack("<IBIQ", MAGIC, op, table, n) + payload
         msg = struct.pack("<I", len(body)) + body
         with self._locks[server]:

@@ -6,6 +6,7 @@ import time
 import numpy as np
 
 from ... import _native
+from ...observability import tracing as _obs
 
 OPT_SUM = 0
 OPT_SGD = 1
@@ -73,9 +74,12 @@ class PsServer:
                         pass
                     lib.pt_ps_sparse_spill(t.table_id, t.mem_budget_rows,
                                            t.spill_path.encode())
-        port = lib.pt_ps_start(self.port)
+        with _obs.trace_span("ps/server_start", cat="ps",
+                             n_tables=len(self.tables)):
+            port = lib.pt_ps_start(self.port)
         if port < 0:
             raise RuntimeError(f"ps server failed to bind port {self.port}")
+        _obs.count("ps_server_starts", cat="ps")
         self.port = port
         self._started = True
         return port

@@ -1,4 +1,10 @@
-"""hapi callbacks (reference: `python/paddle/hapi/callbacks.py`)."""
+"""hapi callbacks (reference: `python/paddle/hapi/callbacks.py`).
+
+`TelemetryCallback` is TPU-build-specific: it drives an
+observability.StepTimer through fit/evaluate so step telemetry
+(tokens/s, examples/s, MFU estimate, data-wait and compile-stall
+fractions) is published to the Prometheus/JSON exporters while
+training runs."""
 
 
 class Callback:
@@ -168,6 +174,90 @@ class ReduceLROnPlateau(Callback):
                               f"from {old:.6g} to {new:.6g}.")
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
+
+
+class TelemetryCallback(Callback):
+    """Per-step telemetry for ``Model.fit`` (observability layer).
+
+    Aggregates a sliding window of training steps into tokens/s,
+    examples/s, an MFU estimate, compile-stall and data-wait fractions
+    (see observability/step.py) and publishes them as export gauges so a
+    metrics scrape (``observability.export.start_http_server`` /
+    ``prometheus_text``) always sees fresh numbers. Optionally writes
+    Prometheus-text / JSON snapshots every ``export_freq`` steps.
+
+    ``tokens_per_batch``: tokens consumed per train step (sequence models).
+    ``examples_per_batch``: examples consumed per train step; not
+    inferred from the loader — pass it explicitly or the examples/s
+    gauge is simply omitted.
+    ``flops_per_step``: dense FLOPs per optimizer step; when None and
+    ``tokens_per_batch`` is set, estimated as ``6 * n_params * tokens``
+    (the standard dense-transformer rule of thumb).
+    """
+
+    def __init__(self, tokens_per_batch=None, examples_per_batch=None,
+                 flops_per_step=None, window=20, export_freq=10,
+                 prom_path=None, json_path=None, peak_flops=None):
+        self.tokens_per_batch = tokens_per_batch
+        self.examples_per_batch = examples_per_batch
+        self.flops_per_step = flops_per_step
+        self.window = window
+        self.export_freq = max(1, int(export_freq))
+        self.prom_path = prom_path
+        self.json_path = json_path
+        self.peak_flops = peak_flops
+        self.timer = None
+        self.last_telemetry = None
+
+    def _n_params(self):
+        try:
+            import numpy as np
+            return int(sum(np.prod(p.shape)
+                           for p in self.model.parameters()))
+        except Exception:
+            return 0
+
+    def on_begin(self, mode, logs=None):
+        if mode != "train":
+            return
+        from ..observability.step import StepTimer
+        flops = self.flops_per_step
+        if flops is None and self.tokens_per_batch:
+            n = self._n_params()
+            flops = 6.0 * n * self.tokens_per_batch if n else None
+        self.timer = StepTimer(window=self.window,
+                               tokens_per_step=self.tokens_per_batch,
+                               examples_per_step=self.examples_per_batch,
+                               flops_per_step=flops,
+                               peak_flops=self.peak_flops).start()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        # re-anchor: the gap since the last train step is eval/save wall
+        # time (and its dataloader waits), not the first step of this
+        # epoch — without this the window telemetry absorbs it
+        if self.timer is not None and epoch > 0:
+            self.timer.start()
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode != "train" or self.timer is None:
+            return
+        self.last_telemetry = self.timer.step()
+        if (self.timer.total_steps % self.export_freq == 0
+                and self.last_telemetry is not None):
+            self._export()
+
+    def on_end(self, mode, logs=None):
+        if mode != "train":
+            return
+        if self.last_telemetry is not None:
+            self._export()
+
+    def _export(self):
+        from ..observability import export as export_mod
+        if self.prom_path:
+            export_mod.write_prometheus(self.prom_path)
+        if self.json_path:
+            export_mod.write_json(self.json_path)
 
 
 class VisualDL(Callback):

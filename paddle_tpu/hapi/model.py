@@ -47,10 +47,12 @@ class Model:
 
     # ------------------------------------------------------------------ train
     def train_batch(self, inputs, labels=None):
+        from ..observability import tracing as _obs
         self.network.train()
         x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
         y = labels[0] if isinstance(labels, (list, tuple)) else labels
-        loss, out = self._train_step_fn(x, y)
+        with _obs.trace_span("hapi/train_batch", cat="step"):
+            loss, out = self._train_step_fn(x, y)
         metrics = []
         for m in self._metrics:
             m.update(m.compute(out, y))

@@ -16,6 +16,7 @@ import threading
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import tracing as _obs
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
@@ -90,22 +91,40 @@ class _PrefetchIter:
         self.thread = threading.Thread(target=self._produce, daemon=True)
         self.thread.start()
 
+    def _put(self, item, assemble_ns):
+        """Enqueue a finished batch; when tracing, record assembly latency
+        and the time the worker blocks on a full queue (backpressure)."""
+        if not _obs.enabled("dataloader"):
+            self.q.put(item)
+            return
+        _obs.count("dataloader_worker_batch_ns", assemble_ns)
+        t0 = _obs.now_ns()
+        self.q.put(item)
+        _obs.count("dataloader_worker_put_wait_ns", _obs.now_ns() - t0)
+
     def _produce(self):
         try:
             loader = self.loader
             if isinstance(loader.dataset, IterableDataset):
                 batch = []
+                t0 = _obs.now_ns() if _obs.enabled("dataloader") else 0
                 for sample in loader.dataset:
                     batch.append(sample)
                     if len(batch) == loader.batch_size:
-                        self.q.put(loader.collate_fn(batch))
+                        item = loader.collate_fn(batch)
+                        self._put(item, _obs.now_ns() - t0 if t0 else 0)
                         batch = []
+                        t0 = (_obs.now_ns()
+                              if _obs.enabled("dataloader") else 0)
                 if batch and not loader.drop_last:
-                    self.q.put(loader.collate_fn(batch))
+                    self._put(loader.collate_fn(batch),
+                              _obs.now_ns() - t0 if t0 else 0)
             else:
                 for indices in loader.batch_sampler:
+                    t0 = _obs.now_ns() if _obs.enabled("dataloader") else 0
                     batch = [loader.dataset[i] for i in indices]
-                    self.q.put(loader.collate_fn(batch))
+                    self._put(loader.collate_fn(batch),
+                              _obs.now_ns() - t0 if t0 else 0)
         except BaseException as e:  # surfaced on the consumer side
             self.error = e
         finally:
@@ -115,7 +134,19 @@ class _PrefetchIter:
         return self
 
     def __next__(self):
-        item = self.q.get()
+        if not _obs.enabled("dataloader"):
+            item = self.q.get()
+        else:
+            # consumer wait = data starvation; queue depth sampled at
+            # entry shows whether prefetch is keeping ahead of the step
+            with _obs.trace_span("dataloader/wait", cat="dataloader",
+                                 queue_depth=self.q.qsize()):
+                t0 = _obs.now_ns()
+                item = self.q.get()
+                wait = _obs.now_ns() - t0
+            _obs.count("dataloader_wait_ns", wait)
+            if item is not self._END:  # the end sentinel is not a batch
+                _obs.count("dataloader_batches")
         if item is self._END:
             if self.error is not None:
                 raise self.error
@@ -171,20 +202,33 @@ class DataLoader:
                 return MultiprocessIter(self)
         return _PrefetchIter(self)
 
+    def _emit_sync(self, batch):
+        """Collate + convert one synchronous batch; with tracing on, the
+        whole assembly counts as data wait (nothing overlaps it)."""
+        if not _obs.enabled("dataloader"):
+            return self._to_output(self.collate_fn(batch))
+        with _obs.trace_span("dataloader/batch", cat="dataloader",
+                             batch_size=len(batch)):
+            t0 = _obs.now_ns()
+            out = self._to_output(self.collate_fn(batch))
+            _obs.count("dataloader_wait_ns", _obs.now_ns() - t0)
+            _obs.count("dataloader_batches")
+        return out
+
     def _sync_iter(self):
         if isinstance(self.dataset, IterableDataset):
             batch = []
             for sample in self.dataset:
                 batch.append(sample)
                 if len(batch) == self.batch_size:
-                    yield self._to_output(self.collate_fn(batch))
+                    yield self._emit_sync(batch)
                     batch = []
             if batch and not self.drop_last:
-                yield self._to_output(self.collate_fn(batch))
+                yield self._emit_sync(batch)
         else:
             for indices in self.batch_sampler:
                 batch = [self.dataset[i] for i in indices]
-                yield self._to_output(self.collate_fn(batch))
+                yield self._emit_sync(batch)
 
     def __len__(self):
         if self.batch_sampler is not None:

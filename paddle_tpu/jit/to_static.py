@@ -24,6 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core import state as state_mod
 from ..core.tensor import Tensor
+from ..observability import tracing as _obs
 
 _is_tracing = False
 
@@ -143,7 +144,15 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if _is_tracing:  # nested to_static: inline
             return self._fn(*args, **kwargs)
+        if not _obs.enabled("executor"):
+            return self._call_impl(args, kwargs)
+        # "executor/step": the compiled-program execution span — for the
+        # to_static path this wrapper IS the executor of the jitted step
+        with _obs.trace_span("executor/step", cat="executor",
+                             fn=getattr(self, "__name__", "fn")):
+            return self._call_impl(args, kwargs)
 
+    def _call_impl(self, args, kwargs):
         leaves, treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
         dyn_idx = [i for i, l in enumerate(leaves) if _is_dynamic(l)]
@@ -164,16 +173,28 @@ class StaticFunction:
                mesh is not None)
         entry = self._cache.get(key)
         if entry is None:
-            try:
-                entry = self._build(treedef, leaves, dyn_idx, state_items)
-            except _DATA_DEPENDENT_ERRORS as e:
-                # data-dependent python control flow: fall back to the AST
-                # transformation (reference: program_translator.py always
-                # AST-transforms; here the plain trace is the fast path)
-                if not self._try_ast_fallback(e):
-                    raise
-                entry = self._build(treedef, leaves, dyn_idx, state_items)
+            t0 = _obs.now_ns() if _obs.enabled("jit") else 0
+            with _obs.trace_span("jit/compile", cat="jit",
+                                 fn=getattr(self, "__name__", "fn"),
+                                 cache_size=len(self._cache)):
+                try:
+                    entry = self._build(treedef, leaves, dyn_idx, state_items)
+                except _DATA_DEPENDENT_ERRORS as e:
+                    # data-dependent python control flow: fall back to the AST
+                    # transformation (reference: program_translator.py always
+                    # AST-transforms; here the plain trace is the fast path)
+                    if not self._try_ast_fallback(e):
+                        raise
+                    entry = self._build(treedef, leaves, dyn_idx, state_items)
+            if t0:
+                # trace/build time only — XLA backend compile happens
+                # lazily on first execution and is captured by the
+                # jax.monitoring hook into jit_backend_compile_ns
+                _obs.count("jit_cache_miss")
+                _obs.count("jit_compile_ns", _obs.now_ns() - t0)
             self._cache[key] = entry
+        else:
+            _obs.count("jit_cache_hit", cat="jit")
         compiled, out_wrap = entry
 
         out_flat = compiled(dyn_vals)
@@ -382,6 +403,7 @@ class StaticFunction:
 
         if getattr(self._fn, "_jst_transformed", False):
             return False
+        _obs.count("jit_ast_fallbacks", cat="jit")
         from .dy2static import convert_to_static
         try:
             fn = self._fn

@@ -11,6 +11,7 @@ veneer: `trace` compiles the layer's forward, `__call__` replays the
 compiled program, and `save_inference_model` re-exports through
 `jit.save`'s StableHLO artifact with the requested feed/fetch subset.
 """
+from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["TracedLayer"]
@@ -56,6 +57,11 @@ class TracedLayer:
         if not isinstance(layer, Layer):
             raise TypeError(
                 f"TracedLayer.trace expects a Layer, got {type(layer)}")
+        # the reference accepts list(Tensor)|tuple(Tensor)|Tensor
+        # (jit.py:1198); a bare Tensor must become ONE argument —
+        # list(Tensor) would iterate it row-wise via Tensor.__iter__
+        if isinstance(inputs, Tensor):
+            inputs = [inputs]
         examples = list(inputs)
         static_fn = to_static(lambda *xs: layer(*xs))
         outs = static_fn(*examples)
@@ -92,5 +98,23 @@ class TracedLayer:
                     f"fetch index {i} outside [0, {self._n_outs})")
         wrapper = _FeedFetchWrapper(self._layer, self._examples,
                                     feed_idx, fetch_idx)
-        specs = [self._examples[i] for i in feed_idx]
+        # batch-polymorphic export: feed specs carry a symbolic axis 0
+        # (None → jax.export "batch" dim) instead of freezing the
+        # trace-time batch size; the reference's saved inference model
+        # serves arbitrary batch sizes the same way. Only possible when
+        # EVERY input is fed — a partial feed freezes the rest at their
+        # concrete trace-time values, and a symbolic batch interacting
+        # with a concrete one fails the export trace
+        if len(feed_idx) == len(self._examples):
+            from .to_static import InputSpec
+            specs = []
+            for i in feed_idx:
+                ex = self._examples[i]
+                shape = tuple(ex.shape)
+                if len(shape) >= 1:
+                    shape = (None,) + shape[1:]
+                specs.append(InputSpec(shape, dtype=str(ex.dtype),
+                                       name=getattr(ex, "name", None)))
+        else:
+            specs = [self._examples[i] for i in feed_idx]
         return jit_io.save(wrapper, path, input_spec=specs, **config)

@@ -1,0 +1,50 @@
+"""Unified observability: span tracing, counters, step telemetry,
+metric exporters, and the perf-regression gate.
+
+Built on the two primitives the reference stack ships (profiler.py
+``RecordEvent``/chrome-trace export ≈ `platform/profiler.cc`; monitor.py
+counter registry ≈ `platform/monitor.cc` StatRegistry) and wired into
+every hot path: the static Executor and the to_static compile cache,
+op dispatch (sampled), collectives, the DataLoader, and the PS runtime.
+
+Quick start::
+
+    import paddle_tpu.observability as obs
+
+    obs.enable()                       # spans + counters on
+    ... train ...
+    obs.export_chrome_trace("/tmp/trace.json")   # chrome://tracing
+    print(obs.export.prometheus_text())          # scrape text
+    obs.disable()
+
+Scraping a live job: ``obs.export.start_http_server(9100)`` serves
+``/metrics``; ``hapi.callbacks.TelemetryCallback`` publishes per-step
+tokens/s / MFU / data-wait gauges into it. The perf gate:
+``python benchmarks/run_all.py --gate BASELINE.json`` or
+``python tools/perf_gate.py --baseline BASELINE.json``.
+"""
+from .. import profiler as _profiler
+from . import export, gate, step, tracing  # noqa: F401
+from .gate import compare, load_results  # noqa: F401
+from .step import StepTimer  # noqa: F401
+from .tracing import (CATEGORIES, count, current_span, disable,  # noqa: F401
+                      enable, enabled, trace_span)
+
+__all__ = [
+    "enable", "disable", "enabled", "trace_span", "current_span", "count",
+    "CATEGORIES", "StepTimer", "export_chrome_trace",
+    "tracing", "export", "gate", "step",
+]
+
+
+def export_chrome_trace(path):
+    """Export every recorded span/event as chrome://tracing JSON (the
+    profiler's exporter — spans and profiler events share one buffer)."""
+    return _profiler.export_chrome_tracing(path)
+
+
+def reset():
+    """Clear recorded events and counters-board gauges (monitor counters
+    are shared state and are left alone; reset them individually)."""
+    _profiler.reset()
+    export.clear_gauges()

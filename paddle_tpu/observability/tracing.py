@@ -1,0 +1,222 @@
+"""Span tracing over the profiler/monitor primitives.
+
+The profiler (profiler.py) gives RAII host events + a chrome-trace
+exporter; the monitor (monitor.py) gives the shared counter registry.
+This module is the unified emission API the runtime instruments against:
+
+- ``trace_span(name, cat, **attrs)`` — lightweight context-managed span
+  with a thread-local span stack. When tracing is disabled (the default)
+  it returns a shared no-op span: the hot-path cost is one list read and
+  one set lookup, no allocation (the reference's analog is the
+  ``RecordEvent`` guard on ``FLAGS_enable_host_event_recorder_hook``).
+- ``count(name, value)`` — guarded counter into the monitor registry.
+- per-category toggles: every instrumented subsystem emits under one of
+  ``CATEGORIES``; ``enable(categories=[...])`` turns on a subset.
+  ``dispatch`` (per-op spans through the core.dispatch observer seam) is
+  OFF by default even under ``enable()`` — it is sampled, and still the
+  only category with per-op cost.
+- a ``jax.monitoring`` listener mirrors XLA compile events (trace time,
+  backend compile wall time) into the span stream and the
+  ``jit_backend_compile_ns`` counter — the compile-cache visibility the
+  CUPTI timeline gave the reference's device side.
+"""
+import threading
+
+from .. import monitor, profiler
+
+__all__ = ["enable", "disable", "enabled", "trace_span", "current_span",
+           "count", "now_ns", "CATEGORIES", "DEFAULT_CATEGORIES"]
+
+# every instrumented subsystem; "dispatch" is opt-in (sampled per-op spans)
+CATEGORIES = ("executor", "jit", "dataloader", "collective", "ps",
+              "dispatch", "step", "user")
+DEFAULT_CATEGORIES = frozenset(c for c in CATEGORIES if c != "dispatch")
+
+_enabled_cats = [None]  # None = disabled; frozenset of categories otherwise
+
+
+class _SpanStack(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_tls = _SpanStack()
+
+
+def now_ns():
+    return profiler._now_ns()
+
+
+def enabled(cat=None):
+    """Fast guard: is tracing on (for `cat`)? Instrumented paths call this
+    before doing any measurement work."""
+    cats = _enabled_cats[0]
+    if cats is None:
+        return False
+    return True if cat is None else cat in cats
+
+
+class Span:
+    """Active span; records into the profiler event buffer on exit so it
+    rides the existing chrome-trace exporter. Nesting is tracked on a
+    thread-local stack (``current_span()``)."""
+
+    __slots__ = ("name", "cat", "attrs", "_t0")
+
+    def __init__(self, name, cat, attrs):
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self._t0 = None
+
+    def set_attr(self, **kwargs):
+        self.attrs.update(kwargs)
+        return self
+
+    def __enter__(self):
+        _tls.stack.append(self)
+        self._t0 = profiler._now_ns()
+        return self
+
+    def __exit__(self, *exc):
+        end = profiler._now_ns()
+        stack = _tls.stack
+        if stack and stack[-1] is self:
+            stack.pop()
+        profiler.record_span(self.name, self.cat, self._t0, end,
+                             self.attrs or None)
+        return False
+
+
+class _NullSpan:
+    """Shared disabled span — no state, no allocation per use."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, **kwargs):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+def trace_span(name, cat="user", **attrs):
+    """Open a span: ``with trace_span("executor/run", cat="executor"): ...``.
+    Returns the shared no-op span when tracing (or `cat`) is disabled."""
+    cats = _enabled_cats[0]
+    if cats is None or cat not in cats:
+        return NULL_SPAN
+    return Span(name, cat, attrs)
+
+
+def current_span():
+    """Innermost active span on this thread, or None."""
+    stack = _tls.stack
+    return stack[-1] if stack else None
+
+
+def count(name, value=1, cat=None):
+    """Guarded counter add into the shared monitor registry."""
+    cats = _enabled_cats[0]
+    if cats is None or (cat is not None and cat not in cats):
+        return
+    monitor.stat_add(name, value)
+
+
+# -- jax compile-cache hook -----------------------------------------------
+
+_jax_hook_installed = [False]
+
+
+def _install_jax_hook():
+    """Mirror jax compile events into the span stream. jax.monitoring has
+    no unregister-one API, so the listener installs once and gates itself
+    on the enabled flag."""
+    if _jax_hook_installed[0]:
+        return
+    try:
+        from jax import monitoring as _jm
+    except Exception:
+        return
+
+    def _on_duration(event, duration, **kwargs):
+        cats = _enabled_cats[0]
+        if cats is None or "jit" not in cats or "compile" not in event:
+            return
+        dur_ns = int(duration * 1e9)
+        end = profiler._now_ns()
+        # e.g. /jax/core/compile/backend_compile_duration -> jax/backend_compile
+        leaf = event.rsplit("/", 1)[-1]
+        if leaf.endswith("_duration"):
+            leaf = leaf[: -len("_duration")]
+        profiler.record_span(f"jax/{leaf}", "jit", end - dur_ns, end)
+        if "backend_compile" in event:
+            monitor.stat_add("jit_backend_compile_ns", dur_ns)
+            monitor.stat_add("jit_backend_compiles", 1)
+
+    _jm.register_event_duration_secs_listener(_on_duration)
+    _jax_hook_installed[0] = True
+
+
+# -- sampled op-dispatch observer -----------------------------------------
+
+class _SampledOpObserver:
+    """Per-op spans through the core.dispatch observer seam, sampled by
+    period so the op hot path stays cheap (one counter increment per op,
+    one span per `period` ops)."""
+
+    def __init__(self, sample_rate=0.01):
+        self.period = max(1, int(round(1.0 / max(sample_rate, 1e-9))))
+        self._n = 0
+
+    def begin(self, name):
+        self._n += 1
+        if self._n % self.period:
+            return None
+        return profiler._now_ns()
+
+    def end(self, token, name, outputs):
+        if token is None:
+            return
+        profiler.record_span(f"op/{name}", "dispatch", token,
+                             profiler._now_ns())
+        monitor.stat_add("dispatch_sampled_ops", 1)
+
+
+def enable(categories=None, dispatch_sample_rate=0.01):
+    """Turn on tracing for `categories` (default: everything except the
+    sampled per-op ``dispatch`` category). Also enables profiler event
+    collection so spans reach the chrome-trace exporter."""
+    cats = (frozenset(categories) if categories is not None
+            else DEFAULT_CATEGORIES)
+    unknown = cats - frozenset(CATEGORIES)
+    if unknown:
+        raise ValueError(
+            f"unknown trace categories {sorted(unknown)}; "
+            f"valid: {list(CATEGORIES)}")
+    _enabled_cats[0] = cats
+    profiler.enable_collection()
+    _install_jax_hook()
+    from ..core import dispatch
+    if "dispatch" in cats:
+        dispatch.add_observer("observability",
+                              _SampledOpObserver(dispatch_sample_rate))
+    else:
+        # re-enable without "dispatch" must tear the sampler down, or a
+        # previous enable(categories=["dispatch"]) keeps recording ops
+        dispatch.remove_observer("observability")
+
+
+def disable():
+    """Turn tracing off and stop profiler event collection. Recorded
+    events stay exportable until ``profiler.reset()``."""
+    _enabled_cats[0] = None
+    from ..core import dispatch
+    dispatch.remove_observer("observability")
+    profiler.disable_collection()

@@ -21,8 +21,31 @@ __all__ = [
     "export_chrome_tracing", "summary", "Profiler",
 ]
 
-_fallback_events = []  # [(name, cat, start_ns, end_ns, tid)] when no native lib
+# [(name, cat, start_ns, end_ns, tid, args)] when no native lib
+_fallback_events = []
 _fallback_enabled = [False]
+
+# hard cap on buffered events: long-running jobs that enable tracing for
+# live metric scraping would otherwise grow the buffer without bound.
+# Once hit, further events are counted (dropped_events()) but not stored;
+# export what you have and reset() to keep recording.
+_MAX_EVENTS = int(os.environ.get("PADDLE_TPU_PROF_MAX_EVENTS", 1_000_000))
+_event_count = [0]
+_dropped_events = [0]
+
+
+def _admit():
+    if _event_count[0] >= _MAX_EVENTS:
+        _dropped_events[0] += 1
+        return False
+    _event_count[0] += 1
+    return True
+
+
+def dropped_events():
+    """Events discarded since the last reset() because the buffer cap
+    (PADDLE_TPU_PROF_MAX_EVENTS) was reached."""
+    return _dropped_events[0]
 
 
 def _now_ns():
@@ -34,12 +57,14 @@ def _now_ns():
 
 
 def _record(name, cat, start_ns, end_ns):
+    if not _admit():
+        return
     tid = threading.get_ident() % (1 << 31)
     L = _native.lib()
     if L is not None:
         L.pt_prof_event(name.encode(), cat.encode(), start_ns, end_ns, tid)
     elif _fallback_enabled[0]:
-        _fallback_events.append((name, cat, start_ns, end_ns, tid))
+        _fallback_events.append((name, cat, start_ns, end_ns, tid, None))
 
 
 def _enabled():
@@ -47,6 +72,40 @@ def _enabled():
     if L is not None:
         return bool(L.pt_prof_enabled())
     return _fallback_enabled[0]
+
+
+def enable_collection():
+    """Turn on event recording WITHOUT installing the op observer — the
+    observability layer's seam (spans record through the same buffer the
+    profiler exports, but op-level tracing stays opt-in)."""
+    L = _native.lib()
+    if L is not None:
+        L.pt_prof_enable()
+    else:
+        _fallback_enabled[0] = True
+
+
+def disable_collection():
+    L = _native.lib()
+    if L is not None:
+        L.pt_prof_disable()
+    else:
+        _fallback_enabled[0] = False
+
+
+def record_span(name, cat, start_ns, end_ns, attrs=None):
+    """Record a completed span (observability/tracing.py emission point).
+    `attrs` survive only the python fallback exporter — the native event
+    record has no args field; numeric attrs that matter for aggregation
+    should also be emitted as monitor counters."""
+    if not _enabled() or not _admit():
+        return
+    tid = threading.get_ident() % (1 << 31)
+    L = _native.lib()
+    if L is not None:
+        L.pt_prof_event(name.encode(), cat.encode(), start_ns, end_ns, tid)
+    else:
+        _fallback_events.append((name, cat, start_ns, end_ns, tid, attrs))
 
 
 class RecordEvent:
@@ -131,9 +190,14 @@ def export_chrome_tracing(path):
     if L is not None:
         return int(L.pt_prof_export(path.encode()))
     import json
-    evs = [{"name": n, "cat": c, "ph": "X", "ts": s / 1e3,
-            "dur": (e - s) / 1e3, "pid": os.getpid(), "tid": t}
-           for (n, c, s, e, t) in _fallback_events]
+    evs = []
+    for (n, c, s, e, t, a) in _fallback_events:
+        ev = {"name": n, "cat": c, "ph": "X", "ts": s / 1e3,
+              "dur": (e - s) / 1e3, "pid": os.getpid(), "tid": t}
+        if a:
+            ev["args"] = {k: (v if isinstance(v, (int, float, str, bool))
+                              else str(v)) for k, v in a.items()}
+        evs.append(ev)
     with open(path, "w") as f:
         json.dump({"traceEvents": evs}, f)
     return len(evs)
@@ -144,6 +208,8 @@ def reset():
     if L is not None:
         L.pt_prof_clear()
     _fallback_events.clear()
+    _event_count[0] = 0
+    _dropped_events[0] = 0
 
 
 def summary():
@@ -163,7 +229,7 @@ def summary():
             rows.append((name, int(calls), int(total), int(mx)))
     else:
         agg = {}
-        for (name, _c, s, e, _t) in _fallback_events:
+        for (name, _c, s, e, _t, _a) in _fallback_events:
             a = agg.setdefault(name, [0, 0, 0])
             a[0] += 1
             a[1] += e - s

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..core.dispatch import _STATIC_HOOK, unwrap
 from ..core.tensor import Parameter, Tensor
+from ..observability import tracing as _obs
 
 
 class _OpRecord:
@@ -132,6 +133,7 @@ class Program:
         self._produced.update(out_slots)
         self.ops.append(_OpRecord(fn, arg_slots, kw_slots, out_slots, op_name,
                                   eval_fn=getattr(fn, "_eval_fn", None)))
+        _obs.count("program_record_ops", cat="executor")
         if len(out_tensors) == 1:
             return out_tensors[0]
         return tuple(out_tensors)
@@ -273,6 +275,14 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        if not _obs.enabled("executor"):
+            return self._run_impl(program, feed, fetch_list, return_numpy)
+        _obs.count("executor_runs")
+        with _obs.trace_span("executor/run", cat="executor"):
+            return self._run_impl(program, feed, fetch_list, return_numpy)
+
+    def _run_impl(self, program=None, feed=None, fetch_list=None,
+                  return_numpy=True):
         prog = program or default_main_program()
         from .transpiler import PsServerProgram
         if isinstance(prog, PsServerProgram):  # listen_and_serv analog
@@ -337,13 +347,23 @@ class Executor:
                tuple(str(v.dtype) for v in feed_vals), tuple(all_fetch))
         compiled = prog._compiled.get(key)
         if compiled is None:
-            pure = prog._pure(feed_slots, all_fetch, param_slots)
-            if opt is not None:
-                compiled = self._build_train_step(prog, pure, param_slots,
-                                                  all_fetch)
-            else:
-                compiled = jax.jit(lambda f, p: pure(f, p))
+            # replay→jit promotion: the program's op list becomes one
+            # compiled XLA step (tracked so compile stalls are attributable)
+            t0 = _obs.now_ns() if _obs.enabled("executor") else 0
+            with _obs.trace_span("executor/compile", cat="executor",
+                                 mode=key[0], n_ops=len(prog.ops)):
+                pure = prog._pure(feed_slots, all_fetch, param_slots)
+                if opt is not None:
+                    compiled = self._build_train_step(prog, pure, param_slots,
+                                                      all_fetch)
+                else:
+                    compiled = jax.jit(lambda f, p: pure(f, p))
+            if t0:
+                _obs.count("executor_compile_miss")
+                _obs.count("executor_compile_ns", _obs.now_ns() - t0)
             prog._compiled[key] = compiled
+        else:
+            _obs.count("executor_compile_hit", cat="executor")
 
         if opt is not None:
             opt_tensors = self._opt_tensors(opt)
@@ -470,8 +490,13 @@ class Executor:
                tuple(sorted(ng_slots)), tg_pattern)
         compiled = prog._compiled.get(key)
         if compiled is None:
-            compiled = jax.jit(pure)
+            with _obs.trace_span("executor/compile", cat="executor",
+                                 mode="grads", n_ops=len(prog.ops)):
+                compiled = jax.jit(pure)
+            _obs.count("executor_compile_miss", cat="executor")
             prog._compiled[key] = compiled
+        else:
+            _obs.count("executor_compile_hit", cat="executor")
         normals, gs = compiled(feed_vals, param_vals, tg_args)
         grad_by_slot = dict(zip(src_slots, gs))
         out = [None] * n_total

@@ -103,6 +103,33 @@ class TestHbmCacheUnit:
             cli.stop_servers()
             srv.stop()
 
+    def test_lru_refresh_ordering(self):
+        """A lookup hit refreshes the key's recency: the next eviction
+        must take the true least-recently-used keys, not the oldest
+        inserted ones."""
+        _reset_cache_stats()
+        srv, cli, cache = self._sgd_setup(capacity=5)
+        try:
+            out = cache.lookup(paddle.to_tensor(
+                np.array([[1, 2, 3, 4]], np.int64)))
+            paddle.ops.sum(out).backward()
+            cache.apply_grads()
+            # touch key 1: inserted first but now most recently used
+            out2 = cache.lookup(paddle.to_tensor(np.array([[1]], np.int64)))
+            paddle.ops.sum(out2).backward()
+            cache.apply_grads()
+            # 2 new keys need 2 slots -> victims are 2,3 (LRU front), NOT
+            # insertion-ordered 1,2
+            out3 = cache.lookup(paddle.to_tensor(np.array([[5, 6]],
+                                                          np.int64)))
+            assert cache.stats["evict"] == 2
+            assert 1 in cache._slots and 4 in cache._slots
+            assert 2 not in cache._slots and 3 not in cache._slots
+            del out, out2, out3
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
     def test_pending_slots_never_evicted(self):
         """A second lookup before apply_grads must not reuse slots whose
         gradient is still pending — that would train the new keys with

@@ -281,3 +281,65 @@ def test_to_static_rejects_traced_attr_stash():
     got = float(m2.diag.numpy()[0])
     want = float(m2.fc(x).mean().numpy())
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_traced_layer_trace_replay_and_bare_tensor():
+    import pytest
+
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m.eval()
+    x = paddle.to_tensor(rng.rand(3, 4).astype("float32"))
+    eager = m(x).numpy()
+    outs, traced = paddle.jit.TracedLayer.trace(m, inputs=[x])
+    np.testing.assert_allclose(outs.numpy(), eager, rtol=1e-5)
+    np.testing.assert_allclose(traced([x]).numpy(), eager, rtol=1e-5)
+    # a bare Tensor input is ONE argument (reference jit.py:1198 accepts
+    # Tensor|list|tuple) — without normalization list(Tensor) would
+    # iterate it row-wise and trace a 3-input forward
+    outs2, traced2 = paddle.jit.TracedLayer.trace(m, inputs=x)
+    np.testing.assert_allclose(outs2.numpy(), eager, rtol=1e-5)
+    np.testing.assert_allclose(traced2([x]).numpy(), eager, rtol=1e-5)
+    with pytest.raises(TypeError):
+        paddle.jit.TracedLayer.trace(lambda t: t, x)
+
+
+def test_traced_layer_save_inference_model_batch_polymorphic(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 4), nn.Tanh(), nn.Linear(4, 2))
+    m.eval()
+    x = paddle.to_tensor(rng.rand(3, 4).astype("float32"))
+    _, traced = paddle.jit.TracedLayer.trace(m, inputs=x)
+    path = str(tmp_path / "traced")
+    traced.save_inference_model(path)
+    served = paddle.jit.load(path)
+    # feed specs carry a symbolic batch axis: the artifact serves batch
+    # sizes the trace never saw, not just the trace-time 3
+    for b in (1, 3, 5):
+        xb = paddle.to_tensor(rng.rand(b, 4).astype("float32"))
+        np.testing.assert_allclose(served(xb).numpy(), m(xb).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_traced_layer_save_inference_model_partial_feed(tmp_path):
+    """A partial feed freezes the non-fed inputs at their trace-time
+    values, so the export must fall back to concrete (trace-batch) feed
+    specs — a symbolic batch axis interacting with the frozen concrete
+    batch would fail the export trace."""
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, a, b):
+            return self.fc(a) + b
+
+    m = TwoIn()
+    m.eval()
+    a = paddle.to_tensor(rng.rand(3, 4).astype("float32"))
+    b = paddle.to_tensor(rng.rand(3, 4).astype("float32"))
+    _, traced = paddle.jit.TracedLayer.trace(m, inputs=[a, b])
+    path = str(tmp_path / "partial")
+    traced.save_inference_model(path, feed=[0])
+    served = paddle.jit.load(path)
+    a2 = paddle.to_tensor(rng.rand(3, 4).astype("float32"))
+    np.testing.assert_allclose(served(a2).numpy(), m(a2, b).numpy(),
+                               rtol=1e-5, atol=1e-6)

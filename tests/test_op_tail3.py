@@ -426,6 +426,23 @@ def test_reduce_scatter_on_mesh():
                                28.0 + 8.0 * np.arange(8))
 
 
+def test_reduce_scatter_eager_wrong_length_raises():
+    """Eager reduce_scatter validates len(tensor_list) against the
+    group's nranks (broadcast's convention): a divergent list must raise
+    instead of silently selecting the wrong shard."""
+    import paddle_tpu.distributed as dist
+
+    t = paddle.to_tensor(np.zeros(3, np.float32))
+    lst2 = [paddle.to_tensor(np.full(3, float(r), np.float32))
+            for r in range(2)]
+    with pytest.raises(ValueError, match="group size"):
+        dist.reduce_scatter(t, lst2)
+    # the correct single-process length (world size 1) is the identity
+    src = np.arange(3, dtype=np.float32)
+    dist.reduce_scatter(t, [paddle.to_tensor(src)])
+    np.testing.assert_allclose(np.asarray(t.numpy()), src)
+
+
 def test_matrix_nms_gaussian_and_keep_all():
     import paddle_tpu.vision.ops as V
     bb = np.array([[[0, 0, 10, 10], [0, 0, 10, 10.5],

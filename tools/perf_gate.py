@@ -1,0 +1,81 @@
+"""CI perf-regression gate (observability/gate.py front-end).
+
+Compare a benchmark results file — or a fresh `benchmarks/run_all.py`
+run — against a pinned baseline; exit non-zero on regression so CI can
+block the merge. Evidence-first: record runs with `--out`, pin them with
+`--write-baseline`, and the A/B trail lives in version control next to
+the code it measures.
+
+Usage:
+    # gate a recorded results file (fast; no benches run):
+    python tools/perf_gate.py --baseline BASELINE_PERF.json \
+        --current results.json
+
+    # run the ladder and gate in one go:
+    python tools/perf_gate.py --baseline BASELINE_PERF.json \
+        --configs resnet,allreduce
+
+    # pin the current run as the new baseline:
+    python tools/perf_gate.py --configs resnet,allreduce \
+        --write-baseline BASELINE_PERF.json
+
+Exit codes: 0 pass, 1 usage/bench error, 2 regression.
+"""
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.observability import gate  # noqa: E402
+
+
+def _run_benches(configs):
+    spec = importlib.util.spec_from_file_location(
+        "pt_bench_run_all", os.path.join(REPO, "benchmarks", "run_all.py"))
+    run_all = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(run_all)
+    results, _failed = run_all.run_benches(configs)
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="perf-regression gate over benchmarks/run_all.py "
+                    "result records")
+    ap.add_argument("--baseline", help="pinned baseline JSON")
+    ap.add_argument("--current", help="results JSON to gate "
+                    "(default: run --configs)")
+    ap.add_argument("--configs", default="resnet,allreduce",
+                    help="benches to run when --current is not given")
+    ap.add_argument("--tolerance", type=float,
+                    default=gate.DEFAULT_TOLERANCE)
+    ap.add_argument("--write-baseline", dest="write_baseline",
+                    help="store the current results as a baseline and exit")
+    args = ap.parse_args(argv)
+
+    if args.current:
+        results = list(gate.load_results(args.current).values())
+    else:
+        results = _run_benches(args.configs)
+
+    if args.write_baseline:
+        n = gate.write_baseline(results, args.write_baseline)
+        print(f"wrote {n} baseline metrics to {args.write_baseline}")
+        return 0
+
+    if not args.baseline:
+        ap.error("--baseline is required unless --write-baseline is given")
+    ok, report = gate.compare(
+        gate.load_results(args.baseline),
+        {r["metric"]: r for r in results if "metric" in r},
+        tolerance=args.tolerance)
+    print(gate.format_report(report))
+    print("PERF GATE:", "PASS" if ok else "FAIL")
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
