@@ -20,7 +20,14 @@ Program structure (each measured on v5e, kept because it won):
 - Timing takes the best of N windows (6 on TPU): the chip is shared, and a
   transient co-tenant burst in one window would otherwise report as a
   regression.
+- `--scan` switches the program structure from the python-unrolled k-step
+  body to the scan-compiled step program (`to_static(one_step,
+  scan_steps=k)`, stacked [k, ...] batch as scan xs): same math, compile
+  time ~independent of k — use it with `--k 32`/`--k 64`, where the
+  unrolled trace/compile is prohibitive (>10 min). Steady-state MFU of
+  both structures is compared back-to-back in benchmarks/ab_mfu.py.
 """
+import argparse
 import json
 import sys
 import time
@@ -33,7 +40,16 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scan", action="store_true",
+                    help="scan-compiled step program instead of the "
+                         "python-unrolled k-step body")
+    ap.add_argument("--k", type=int, default=None,
+                    help="dispatch-amortization factor (steps per "
+                         "compiled program); default 20 TPU / 2 CPU")
+    args_cli = ap.parse_args(argv)
+
     import jax
     import jax.lax as lax
 
@@ -53,6 +69,8 @@ def main():
                          num_heads=4, intermediate_size=512,
                          hidden_dropout=0.0, attention_dropout=0.0)
         batch, seq, k, iters, warmup, windows = 4, 128, 2, 2, 1, 1
+    if args_cli.k:
+        k = args_cli.k
 
     model = BertForPretraining(cfg)
     if on_tpu:
@@ -75,31 +93,57 @@ def main():
         opt.clear_grad()
         return loss
 
-    def k_steps(ids, tok, labels, nsp_labels):
-        for _ in range(k):
-            loss = one_step(ids, tok, labels, nsp_labels)
-        return loss
+    if args_cli.scan:
+        # scan-compiled program: one traced body rolled k times; the
+        # [k, ...]-stacked batch is the scan xs (same microbatch repeated
+        # here, matching the unrolled control's batch reuse)
+        step = paddle.jit.to_static(one_step, scan_steps=k)
+    else:
+        def k_steps(ids, tok, labels, nsp_labels):
+            for _ in range(k):
+                loss = one_step(ids, tok, labels, nsp_labels)
+            return loss
 
-    step = paddle.jit.to_static(k_steps)
+        step = paddle.jit.to_static(k_steps)
+
+    # window telemetry cross-check: the per-model FLOP count (not the
+    # 6*N*T estimate) drives the exported MFU gauge
+    from paddle_tpu.observability.step import StepTimer
+    timer = StepTimer(window=max(windows * iters, 2),
+                      flops_per_token=model.flops_per_token(seq),
+                      peak_flops=PEAK_BF16_FLOPS["tpu" if on_tpu else "cpu"],
+                      publish_as="bench")
 
     def run(bs):
         ids, tok, labels, nsp = synthetic_mlm_batch(bs, seq,
                                                     vocab_size=cfg.vocab_size)
+        if args_cli.scan:
+            stack = lambda a: np.broadcast_to(a, (k,) + a.shape).copy()
+            ids, tok, labels, nsp = (stack(a) for a in
+                                     (ids, tok, labels, nsp))
         t_ids = paddle.to_tensor(ids)
         t_tok = paddle.to_tensor(tok)
         t_lab = paddle.to_tensor(labels)
         t_nsp = paddle.to_tensor(nsp)
         args = (t_ids, t_tok, t_lab, t_nsp)
+        t_compile = time.perf_counter()
         for _ in range(warmup):
             loss = step(*args)
-        float(loss.numpy())  # hard sync (device->host) before timing
+        last = (lambda l: l[-1]) if args_cli.scan else (lambda l: l)
+        float(last(loss).numpy())  # hard sync (device->host) before timing
+        t_compile = time.perf_counter() - t_compile
+        print(f"# first-call (trace+compile+run) {t_compile:.1f}s "
+              f"structure={'scan' if args_cli.scan else 'unroll'} k={k}",
+              file=sys.stderr)
         best = 0.0
+        timer.start()
         for _ in range(windows):
             t0 = time.perf_counter()
             for _ in range(iters):
                 loss = step(*args)
-            loss_host = float(loss.numpy())  # true sync: chains all steps
+            loss_host = float(last(loss).numpy())  # true sync: chains steps
             dt = time.perf_counter() - t0
+            timer.step(tokens=bs * seq * iters * k)
             best = max(best, bs * seq * iters * k / dt)
         return best, loss_host
 
@@ -116,7 +160,7 @@ def main():
     if tokens_per_s is None:
         print(json.dumps({"metric": "bert_base_pretrain_tokens_per_s_per_chip",
                           "value": 0.0, "unit": "tokens/s",
-                          "vs_baseline": 0.0}))
+                          "backend": backend, "vs_baseline": 0.0}))
         return
 
     flops_per_token = model.flops_per_token(seq)
@@ -126,11 +170,15 @@ def main():
         "metric": "bert_base_pretrain_tokens_per_s_per_chip",
         "value": round(tokens_per_s, 1),
         "unit": "tokens/s",
+        "backend": backend,
         "vs_baseline": round(mfu / 0.50, 4),
     }
     print(json.dumps(result))
+    t = timer.telemetry()
     print(f"# backend={backend} batch={batch} seq={seq} k={k} "
-          f"mfu={mfu:.3f} loss={loss_val:.3f}", file=sys.stderr)
+          f"structure={'scan' if args_cli.scan else 'unroll'} "
+          f"mfu={mfu:.3f} timer_mfu={t.get('mfu', 0.0):.3f} "
+          f"loss={loss_val:.3f}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,7 +1,13 @@
 """Back-to-back A/B experiments on the flagship bench step (one process,
 same chip state). Each variant rebuilds the model + programs from scratch.
 
-Usage: python benchmarks/ab_mfu.py [variant ...]   (variant: k<N>[_b<N>])
+Usage: python benchmarks/ab_mfu.py [variant ...]
+       variant: [scan_]k<N>[_b<N>][_bf16]   (e.g. k20_bf16, scan_k20_bf16,
+       scan_k64_bf16)
+
+Every variant logs its first-call wall time (trace+compile+first run) next
+to the steady-state MFU — the scan-vs-unroll lever is a COMPILE-time
+structure change, so both numbers are the evidence.
 
 Measured history on the shared v5e (for future rounds — don't re-try losers):
 - pallas flash attention at seq 512 (ours AND jax's tuned tpu kernel):
@@ -21,6 +27,17 @@ Measured history on the shared v5e (for future rounds — don't re-try losers):
   owns that fusion. Don't retry.
 - r4 winners: k20 (+2.2% over k16) and pure-bf16 params + fp32 masters
   (+0.5%); combined 0.511 -> 0.525 MFU back-to-back.
+- r6 (this PR, CPU-small BERT config — no TPU attached to the builder):
+  scan-compiled step program vs python-unrolled control, first-call
+  trace+compile+run wall time: unroll k2 17.0s / k8 82.7s / k20 267.5s
+  (superlinear in k; the k32 ">10 min, don't" entry above is this curve)
+  vs scan k2 7.1s / k8 6.5s / k20 8.6s (~flat in k) — 31x at k20, and
+  k32/k64 become tractable at all. Inner-step losses match the unrolled
+  program exactly (tests/test_jit.py scan-equivalence). TPU steady-state
+  MFU rows for scan_k20/scan_k32/scan_k64 vs the k20_bf16 control still
+  NEED a TPU runner: scan trades the unroll's cross-step fusion freedom
+  for O(1) compile, so the steady-state delta must be measured
+  back-to-back before switching bench.py's default structure.
 """
 import os
 import sys
@@ -29,14 +46,22 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=()):
-    """The flagship program, identical to bench.py: k unrolled training
-    steps, optimization_barrier between backward and AdamW. Returns
-    (step_fn, args, model) with step_fn compiled via to_static.
+def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
+               scan=False):
+    """The flagship program, identical to bench.py: k training steps per
+    compiled program, optimization_barrier between backward and AdamW.
+    Returns (step_fn, args, model) with step_fn compiled via to_static.
 
     pure_bf16: params live in bf16 (halves the param-read HBM traffic the
     O1 auto_cast pays per use) with fp32 master weights in the AdamW
-    update (multi_precision)."""
+    update (multi_precision).
+
+    scan: compile the single-step body once and roll it with lax.scan
+    (to_static(one_step, scan_steps=k)); args become [k, ...]-stacked —
+    the same microbatch repeated, matching the unrolled control's batch
+    reuse so the A/B isolates program structure."""
+    import numpy as np
+
     import jax.lax as lax
 
     import paddle_tpu as paddle
@@ -69,56 +94,76 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=()):
         opt.clear_grad()
         return loss
 
-    def k_steps(*a):
-        for _ in range(k):
-            loss = one_step(*a)
-        return loss
-
-    step = paddle.jit.to_static(k_steps)
     ids, tok, labels, nsp = synthetic_mlm_batch(batch, seq,
                                                 vocab_size=cfg.vocab_size)
+    if scan:
+        step = paddle.jit.to_static(one_step, scan_steps=k)
+        stack = lambda a: np.broadcast_to(a, (k,) + a.shape).copy()
+        ids, tok, labels, nsp = (stack(a) for a in (ids, tok, labels, nsp))
+    else:
+        def k_steps(*a):
+            for _ in range(k):
+                loss = one_step(*a)
+            return loss
+
+        step = paddle.jit.to_static(k_steps)
     args = tuple(paddle.to_tensor(x) for x in (ids, tok, labels, nsp))
     return step, args, model
 
 
 def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2,
-                pure_bf16=False, white=()):
+                pure_bf16=False, white=(), scan=False):
     seq = 512
     step, args, model = build_step(k=k, batch=batch, seq=seq,
-                                   pure_bf16=pure_bf16, white=white)
+                                   pure_bf16=pure_bf16, white=white,
+                                   scan=scan)
+    last = (lambda l: l[-1]) if scan else (lambda l: l)
+    t_compile = time.perf_counter()
     for _ in range(warmup):
         loss = step(*args)
-    float(loss.numpy())
+    float(last(loss).numpy())
+    t_compile = time.perf_counter() - t_compile
     best = 0.0
     for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(iters):
             loss = step(*args)
-        lv = float(loss.numpy())
+        lv = float(last(loss).numpy())
         dt = time.perf_counter() - t0
         best = max(best, batch * seq * iters * k / dt)
     mfu = best * model.flops_per_token(seq) / 197e12
     print(f"{name:14s} tokens/s={best:9.1f} ms/step={batch*seq*1e3/best:6.2f} "
-          f"mfu={mfu:.4f} loss={lv:.3f}", flush=True)
+          f"mfu={mfu:.4f} loss={lv:.3f} compile_s={t_compile:.1f}",
+          flush=True)
     return mfu
+
+
+def parse_spec(spec):
+    """'[scan_]k<N>[_b<N>][_bf16][_wsm][_wln]' -> run_variant kwargs."""
+    kw = {"k": 16, "batch": 16, "pure_bf16": False, "scan": False}
+    white = []
+    for part in spec.split("_"):
+        if part == "scan":
+            kw["scan"] = True
+        elif part == "bf16":
+            kw["pure_bf16"] = True
+        elif part == "wsm":
+            white.append("softmax")
+        elif part == "wln":
+            white.append("layer_norm")
+        elif part.startswith("k") and part[1:].isdigit():
+            kw["k"] = int(part[1:])
+        elif part.startswith("b") and part[1:].isdigit():
+            kw["batch"] = int(part[1:])
+        else:
+            raise SystemExit(f"unknown variant token {part!r} in {spec!r}")
+    kw["white"] = tuple(white)
+    return kw
 
 
 def main():
     for spec in sys.argv[1:] or ["k16"]:
-        k, batch, bf16, white = 16, 16, False, []
-        for part in spec.split("_"):
-            if part == "bf16":
-                bf16 = True
-            elif part == "wsm":
-                white.append("softmax")
-            elif part == "wln":
-                white.append("layer_norm")
-            elif part.startswith("k"):
-                k = int(part[1:])
-            elif part.startswith("b"):
-                batch = int(part[1:])
-        run_variant(spec, k=k, batch=batch, pure_bf16=bf16,
-                    white=tuple(white))
+        run_variant(spec, **parse_spec(spec))
 
 
 if __name__ == "__main__":

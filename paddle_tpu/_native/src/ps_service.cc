@@ -29,11 +29,13 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <map>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -388,6 +390,15 @@ struct PsServer {
   std::vector<int> conn_fds;  // parallel to conns; -1 once the handler
                               // has closed its socket (guarded by conns_mu)
   std::mutex conns_mu;
+  // per-(table, op) service-side latency: calls + total ns spent from
+  // frame-parsed to response-sent (the reference's per-table pserver
+  // profiler vars). Ordered map -> stable pt_ps_stats_json output.
+  struct OpStat {
+    uint64_t calls = 0;
+    uint64_t ns = 0;
+  };
+  std::map<uint64_t, OpStat> op_stats;  // key = table << 8 | op
+  std::mutex stats_mu;
 };
 
 PsServer* g_ps = nullptr;
@@ -651,6 +662,7 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
       if (bad) break;  // drop the connection
     }
 
+    auto op_t0 = std::chrono::steady_clock::now();
     if (op == kStop) {
       uint32_t ok = 1;
       send_resp(fd, &ok, 4);
@@ -949,6 +961,15 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
         break;
       }
     }
+    uint64_t op_ns = (uint64_t)std::chrono::duration_cast<
+        std::chrono::nanoseconds>(std::chrono::steady_clock::now() - op_t0)
+        .count();
+    {
+      std::lock_guard<std::mutex> slk(ps->stats_mu);
+      auto& st = ps->op_stats[((uint64_t)table << 8) | op];
+      st.calls += 1;
+      st.ns += op_ns;
+    }
   }
   // Close under conns_mu and mark the slot so pt_ps_stop never calls
   // shutdown() on a recycled fd number.
@@ -1121,4 +1142,31 @@ PT_API int32_t pt_ps_port() {
 PT_API int32_t pt_ps_running() {
   std::lock_guard<std::mutex> lk(g_ps_mu);
   return g_ps && g_ps->running.load() ? 1 : 0;
+}
+
+// Serialize the per-(table, op) latency stats as a JSON array. Returns
+// bytes written (NUL excluded); if `cap` is too small returns the
+// negated required size (incl. NUL) and writes nothing.
+PT_API int32_t pt_ps_stats_json(char* out, int32_t cap) {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  std::string s = "[";
+  if (g_ps) {
+    std::lock_guard<std::mutex> slk(g_ps->stats_mu);
+    bool first = true;
+    for (auto& kv : g_ps->op_stats) {
+      char buf[128];
+      snprintf(buf, sizeof(buf),
+               "%s{\"table\":%u,\"op\":%u,\"calls\":%llu,\"ns\":%llu}",
+               first ? "" : ",", (uint32_t)(kv.first >> 8),
+               (uint32_t)(kv.first & 0xff),
+               (unsigned long long)kv.second.calls,
+               (unsigned long long)kv.second.ns);
+      s += buf;
+      first = false;
+    }
+  }
+  s += "]";
+  if ((int32_t)s.size() + 1 > cap) return -(int32_t)(s.size() + 1);
+  memcpy(out, s.c_str(), s.size() + 1);
+  return (int32_t)s.size();
 }

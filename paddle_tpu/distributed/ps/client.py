@@ -31,6 +31,8 @@ OP_SPARSE_SIZE = 11
 OP_PULL_DENSE_INIT = 12
 OP_SPARSE_SPILL_INFO = 27
 
+# the one wire-op -> name map (client spans AND the server's per-table
+# latency exporter use it; graph-service ids 20-26 are graph.py's)
 _OP_NAMES = {
     OP_PULL_DENSE: "pull_dense", OP_PUSH_DENSE_GRAD: "push_dense_grad",
     OP_PULL_SPARSE: "pull_sparse", OP_PUSH_SPARSE_GRAD: "push_sparse_grad",
@@ -39,6 +41,9 @@ _OP_NAMES = {
     OP_SAVE: "save", OP_LOAD: "load", OP_STOP: "stop",
     OP_SPARSE_SIZE: "sparse_size", OP_PULL_DENSE_INIT: "pull_dense_init",
     OP_SPARSE_SPILL_INFO: "sparse_spill_info",
+    20: "graph_add_nodes", 21: "graph_add_edges",
+    22: "graph_sample_neighbors", 23: "graph_pull_list",
+    24: "graph_node_feat", 25: "graph_random_nodes", 26: "graph_size",
 }
 
 

@@ -15,6 +15,44 @@ OPT_ADAM = 2
 _OPT_BY_NAME = {"sum": OPT_SUM, "sgd": OPT_SGD, "adam": OPT_ADAM}
 
 
+def server_op_stats():
+    """Native per-(table, op) service-side latency totals:
+    ``[{"table", "op", "calls", "ns"}, ...]`` (empty when the native lib
+    is absent or no server ran). Monotonic until ``pt_ps_reset``."""
+    import ctypes
+    import json
+
+    from .client import _OP_NAMES
+
+    lib = _native.lib()
+    if lib is None:
+        return []
+    size = 1 << 16
+    for _ in range(4):  # concurrent handlers can grow the table between
+        buf = ctypes.create_string_buffer(size)  # the size probe + read
+        n = lib.pt_ps_stats_json(buf, len(buf))
+        if n >= 0:
+            break
+        size = -n + 4096
+    if n <= 0:
+        return []
+    rows = json.loads(buf.value.decode())
+    for r in rows:
+        r["op"] = _OP_NAMES.get(r["op"], f"op{r['op']}")
+    return rows
+
+
+def _stats_collector():
+    """Scrape-time collector: per-table per-op latency counters with
+    Prometheus labels (ps_server_op_{calls,ns}{table=...,op=...})."""
+    out = {}
+    for r in server_op_stats():
+        key = f'{{table="{r["table"]}",op="{r["op"]}"}}'
+        out[f"ps_server_op_calls{key}"] = r["calls"]
+        out[f"ps_server_op_ns{key}"] = r["ns"]
+    return out
+
+
 class TableConfig:
     """One PS table (reference: ps.proto TableParameter)."""
 
@@ -80,9 +118,18 @@ class PsServer:
         if port < 0:
             raise RuntimeError(f"ps server failed to bind port {self.port}")
         _obs.count("ps_server_starts", cat="ps")
+        # per-table op latencies become scrapeable the moment the server
+        # is up; the collector pulls fresh native counters per scrape
+        from ...observability import export as _export
+        _export.register_collector("ps_server", _stats_collector)
         self.port = port
         self._started = True
         return port
+
+    def stats(self):
+        """Per-(table, op) service-side latency totals (see
+        :func:`server_op_stats`)."""
+        return server_op_stats()
 
     def run(self):
         """Block until a client sends STOP (reference: run_server)."""

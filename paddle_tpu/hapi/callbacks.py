@@ -193,14 +193,25 @@ class TelemetryCallback(Callback):
     ``flops_per_step``: dense FLOPs per optimizer step; when None and
     ``tokens_per_batch`` is set, estimated as ``6 * n_params * tokens``
     (the standard dense-transformer rule of thumb).
+    ``flops_per_token``: per-model override (``model.flops_per_token(seq)``)
+    — exact attention-aware MFU accounting; takes precedence over the
+    6*N*T estimate.
     """
 
     def __init__(self, tokens_per_batch=None, examples_per_batch=None,
-                 flops_per_step=None, window=20, export_freq=10,
-                 prom_path=None, json_path=None, peak_flops=None):
+                 flops_per_step=None, flops_per_token=None, window=20,
+                 export_freq=10, prom_path=None, json_path=None,
+                 peak_flops=None):
         self.tokens_per_batch = tokens_per_batch
         self.examples_per_batch = examples_per_batch
         self.flops_per_step = flops_per_step
+        if flops_per_token is not None and not tokens_per_batch:
+            # the override scales by the window's token throughput; with
+            # no token counts it would silently produce no MFU gauge
+            raise ValueError(
+                "TelemetryCallback(flops_per_token=...) requires "
+                "tokens_per_batch")
+        self.flops_per_token = flops_per_token
         self.window = window
         self.export_freq = max(1, int(export_freq))
         self.prom_path = prom_path
@@ -222,13 +233,15 @@ class TelemetryCallback(Callback):
             return
         from ..observability.step import StepTimer
         flops = self.flops_per_step
-        if flops is None and self.tokens_per_batch:
+        if (flops is None and self.flops_per_token is None
+                and self.tokens_per_batch):
             n = self._n_params()
             flops = 6.0 * n * self.tokens_per_batch if n else None
         self.timer = StepTimer(window=self.window,
                                tokens_per_step=self.tokens_per_batch,
                                examples_per_step=self.examples_per_batch,
                                flops_per_step=flops,
+                               flops_per_token=self.flops_per_token,
                                peak_flops=self.peak_flops).start()
 
     def on_epoch_begin(self, epoch, logs=None):

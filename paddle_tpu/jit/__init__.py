@@ -3,3 +3,6 @@ from .to_static import StaticFunction, InputSpec, to_static, not_to_static, in_t
 from .io import save, load, TranslatedLayer  # noqa: F401
 from .traced_layer import TracedLayer  # noqa: F401
 from . import dy2static  # noqa: F401  (reference: paddle.jit.dy2static)
+from . import compile_cache  # noqa: F401  (persistent XLA compile cache)
+
+compile_cache.configure_from_env()  # records env policy only; backend-clean

@@ -1,0 +1,160 @@
+"""Persistent XLA compilation cache wiring.
+
+The flagship step program costs tens of seconds (TPU) to minutes (large k)
+of backend compile on a cold process; jax's persistent compilation cache
+(`jax_compilation_cache_dir`) keys the serialized executable on the HLO +
+compile options + backend version, so a restart replays the compile from
+disk. This module owns the policy:
+
+- ``configure_from_env()`` runs at ``paddle_tpu`` import and only RECORDS
+  the policy (env vars below) — it must not touch the backend, because
+  ``import paddle_tpu`` stays backend-clean for multi-process init.
+- ``ensure_enabled()`` runs at first ``to_static`` build, when the backend
+  is initialized anyway: default ON for accelerators, OFF for CPU smoke
+  (cache writes would churn on every tiny test program). An explicit env
+  dir/switch overrides the backend default in either direction.
+- cache effectiveness is observable: jax's ``/jax/compilation_cache/*``
+  monitoring events are mirrored into the shared monitor registry
+  (``jit_persistent_cache_hits`` / ``_misses`` / ``_saved_ns``) next to
+  the ``jit_backend_compile_ns`` counter the tracing hook maintains, so
+  the cold/warm compile delta shows up in any metrics scrape.
+
+Env:
+    PADDLE_TPU_COMPILE_CACHE       "1"/"on" force-enable (any backend),
+                                   "0"/"off" disable.
+    PADDLE_TPU_COMPILE_CACHE_DIR   cache directory; setting it implies
+                                   enable. Default ~/.cache/paddle_tpu/xla.
+"""
+import os
+
+__all__ = ["configure_from_env", "ensure_enabled", "enable", "disable",
+           "is_enabled", "cache_dir", "DEFAULT_CACHE_DIR"]
+
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "paddle_tpu", "xla")
+
+_ENV_SWITCH = "PADDLE_TPU_COMPILE_CACHE"
+_ENV_DIR = "PADDLE_TPU_COMPILE_CACHE_DIR"
+
+# policy: None = decide from backend at first compile; True/False = forced
+_state = {"policy": None, "dir": DEFAULT_CACHE_DIR, "enabled": False,
+          "resolved": False}
+_events_installed = [False]
+
+
+def configure_from_env():
+    """Record the env policy (import-time safe: no jax backend access)."""
+    d = os.environ.get(_ENV_DIR)
+    if d:
+        _state["dir"] = d
+        _state["policy"] = True
+    switch = os.environ.get(_ENV_SWITCH, "").strip().lower()
+    if switch in ("1", "on", "true", "yes"):
+        _state["policy"] = True
+    elif switch in ("0", "off", "false", "no"):
+        _state["policy"] = False
+    return _state["policy"]
+
+
+def _install_event_mirror():
+    """Count jax persistent-cache events into the monitor registry. jax
+    has no unregister-one API, so install once and gate on enabled."""
+    if _events_installed[0]:
+        return
+    try:
+        from jax import monitoring as _jm
+    except Exception:
+        return
+    from .. import monitor
+
+    def _on_event(event, **kwargs):
+        if not _state["enabled"]:
+            return
+        if event == "/jax/compilation_cache/cache_hits":
+            monitor.stat_add("jit_persistent_cache_hits", 1)
+        elif event == "/jax/compilation_cache/cache_misses":
+            monitor.stat_add("jit_persistent_cache_misses", 1)
+
+    def _on_duration(event, duration, **kwargs):
+        if not _state["enabled"]:
+            return
+        if event == "/jax/compilation_cache/compile_time_saved_sec":
+            monitor.stat_add("jit_persistent_cache_saved_ns",
+                             int(duration * 1e9))
+
+    _jm.register_event_listener(_on_event)
+    _jm.register_event_duration_secs_listener(_on_duration)
+    _events_installed[0] = True
+
+
+def enable(directory=None, min_compile_time_secs=None):
+    """Turn the persistent cache on (explicit API; also used by
+    ``ensure_enabled``). ``min_compile_time_secs=0`` caches every program
+    — the right setting for tests; the jax default (1s) skips trivial
+    programs in production."""
+    import jax
+
+    if directory is not None:
+        _state["dir"] = directory
+    os.makedirs(_state["dir"], exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _state["dir"])
+    if min_compile_time_secs is not None:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+        # min_entry_size -1 disables the size floor so tiny smoke programs
+        # round-trip too (only consulted when the time floor passes)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _reset_jax_cache()
+    _state["enabled"] = True
+    _state["resolved"] = True
+    _install_event_mirror()
+    return _state["dir"]
+
+
+def _reset_jax_cache():
+    """jax initializes its cache object ONCE per process and never
+    re-reads the config after that, so flipping the dir mid-process (a
+    long-lived trainer enabling the cache after warmup compiles, or the
+    tests) needs an explicit re-init."""
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:
+        pass  # pre-reset jax: the import-time config still applies
+
+
+def disable():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_jax_cache()
+    _state["enabled"] = False
+    _state["resolved"] = True
+
+
+def ensure_enabled():
+    """Resolve the policy once, at first compile (backend already up):
+    accelerators default on, CPU defaults off, env overrides both."""
+    if _state["resolved"]:
+        return _state["enabled"]
+    policy = _state["policy"]
+    if policy is None:
+        try:
+            import jax
+            policy = jax.default_backend() != "cpu"
+        except Exception:
+            policy = False
+    if policy:
+        enable()
+    else:
+        _state["resolved"] = True
+    return _state["enabled"]
+
+
+def is_enabled():
+    return _state["enabled"]
+
+
+def cache_dir():
+    return _state["dir"] if _state["enabled"] else None

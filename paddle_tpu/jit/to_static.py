@@ -99,16 +99,87 @@ def _leaf_key(x):
         return ("static", repr(x))
 
 
+def _analysis_trace(pure_fn, state_vals, dyn_template, grad_vals, n, info):
+    """Abstractly trace ``pure_fn(state, dyn, grads)`` and decide which
+    state/grad inputs the program actually reads. Fills ``info`` (via the
+    trace itself) and returns ``(closed_jaxpr, val_used, grad_used)``.
+    ``dyn_template``/``grad_vals`` entries may be ``jax.ShapeDtypeStruct``
+    placeholders — only shape/dtype matter here, nothing executes."""
+    a_args = (state_vals, dyn_template, grad_vals)
+    a_leaves, a_tdef = jax.tree_util.tree_flatten(a_args)
+    closed = jax.make_jaxpr(
+        lambda *ls: pure_fn(*jax.tree_util.tree_unflatten(a_tdef, ls))
+    )(*a_leaves)
+    used_vars = set()
+    for eqn in closed.jaxpr.eqns:
+        # Literals (hasattr .val) may be unhashable; only Vars matter
+        used_vars.update(v for v in eqn.invars if not hasattr(v, "val"))
+    # an invar returned verbatim in the *user-visible* outputs (fn
+    # returns an unmodified param) must stay a runtime input, not be
+    # frozen as a constant. Only the first n_out outvars are the user
+    # outputs — an invar in its OWN slot of the new_state/new_grads
+    # passthrough tail must NOT mark it used (or nothing would ever be
+    # skippable), but landing in a DIFFERENT slot (EMA/target-network
+    # sync: a.set_value(b) creates no eqn) is a real use.
+    used_vars.update(v for v in closed.jaxpr.outvars[:info["n_out"]]
+                     if not hasattr(v, "val"))
+    invar_slot = {}
+    for i in range(n):
+        invar_slot[closed.jaxpr.invars[i]] = ("val", i)
+    pos_in = n + len(dyn_template)
+    for i, g in enumerate(grad_vals):
+        if g is not None:
+            invar_slot[closed.jaxpr.invars[pos_in]] = ("grad", i)
+            pos_in += 1
+    pos_out = info["n_out"]
+    for j in range(n):  # new_state tail
+        v = closed.jaxpr.outvars[pos_out]
+        if (not hasattr(v, "val")
+                and invar_slot.get(v, ("val", j)) != ("val", j)):
+            used_vars.add(v)
+        pos_out += 1
+    for j, present in enumerate(info["grad_out_mask"]):  # new_grads tail
+        if present:
+            v = closed.jaxpr.outvars[pos_out]
+            if (not hasattr(v, "val")
+                    and invar_slot.get(v, ("grad", j)) != ("grad", j)):
+                used_vars.add(v)
+            pos_out += 1
+    leaf_used = [v in used_vars for v in closed.jaxpr.invars]
+    # map flat leaves back to (state, dyn, grad) slots; None grads were
+    # dropped by tree_flatten, so enumerate in flatten order
+    val_used = leaf_used[:n]
+    grad_used = {}
+    pos = n + len(dyn_template)
+    for i, g in enumerate(grad_vals):
+        if g is not None:
+            grad_used[i] = leaf_used[pos]
+            pos += 1
+    return closed, val_used, grad_used
+
+
 class StaticFunction:
     """Callable wrapper with a compile cache keyed on arg shapes/dtypes and
     the framework-state registry version (reference: StaticFunction
-    program_translator.py:232 + its program cache)."""
+    program_translator.py:232 + its program cache).
 
-    def __init__(self, fn, input_spec=None, donate_state=True):
+    ``scan_steps=k`` selects the scan-compiled step program: ``fn`` is the
+    SINGLE-step body, the wrapper consumes ``[k, ...]``-stacked dynamic
+    inputs, and the body is traced ONCE and rolled with ``jax.lax.scan``
+    carrying the full framework state — trace/compile time is ~independent
+    of k (the unrolled program's is linear in k), which is what unlocks
+    large dispatch-amortization factors. See ``_build_scan``.
+    """
+
+    def __init__(self, fn, input_spec=None, donate_state=True,
+                 scan_steps=None):
         self._fn = fn
         self._cache = {}
         self._donate = donate_state
         self._input_spec = input_spec
+        if scan_steps is not None and int(scan_steps) < 1:
+            raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
+        self._scan_steps = int(scan_steps) if scan_steps is not None else None
         functools.update_wrapper(self, fn)
 
     # -- sharding helpers -------------------------------------------------
@@ -214,25 +285,12 @@ class StaticFunction:
                 out.append(jax.device_put(v, NamedSharding(mesh, spec)))
         return out
 
-    def _build(self, treedef, template_leaves, dyn_idx, state_items):
-        """Two-phase build.
-
-        Phase A traces the user function once (abstractly) threading *all*
-        state, and records which state values / grads the program actually
-        writes (object identity of the tracer survives only if untouched)
-        and which inputs it reads (jaxpr var usage).
-
-        Phase B compiles the real program threading only what matters:
-        written entries are donated inputs + outputs (PJRT aliasing — the
-        in-place Variable update of the reference); read-only entries are
-        plain inputs (no donation, no passthrough output — XLA would
-        otherwise materialize a full copy of every parameter in grad-only
-        programs); untouched entries are not passed at all (keeps dispatch
-        overhead proportional to the program's real state footprint).
-        """
+    def _make_pure_fn(self, treedef, template_leaves, dyn_idx, state_items,
+                      out_template, info):
+        """The functionalized user step: ``(state, dyn, grads) -> (outs,
+        new_state, new_grads)``. Fills ``out_template``/``info`` as a side
+        effect of tracing (both build modes share it)."""
         fn = self._fn
-        out_template = {}
-        info = {}
 
         def pure_fn(state_vals, dyn_vals, grad_vals):
             leaves = list(template_leaves)
@@ -255,6 +313,37 @@ class StaticFunction:
             info["grad_out_mask"] = [ng is not None for ng in new_grads]
             return out_vals, new_state, new_grads
 
+        return pure_fn
+
+    def _build(self, treedef, template_leaves, dyn_idx, state_items):
+        from . import compile_cache
+        compile_cache.ensure_enabled()  # backend is initialized by now
+        if self._scan_steps is not None:
+            return self._build_scan(treedef, template_leaves, dyn_idx,
+                                    state_items)
+        return self._build_unrolled(treedef, template_leaves, dyn_idx,
+                                    state_items)
+
+    def _build_unrolled(self, treedef, template_leaves, dyn_idx, state_items):
+        """Two-phase build.
+
+        Phase A traces the user function once (abstractly) threading *all*
+        state, and records which state values / grads the program actually
+        writes (object identity of the tracer survives only if untouched)
+        and which inputs it reads (jaxpr var usage).
+
+        Phase B compiles the real program threading only what matters:
+        written entries are donated inputs + outputs (PJRT aliasing — the
+        in-place Variable update of the reference); read-only entries are
+        plain inputs (no donation, no passthrough output — XLA would
+        otherwise materialize a full copy of every parameter in grad-only
+        programs); untouched entries are not passed at all (keeps dispatch
+        overhead proportional to the program's real state footprint).
+        """
+        out_template = {}
+        info = {}
+        pure_fn = self._make_pure_fn(treedef, template_leaves, dyn_idx,
+                                     state_items, out_template, info)
         n = len(state_items)
         state_vals = [t._value for _, t in state_items]
         grad_vals = [t._grad for _, t in state_items]
@@ -262,56 +351,8 @@ class StaticFunction:
         # ---- phase A: analysis trace ----
         dyn_template = [l._value if isinstance(l, Tensor) else l
                         for l in (template_leaves[i] for i in dyn_idx)]
-        a_args = (state_vals, dyn_template, grad_vals)
-        a_leaves, a_tdef = jax.tree_util.tree_flatten(a_args)
-        closed = jax.make_jaxpr(
-            lambda *ls: pure_fn(*jax.tree_util.tree_unflatten(a_tdef, ls))
-        )(*a_leaves)
-        used_vars = set()
-        for eqn in closed.jaxpr.eqns:
-            # Literals (hasattr .val) may be unhashable; only Vars matter
-            used_vars.update(v for v in eqn.invars if not hasattr(v, "val"))
-        # an invar returned verbatim in the *user-visible* outputs (fn
-        # returns an unmodified param) must stay a runtime input, not be
-        # frozen as a constant. Only the first n_out outvars are the user
-        # outputs — an invar in its OWN slot of the new_state/new_grads
-        # passthrough tail must NOT mark it used (or nothing would ever be
-        # skippable), but landing in a DIFFERENT slot (EMA/target-network
-        # sync: a.set_value(b) creates no eqn) is a real use.
-        used_vars.update(v for v in closed.jaxpr.outvars[:info["n_out"]]
-                         if not hasattr(v, "val"))
-        invar_slot = {}
-        for i in range(n):
-            invar_slot[closed.jaxpr.invars[i]] = ("val", i)
-        pos_in = n + len(dyn_template)
-        for i, g in enumerate(grad_vals):
-            if g is not None:
-                invar_slot[closed.jaxpr.invars[pos_in]] = ("grad", i)
-                pos_in += 1
-        pos_out = info["n_out"]
-        for j in range(n):  # new_state tail
-            v = closed.jaxpr.outvars[pos_out]
-            if (not hasattr(v, "val")
-                    and invar_slot.get(v, ("val", j)) != ("val", j)):
-                used_vars.add(v)
-            pos_out += 1
-        for j, present in enumerate(info["grad_out_mask"]):  # new_grads tail
-            if present:
-                v = closed.jaxpr.outvars[pos_out]
-                if (not hasattr(v, "val")
-                        and invar_slot.get(v, ("grad", j)) != ("grad", j)):
-                    used_vars.add(v)
-                pos_out += 1
-        leaf_used = [v in used_vars for v in closed.jaxpr.invars]
-        # map flat leaves back to (state, dyn, grad) slots; None grads were
-        # dropped by tree_flatten, so enumerate in flatten order
-        val_used = leaf_used[:n]
-        grad_used = {}
-        pos = n + len(dyn_template)
-        for i, g in enumerate(grad_vals):
-            if g is not None:
-                grad_used[i] = leaf_used[pos]
-                pos += 1
+        closed, val_used, grad_used = _analysis_trace(
+            pure_fn, state_vals, dyn_template, grad_vals, n, info)
 
         w_val, w_grad = info["w_val"], info["w_grad"]
         don_val_idx = [i for i in range(n) if w_val[i]]
@@ -397,6 +438,168 @@ class StaticFunction:
 
         return compiled, out_wrap
 
+    def _build_scan(self, treedef, template_leaves, dyn_idx, state_items):
+        """Scan-compiled step program: trace the single-step body once and
+        roll it k times with ``jax.lax.scan``.
+
+        The full framework state rides the scan carry — written state
+        values (params, optimizer accumulators + fp32 masters, the RNG
+        key, a scheduled lr) and written/accumulated grads — so the
+        reference's persistable-@GRAD survival semantics hold through the
+        carry: a grad accumulated in inner step i is the grad input of
+        inner step i+1, and one that survives the last step is written
+        back to ``Tensor._grad``. Read-only state enters as plain
+        (broadcast) inputs, untouched state is skipped exactly like the
+        unrolled build. The stacked ``[k, ...]`` dynamic args are the scan
+        ``xs``, so each inner step consumes a fresh microbatch; per-step
+        user outputs come back ``[k, ...]``-stacked.
+
+        Grad carry structure must be iteration-invariant, which python
+        ``None`` grads are not, so presence is solved to a fixpoint: a
+        grad the body CREATES (None at entry, live at exit) joins the
+        carry initialized to zeros (additive accumulation makes zeros ≡
+        "no grad yet"), and a grad the body CLEARS (opt.clear_grad) flows
+        to the next step as zeros and is written back as ``None`` after
+        the scan, matching the unrolled program observably.
+        """
+        import jax.numpy as jnp
+
+        k = self._scan_steps
+        out_template = {}
+        info = {}
+        pure_fn = self._make_pure_fn(treedef, template_leaves, dyn_idx,
+                                     state_items, out_template, info)
+        n = len(state_items)
+        state_vals = [t._value for _, t in state_items]
+
+        # single-step abstract templates from the [k, ...]-stacked args
+        dyn_stacked = [template_leaves[i]._value
+                       if isinstance(template_leaves[i], Tensor)
+                       else template_leaves[i] for i in dyn_idx]
+        step_tmpl = []
+        for v in dyn_stacked:
+            shape = tuple(np.shape(v))
+            if not shape or shape[0] != k:
+                raise ValueError(
+                    f"scan_steps={k}: every dynamic input must be stacked "
+                    f"[k, ...]; got shape {shape}")
+            step_tmpl.append(jax.ShapeDtypeStruct(shape[1:],
+                                                  np.dtype(v.dtype)))
+
+        # grad-presence fixpoint (presence only grows, so it terminates)
+        grad_tmpl = [t._grad for _, t in state_items]
+        for _ in range(n + 1):
+            closed, val_used, grad_used = _analysis_trace(
+                pure_fn, state_vals, step_tmpl, grad_tmpl, n, info)
+            out_avals = list(closed.out_avals)
+            pos = info["n_out"] + n
+            created = []
+            for i, present in enumerate(info["grad_out_mask"]):
+                if present:
+                    if grad_tmpl[i] is None:
+                        created.append((i, out_avals[pos]))
+                    pos += 1
+            if not created:
+                break
+            for i, aval in created:
+                grad_tmpl[i] = jax.ShapeDtypeStruct(aval.shape, aval.dtype)
+
+        w_val, w_grad = info["w_val"], info["w_grad"]
+        steady_mask = list(info["grad_out_mask"])
+        carry_val_idx = [i for i in range(n) if w_val[i]]
+        ro_val_idx = [i for i in range(n) if not w_val[i] and val_used[i]]
+        skip_val_idx = [i for i in range(n)
+                        if not w_val[i] and not val_used[i]]
+        carry_grad_idx = [i for i in range(n)
+                          if grad_tmpl[i] is not None and w_grad[i]]
+        ro_grad_idx = [i for i in range(n)
+                       if grad_tmpl[i] is not None and not w_grad[i]
+                       and grad_used.get(i, False)]
+        skip_grad_idx = [i for i in range(n)
+                         if i not in carry_grad_idx and i not in ro_grad_idx]
+        # zeros template per carried grad: the scan-carry aval (used both
+        # for the initial carry when the live grad is None and for the
+        # cleared-inside-the-step substitution)
+        carry_g_sds = {i: (tuple(grad_tmpl[i].shape),
+                           np.dtype(grad_tmpl[i].dtype))
+                       for i in carry_grad_idx}
+
+        def pure_fn2(carry_vals, carry_grads, xs_stacked, ro_vals, ro_grads):
+            def body(carry, xs):
+                c_vals, c_grads = carry
+                sv = [None] * n
+                gv = [None] * n
+                for i, v in zip(carry_val_idx, c_vals):
+                    sv[i] = v
+                for i, v in zip(ro_val_idx, ro_vals):
+                    sv[i] = v
+                for i in skip_val_idx:  # trace-time read of the live value
+                    sv[i] = state_items[i][1]._value
+                for i, g in zip(carry_grad_idx, c_grads):
+                    gv[i] = g
+                for i, g in zip(ro_grad_idx, ro_grads):
+                    gv[i] = g
+                for i in skip_grad_idx:
+                    gv[i] = state_items[i][1]._grad
+                out_vals, new_state, new_grads = pure_fn(sv, list(xs), gv)
+                next_grads = []
+                for i in carry_grad_idx:
+                    g = new_grads[i]
+                    if g is None:  # cleared: zeros ≡ cleared for step i+1
+                        shape, dt = carry_g_sds[i]
+                        g = jnp.zeros(shape, dt)
+                    next_grads.append(g)
+                return ([new_state[i] for i in carry_val_idx], next_grads), \
+                    tuple(out_vals)
+
+            (f_vals, f_grads), ys = jax.lax.scan(
+                body, (list(carry_vals), list(carry_grads)),
+                tuple(xs_stacked), length=k)
+            return list(ys), f_vals, f_grads
+
+        donate = (0, 1) if self._donate else ()
+        jitted = jax.jit(pure_fn2, donate_argnums=donate)
+
+        uids = [uid for uid, _ in state_items]
+        self._last_partition = {
+            "donated": [uids[i] for i in carry_val_idx],
+            "readonly": [uids[i] for i in ro_val_idx],
+            "skipped": [uids[i] for i in skip_val_idx],
+            "donated_grads": [uids[i] for i in carry_grad_idx],
+            "readonly_grads": [uids[i] for i in ro_grad_idx],
+            "scan_steps": k,
+        }
+
+        carry_ts = [state_items[i][1] for i in carry_val_idx]
+        ro_ts = [state_items[i][1] for i in ro_val_idx]
+        cg_ts = [state_items[i][1] for i in carry_grad_idx]
+        rog_ts = [state_items[i][1] for i in ro_grad_idx]
+
+        def compiled(dyn_vals):
+            init_grads = []
+            for i, t in zip(carry_grad_idx, cg_ts):
+                g = t._grad
+                if g is None:
+                    shape, dt = carry_g_sds[i]
+                    g = jnp.zeros(shape, dt)
+                init_grads.append(g)
+            ys, f_vals, f_grads = jitted(
+                [t._value for t in carry_ts], init_grads, dyn_vals,
+                [t._value for t in ro_ts], [t._grad for t in rog_ts])
+            for t, v in zip(carry_ts, f_vals):
+                t._value = v
+            for i, t, g in zip(carry_grad_idx, cg_ts, f_grads):
+                t._grad = g if steady_mask[i] else None
+            return ys
+
+        def out_wrap(out_flat):
+            wrapped = [Tensor(v) if isinstance(v, jax.Array) else v
+                       for v in out_flat]
+            return jax.tree_util.tree_unflatten(out_template["treedef"],
+                                                wrapped)
+
+        return compiled, out_wrap
+
     def _try_ast_fallback(self, cause):
         """Swap self._fn for its dy2static-transformed version once."""
         import types as _types
@@ -431,20 +634,29 @@ class StaticFunction:
         return None
 
 
-def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
-    """Decorator / wrapper, usable as @to_static or to_static(fn)."""
+def to_static(function=None, input_spec=None, build_strategy=None,
+              scan_steps=None, **kwargs):
+    """Decorator / wrapper, usable as @to_static or to_static(fn).
+
+    ``scan_steps=k`` compiles ``function`` (the single-step body) as a
+    ``jax.lax.scan`` over k inner steps: dynamic args must arrive
+    ``[k, ...]``-stacked (one microbatch per inner step) and per-step
+    outputs return ``[k, ...]``-stacked. Compile time is ~independent of
+    k, vs linear in k for a python-unrolled loop over the body."""
     if function is None:
-        return lambda fn: to_static(fn, input_spec=input_spec)
+        return lambda fn: to_static(fn, input_spec=input_spec,
+                                    scan_steps=scan_steps)
     if isinstance(function, StaticFunction):
         return function
     # Layers: wrap forward, keep the layer object semantics
     from ..nn.layer.layers import Layer
     if isinstance(function, Layer):
         layer = function
-        static_forward = StaticFunction(layer.forward, input_spec)
+        static_forward = StaticFunction(layer.forward, input_spec,
+                                        scan_steps=scan_steps)
         layer.forward = static_forward
         return layer
-    return StaticFunction(function, input_spec)
+    return StaticFunction(function, input_spec, scan_steps=scan_steps)
 
 
 class InputSpec:

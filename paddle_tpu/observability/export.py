@@ -21,14 +21,54 @@ import time
 from .. import monitor
 
 __all__ = ["publish", "gauges", "prometheus_text", "telemetry_dict",
-           "write_json", "start_http_server", "PROM_PREFIX"]
+           "write_json", "start_http_server", "register_collector",
+           "unregister_collector", "PROM_PREFIX"]
 
 PROM_PREFIX = "paddle_tpu"
 
 _gauges = {}
 _gauges_lock = threading.Lock()
 
+# scrape-time collectors: name -> zero-arg fn returning {metric: value}.
+# For subsystems whose counters live OUTSIDE the python monitor registry
+# (the native PS server's per-table op latencies) — pulled fresh on every
+# scrape instead of being pushed. Metric names may carry a Prometheus
+# label suffix ('ps_server_op_ns{table="1000",op="pull_sparse"}'); values
+# must be monotonic counters.
+_collectors = {}
+_collectors_lock = threading.Lock()
+
 _name_re = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def register_collector(name, fn):
+    with _collectors_lock:
+        _collectors[name] = fn
+
+
+def unregister_collector(name):
+    with _collectors_lock:
+        _collectors.pop(name, None)
+
+
+_collector_errors = {}  # name -> lifetime count (keeps the series monotonic)
+
+
+def collected():
+    """Run all registered collectors; a broken collector is dropped from
+    the scrape (never kills it) and reported as a *_collector_errors
+    counter instead."""
+    out = {}
+    with _collectors_lock:
+        items = list(_collectors.items())
+    for name, fn in items:
+        try:
+            out.update(fn() or {})
+        except Exception:
+            _collector_errors[name] = _collector_errors.get(name, 0) + 1
+    for name, count in _collector_errors.items():
+        out[f"{name}_collector_errors"] = count
+    return out
 
 
 def publish(prefix, values):
@@ -55,15 +95,29 @@ def clear_gauges():
 
 
 def _prom_name(name):
+    # labels survive sanitization: only the name part (before '{') is
+    # restricted to the Prometheus metric-name alphabet
+    if "{" in name:
+        base, labels = name.split("{", 1)
+        return _name_re.sub("_", base) + "{" + labels
     return _name_re.sub("_", name)
 
 
 def prometheus_text(prefix=PROM_PREFIX):
-    """Render counters + gauges in the Prometheus text exposition format."""
+    """Render counters + gauges + collector pulls in the Prometheus text
+    exposition format."""
     lines = []
     for name, value in sorted(monitor.stats().items()):
         mname = f"{prefix}_{_prom_name(name)}"
         lines.append(f"# TYPE {mname} counter")
+        lines.append(f"{mname} {value}")
+    typed = set()
+    for name, value in sorted(collected().items()):
+        mname = f"{prefix}_{_prom_name(name)}"
+        base = mname.split("{", 1)[0]
+        if base not in typed:  # one TYPE line per family, not per label set
+            typed.add(base)
+            lines.append(f"# TYPE {base} counter")
         lines.append(f"{mname} {value}")
     for name, value in sorted(gauges().items()):
         mname = f"{prefix}_{_prom_name(name)}"
@@ -73,9 +127,9 @@ def prometheus_text(prefix=PROM_PREFIX):
 
 
 def telemetry_dict():
-    """Counters + gauges as one JSON-ready dict."""
+    """Counters + gauges + collector pulls as one JSON-ready dict."""
     return {"time": time.time(), "counters": monitor.stats(),
-            "gauges": gauges()}
+            "gauges": gauges(), "collected": collected()}
 
 
 def write_json(path):

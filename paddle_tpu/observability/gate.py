@@ -15,6 +15,14 @@ upward, everything else (img/s, tokens/s, GB/s, speedup "x", MFU)
 regresses downward. A metric present in the baseline but missing or
 errored in the current run FAILS the gate — silently dropped coverage is
 how regressions hide.
+
+Baselines are pinned on the hardware that matters (TPU); a CPU smoke
+host can't reproduce those numbers, so when the baseline and current
+record carry different ``backend`` tags the gate checks METRIC PRESENCE
+only (status PRESENT): the bench still ran and produced a usable value,
+but the value is not compared. A baseline record may also pin ``"gate":
+"presence"`` explicitly for metrics whose absolute value is known-noisy
+(loopback TCP, host-simulated dryruns) — presence-only on any host.
 """
 import json
 
@@ -92,6 +100,19 @@ def compare(baseline, current, tolerance=DEFAULT_TOLERANCE):
                 "note": ("metric errored or absent in current run: "
                          + str((cur or {}).get("error", "not present"))[:200])})
             continue
+        base_be, cur_be = base.get("backend"), cur.get("backend")
+        if (base.get("gate") == "presence"
+                or (base_be and cur_be and base_be != cur_be)):
+            report.append({
+                "metric": name, "status": "PRESENT",
+                "baseline": base["value"], "current": cur["value"],
+                "unit": base.get("unit", ""),
+                "note": (f"value not compared (baseline backend="
+                         f"{base_be or '?'}, current={cur_be or '?'}"
+                         + (", pinned presence-only"
+                            if base.get("gate") == "presence" else "")
+                         + ")")})
+            continue
         bv, cv = float(base["value"]), float(cur["value"])
         hib = higher_is_better(base)
         if bv == 0:
@@ -130,6 +151,10 @@ def format_report(report):
                 f"baseline {e['baseline']:g} {e['unit']} "
                 f"({(e['ratio'] - 1) * 100:+.1f}% {arrow}, "
                 f"tol ±{e['tolerance'] * 100:.0f}%)")
+        elif status == "PRESENT":
+            lines.append(
+                f"[{status:>10}] {e['metric']}: {e['current']:g} "
+                f"{e['unit']} — {e['note']}")
         elif status == "MISSING":
             lines.append(f"[{status:>10}] {e['metric']}: {e['note']}")
         elif status == "NEW":

@@ -5,7 +5,9 @@ A StepTimer marks step boundaries; over a sliding window it derives
 - tokens/s and examples/s (caller supplies per-step token/example counts),
 - an MFU estimate (``flops_per_step / step_time / peak_flops`` — the
   standard 6*N*T dense-transformer estimate when the caller passes
-  ``flops_per_step=6 * n_params * tokens_per_step``),
+  ``flops_per_step=6 * n_params * tokens_per_step``; pass the per-model
+  ``flops_per_token`` override — e.g. ``model.flops_per_token(seq)`` —
+  for exact attention-aware accounting),
 - compile-stall fraction: time the window spent building/compiling
   programs (``jit_compile_ns`` + ``executor_compile_ns`` + XLA
   ``jit_backend_compile_ns``, all maintained by the instrumentation),
@@ -48,11 +50,16 @@ class StepTimer:
 
     def __init__(self, window=20, tokens_per_step=None,
                  examples_per_step=None, flops_per_step=None,
-                 peak_flops=None, publish_as="step"):
+                 flops_per_token=None, peak_flops=None, publish_as="step"):
         self.window = int(window)
         self.tokens_per_step = tokens_per_step
         self.examples_per_step = examples_per_step
         self.flops_per_step = flops_per_step
+        # per-model FLOP count (e.g. model.flops_per_token(seq)): exact
+        # attention accounting instead of the 6*N*T dense estimate; when
+        # set it takes precedence and MFU follows the window's actual
+        # token count, so variable-size batches stay correct
+        self.flops_per_token = flops_per_token
         self.peak_flops = peak_flops or DEFAULT_PEAK_FLOPS
         self.publish_as = publish_as
         # (dt_s, tokens, examples, wait_ns, compile_ns) per completed step
@@ -116,7 +123,10 @@ class StepTimer:
             out["tokens_per_s"] = tokens / wall
         if examples:
             out["examples_per_s"] = examples / wall
-        if self.flops_per_step is not None and wall:
+        if self.flops_per_token is not None and tokens and wall:
+            out["mfu"] = (self.flops_per_token * tokens / wall
+                          / self.peak_flops)
+        elif self.flops_per_step is not None and wall:
             achieved = self.flops_per_step * len(w) / wall
             out["mfu"] = achieved / self.peak_flops
         return out

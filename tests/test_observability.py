@@ -279,6 +279,27 @@ def test_step_timer_mfu_estimate():
     assert "mfu" in t and t["mfu"] > 0
 
 
+def test_step_timer_flops_per_token_override():
+    """The per-model flops_per_token override drives MFU from the
+    window's actual token throughput and beats the flops_per_step
+    estimate when both are given."""
+    timer = obs.StepTimer(window=4, flops_per_step=1e20,  # would be absurd
+                          flops_per_token=1e6, peak_flops=1e12,
+                          publish_as=None).start()
+    time.sleep(0.005)
+    t = timer.step(tokens=1000)
+    # achieved = 1e6 * 1000 / dt; dt >= 5ms -> mfu <= 0.2, far below the
+    # absurd flops_per_step estimate (which would exceed 1e4)
+    assert 0 < t["mfu"] < 1.0
+    # without token counts the override cannot apply; falls back
+    timer2 = obs.StepTimer(window=2, flops_per_token=1e6,
+                           flops_per_step=1e7, peak_flops=1e12,
+                           publish_as=None).start()
+    time.sleep(0.002)
+    t2 = timer2.step()
+    assert t2["mfu"] > 0  # flops_per_step fallback path
+
+
 # -- exporters -------------------------------------------------------------
 
 def test_prometheus_and_json_exporters(tracing, tmp_path):
@@ -312,6 +333,55 @@ def test_metrics_http_server(tracing):
         assert tele["counters"]["obs_http_counter"] == 3
     finally:
         server.stop()
+
+
+@pytest.mark.skipif(not _native.AVAILABLE, reason="native runtime not built")
+def test_ps_server_per_table_op_latency_export():
+    """The native PS server's per-(table, op) service-side latencies show
+    up as labeled counters in both exporters, per table."""
+    from paddle_tpu.distributed.ps import PsClient, PsServer, TableConfig
+
+    srv = PsServer([TableConfig(41, "sparse", 4, "sgd", lr=0.1,
+                                init_range=0.1, seed=1),
+                    TableConfig(42, "sparse", 4, "sgd", lr=0.1,
+                                init_range=0.1, seed=1)], port=0)
+    port = srv.start()
+    cli = PsClient([f"127.0.0.1:{port}"])
+    try:
+        cli.register_sparse(41, 4)
+        cli.register_sparse(42, 4)
+        keys = np.arange(20, dtype=np.uint64)
+        for table in (41, 42):
+            rows = cli.pull_sparse(table, keys)
+            cli.push_sparse_grad(table, keys, np.ones_like(rows))
+        stats = {(r["table"], r["op"]): r for r in srv.stats()}
+        for table in (41, 42):
+            for op in ("pull_sparse", "push_sparse_grad"):
+                r = stats[(table, op)]
+                assert r["calls"] >= 1 and r["ns"] > 0
+        text = export_mod.prometheus_text()
+        assert ('paddle_tpu_ps_server_op_ns{table="41",op="pull_sparse"}'
+                in text)
+        assert ('paddle_tpu_ps_server_op_calls{table="42",'
+                'op="push_sparse_grad"}' in text)
+        tele = export_mod.telemetry_dict()
+        assert any(k.startswith("ps_server_op_ns") for k in
+                   tele["collected"])
+    finally:
+        cli.stop_servers()
+        srv.stop()
+
+
+def test_collector_errors_do_not_kill_scrape():
+    def broken():
+        raise RuntimeError("collector exploded")
+
+    export_mod.register_collector("obs_test_broken", broken)
+    try:
+        text = export_mod.prometheus_text()  # must not raise
+        assert "obs_test_broken_collector_errors" in text
+    finally:
+        export_mod.unregister_collector("obs_test_broken")
 
 
 # -- perf gate -------------------------------------------------------------
@@ -356,6 +426,34 @@ def test_gate_missing_metric_fails_and_new_is_informational():
     ok, rep = gate_mod.compare({"a": {"metric": "a", "error": "boom"}}, cur)
     assert ok
     assert rep[0]["status"] == "SKIP"
+
+
+def test_gate_backend_mismatch_checks_presence_only():
+    """A TPU-pinned baseline gated on a CPU smoke host: values are not
+    comparable, so the gate demands metric PRESENCE (a usable record)
+    and nothing else."""
+    base = {"a": dict(_rec("a", 5000.0, "img/s"), backend="tpu")}
+    # wildly lower CPU value still passes — PRESENT, not REGRESSION
+    ok, rep = gate_mod.compare(
+        base, {"a": dict(_rec("a", 3.0, "img/s"), backend="cpu")})
+    assert ok
+    assert rep[0]["status"] == "PRESENT"
+    # but an errored/absent record still fails: presence means PRESENT
+    ok, rep = gate_mod.compare(base, {"a": {"metric": "a", "error": "x"}})
+    assert not ok and rep[0]["status"] == "MISSING"
+    # same backend -> real value gating
+    ok, rep = gate_mod.compare(
+        base, {"a": dict(_rec("a", 3.0, "img/s"), backend="tpu")})
+    assert not ok and rep[0]["status"] == "REGRESSION"
+
+
+def test_gate_presence_pin_skips_value_compare():
+    base = {"n": dict(_rec("n", 3.0, "x"), backend="cpu",
+                      gate="presence")}
+    cur = {"n": dict(_rec("n", 0.5, "x"), backend="cpu")}
+    ok, rep = gate_mod.compare(base, cur)  # 6x "regression" — ignored
+    assert ok and rep[0]["status"] == "PRESENT"
+    assert "PRESENT" in gate_mod.format_report(rep)
 
 
 def test_write_baseline_drops_errored_records(tmp_path, capsys):

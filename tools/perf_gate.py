@@ -7,9 +7,11 @@ block the merge. Evidence-first: record runs with `--out`, pin them with
 the code it measures.
 
 Usage:
-    # gate a recorded results file (fast; no benches run):
-    python tools/perf_gate.py --baseline BASELINE_PERF.json \
-        --current results.json
+    # gate a recorded results file (fast; no benches run) against the
+    # pinned repo baseline (--baseline defaults to BASELINE_PERF.json;
+    # TPU-pinned values are compared on a TPU host, PRESENCE-checked on
+    # a CPU smoke host — see observability/gate.py):
+    python tools/perf_gate.py --current results.json
 
     # run the ladder and gate in one go:
     python tools/perf_gate.py --baseline BASELINE_PERF.json \
@@ -45,7 +47,10 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="perf-regression gate over benchmarks/run_all.py "
                     "result records")
-    ap.add_argument("--baseline", help="pinned baseline JSON")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, "BASELINE_PERF.json"),
+                    help="pinned baseline JSON (default: the repo's "
+                    "BASELINE_PERF.json)")
     ap.add_argument("--current", help="results JSON to gate "
                     "(default: run --configs)")
     ap.add_argument("--configs", default="resnet,allreduce",
