@@ -438,6 +438,17 @@ def main():
         with open(args.out, "w") as f:
             json.dump({"results": results}, f, indent=1)
     if args.write_baseline:
+        # a perf baseline is only meaningful for programs the static
+        # analyzer accepts: verify the ladder's program miniatures first
+        # and refuse to pin from an unverified ladder (tools/
+        # lint_program.py --ladder is the standalone front-end)
+        from paddle_tpu.analysis import errors, format_findings, ladder
+        bad = errors(ladder.verify_ladder()[0])
+        if bad:
+            print("refusing to pin a baseline: ladder program "
+                  "verification failed\n" + format_findings(bad),
+                  flush=True)
+            return 1
         n = gate_mod.write_baseline(results, args.write_baseline)
         print(f"wrote {n} baseline metrics to {args.write_baseline}",
               flush=True)

@@ -63,6 +63,7 @@ from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401
 from . import observability  # noqa: F401
+from . import analysis  # noqa: F401
 from . import distribution  # noqa: F401
 from . import text  # noqa: F401
 from . import dataset  # noqa: F401

@@ -1,0 +1,93 @@
+"""Collective-order checker for multi-device programs.
+
+A mesh deadlocks when ranks disagree on the collective schedule: rank 0
+issues allreduce(axis=dp) while rank 1 is in allgather(axis=mp), and both
+wait forever (the reference guards this with the C++ side's
+`c_gen_nccl_id`/comm-context ordering checks; GSPMD-inserted collectives
+can't skew, but *recorded* per-rank programs — the DistributeTranspiler
+family, hand-built pipeline ranks — can). The checker extracts each
+program's ordered collective sequence (op name + mesh axis, which
+`distributed.collective` stamps on the traced lowering as
+``fn._collective_axis``) and flags ranks whose sequences diverge, plus
+axis names no active mesh defines.
+"""
+from .findings import ERROR, WARNING, Finding
+
+__all__ = ["COLLECTIVE_OPS", "collective_sequence", "check_collectives",
+           "check_collective_order"]
+
+# op_name values distributed/collective.py records through call_op
+COLLECTIVE_OPS = frozenset({
+    "c_allreduce", "c_allgather", "c_reducescatter", "c_broadcast",
+    "c_scatter", "c_alltoall", "c_send", "c_recv", "c_barrier",
+    "p2p_transfer",
+})
+
+
+def collective_sequence(prog):
+    """Ordered [(op_index, op_name, axis_name)] of a program's recorded
+    collectives."""
+    return [(i, op.name, getattr(op.fn, "_collective_axis", None))
+            for i, op in enumerate(prog.ops) if op.name in COLLECTIVE_OPS]
+
+
+def _mesh_axes():
+    try:
+        from ..distributed import parallel_env
+        mesh = parallel_env.current_mesh()
+    except Exception:
+        return None
+    return tuple(mesh.axis_names) if mesh is not None else None
+
+
+def check_collectives(prog, mesh_axes=None):
+    """Single-program checks: every collective must name an axis the mesh
+    defines (an unknown axis fails at compile; a None axis means the
+    lowering lost its axis stamp and the order checker can't match it)."""
+    findings = []
+    if mesh_axes is None:
+        mesh_axes = _mesh_axes()
+    for i, name, ax in collective_sequence(prog):
+        if ax is None:
+            findings.append(Finding(
+                "collective-axis-unknown", WARNING,
+                f"{name} carries no axis stamp (_collective_axis); "
+                "cross-rank order checking cannot match it", op_index=i,
+                op_name=name))
+        elif mesh_axes is not None and ax not in mesh_axes:
+            findings.append(Finding(
+                "unknown-collective-axis", ERROR,
+                f"{name} reduces over axis {ax!r} but the active mesh "
+                f"defines {list(mesh_axes)}", op_index=i, op_name=name))
+    return findings
+
+
+def check_collective_order(programs, mesh_axes=None):
+    """Cross-rank check: all per-rank programs must issue the same
+    collective sequence (same length, op kind and axis at every position)
+    or a real mesh deadlocks at the first divergence."""
+    findings = []
+    if not programs:
+        return findings
+    seqs = [collective_sequence(p) for p in programs]
+    ref = seqs[0]
+    for r, seq in enumerate(seqs[1:], start=1):
+        if len(seq) != len(ref):
+            findings.append(Finding(
+                "collective-order-mismatch", ERROR,
+                f"rank {r} issues {len(seq)} collectives but rank 0 "
+                f"issues {len(ref)} — the mesh deadlocks at the first "
+                "unmatched collective"))
+        for k, ((_, n0, a0), (_, n1, a1)) in enumerate(zip(ref, seq)):
+            if n0 != n1 or a0 != a1:
+                findings.append(Finding(
+                    "collective-order-mismatch", ERROR,
+                    f"position {k}: rank 0 issues {n0}(axis={a0!r}) but "
+                    f"rank {r} issues {n1}(axis={a1!r}) — mismatched "
+                    "collectives cross-match on the wire and deadlock",
+                    op_index=seq[k][0], op_name=n1))
+    for r, p in enumerate(programs):
+        for f in check_collectives(p, mesh_axes=mesh_axes):
+            f.message = f"rank {r}: {f.message}"
+            findings.append(f)
+    return findings

@@ -1,0 +1,111 @@
+"""Donation / aliasing hazard detection.
+
+Donation in this stack appears at three seams:
+
+1. ``Program._buffer_updates`` — the op-list IR's aliasing declaration:
+   "buffer slot b is overwritten from slot o after the run". Any op that
+   reads b *after* the op producing o has run sees the stale pre-update
+   value in eager replay but an ambiguous buffer under XLA aliasing — the
+   donated-slot-read-after-donation class.
+2. Explicitly donated program inputs (the fused train step donates
+   parameter/optimizer state the way to_static donates its carry): an op
+   that *writes* a donated input slot destroys the original buffer for
+   every other reader.
+3. ``to_static``'s state partition (``StaticFunction._last_partition``):
+   a state uid may be donated OR read-only OR skipped, never two of those
+   at once — a donated buffer also threaded as a plain (non-donated) input
+   is exactly the "donated slot read after its donating op" hazard at the
+   jit boundary (XLA may alias the donated buffer to an output and delete
+   it out from under the read).
+"""
+from ..core.tensor import Parameter
+from .findings import ERROR, INFO, Finding
+from .verifier import in_slots
+
+__all__ = ["check_donation", "check_static_function"]
+
+
+def check_donation(prog, donated=None):
+    """Donation hazards over a Program. ``donated`` is the set of input
+    slots whose buffers are donated to the compiled step; default: the
+    trainable parameters when an optimizer is attached (the fused train
+    step's donated state), else empty. Pass the buffer slots too when the
+    program runs through a donated carry (the scan step program donates
+    ALL threaded state)."""
+    findings = []
+    if donated is None:
+        donated = set()
+        if prog._optimizer is not None:
+            donated = {s for s, t in prog.params.items()
+                       if isinstance(t, Parameter)}
+    donated = set(donated)
+
+    produced_at = {}
+    for i, op in enumerate(prog.ops):
+        for s in op.out_slots:
+            produced_at.setdefault(s, i)
+
+    # 1. read of a DONATED aliased buffer after its replacement is
+    # produced. Non-donated buffer updates are deferred write-backs (the
+    # executor assigns after the run) and a post-update read legitimately
+    # sees the pre-update value — batch_norm's normalize op reads the
+    # running stats it just scheduled an update for. Donation removes the
+    # deferral: the buffer is aliased to the producer's output and the
+    # later read is stale-vs-freed undefined.
+    for b, o in sorted(prog._buffer_updates.items()):
+        if b not in donated:
+            continue
+        i = produced_at.get(o)
+        if i is None:
+            continue  # dangling producer: the graph verifier owns that
+        for j in range(i + 1, len(prog.ops)):
+            if b in in_slots(prog.ops[j]):
+                findings.append(Finding(
+                    "donated-slot-reuse", ERROR,
+                    f"donated buffer slot {b} is aliased to the output "
+                    f"of op[{i}] ({prog.ops[i].name}) via _buffer_updates "
+                    f"but op[{j}] reads it afterwards — the donated "
+                    "buffer no longer holds the pre-update value",
+                    op_index=j, op_name=prog.ops[j].name, slot=b))
+
+    # 2. write into a donated input slot
+    for i, op in enumerate(prog.ops):
+        for s in op.out_slots:
+            if s in donated:
+                readers = [j for j in range(i + 1, len(prog.ops))
+                           if s in in_slots(prog.ops[j])]
+                findings.append(Finding(
+                    "donated-slot-reuse", ERROR,
+                    f"op overwrites donated input slot {s}"
+                    + (f"; op(s) {readers} read it afterwards"
+                       if readers else "")
+                    + " — the donated buffer no longer holds the input "
+                    "value", op_index=i, op_name=op.name, slot=s))
+    return findings
+
+
+def check_static_function(sfn):
+    """Partition-consistency check for a built ``StaticFunction`` (unrolled
+    or scan): the donated / read-only / skipped classes must be disjoint,
+    for values and grads alike."""
+    part = getattr(sfn, "_last_partition", None)
+    if part is None:
+        return [Finding(
+            "not-built", INFO,
+            "StaticFunction has not been traced yet; call it once (or "
+            "verify after the first step)")]
+    findings = []
+    pairs = [("donated", "readonly"), ("donated", "skipped"),
+             ("readonly", "skipped"),
+             ("donated_grads", "readonly_grads")]
+    for a, b in pairs:
+        both = set(part.get(a, ())) & set(part.get(b, ()))
+        for uid in sorted(both):
+            findings.append(Finding(
+                "donated-slot-reuse", ERROR,
+                f"state uid {uid!r} is in both the {a!r} and {b!r} "
+                "partitions of the compiled step — a donated carry "
+                "buffer must not also be threaded as a plain input "
+                "(XLA may alias it to an output and free it under the "
+                "other read)"))
+    return findings

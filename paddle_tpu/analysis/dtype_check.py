@@ -1,0 +1,189 @@
+"""Dtype/shape consistency checker: abstract replay via jax.eval_shape.
+
+Replays the recorded op-list on ShapeDtypeStruct placeholders (nothing
+executes, nothing allocates) and flags the dtype-drift classes that XLA
+compiles silently but that wreck TPU throughput or numerics:
+
+- silent fp64 upcasts (a python float / numpy default-f64 constant leaking
+  into the stream doubles every downstream buffer — on TPU fp64 is emulated
+  and catastrophically slow);
+- AMP boundary drift: an op on the ``downcast_out_list`` (layer_norm,
+  softmax, ...) whose inputs arrived bf16 but whose recorded lowering
+  returns fp32 — the residual stream gets pulled up to fp32 and
+  activation+cotangent HBM traffic doubles (measured 1.4x step time on
+  BERT-base, see amp/auto_cast.py);
+- mixed-precision compute: a matmul-class op fed both bf16 and fp32
+  operands — the AMP master-weight contract keeps fp32 masters *outside*
+  the compute stream, so an fp32 operand here is usually a master weight
+  leaking into what should be a pure-bf16 MXU op;
+- shape-specialization: a feed dim declared dynamic (-1) whose program
+  nevertheless bakes a concrete size (reshape to literals, etc.) — the
+  executor would re-specialize per shape, compiling per batch size.
+"""
+import numpy as np
+
+import jax
+
+from ..static.program import _Slot
+from .findings import ERROR, WARNING, Finding
+
+__all__ = ["check_dtypes", "abstract_replay"]
+
+_F64 = ("float64", "complex128")
+_LOW = ("bfloat16", "float16")
+
+
+def _sds(x):
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    # metadata only — np.asarray here would device->host copy a jax Array
+    # just to read its dtype (multi-GB transfer for a production program)
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        dt = np.asarray(x).dtype  # plain python scalar/list
+    return jax.ShapeDtypeStruct(tuple(np.shape(x)), np.dtype(dt))
+
+
+def _feed_build_shape(shape, bump):
+    # dynamic dims recorded as -1 were built with 1; `bump` re-sizes them
+    # to probe shape polymorphism
+    return tuple((1 + bump) if (s is None or s == -1) else int(s)
+                 for s in shape)
+
+
+def abstract_replay(prog, bump=0, on_op=None):
+    """Replay every op through ``jax.eval_shape``; returns
+    ``(env, findings)`` where env maps slot -> ShapeDtypeStruct. An op whose
+    abstract eval raises is reported and its outputs are back-filled from
+    the build-time tensors so the replay continues. ``on_op(i, op, in_sds,
+    out_sds)`` observes each successful op."""
+    from ..core.dtype import convert_dtype
+
+    findings = []
+    env = {}
+    for name, (slot, shape, dtype) in prog.feed_vars.items():
+        env[slot] = jax.ShapeDtypeStruct(_feed_build_shape(shape, bump),
+                                         np.dtype(convert_dtype(dtype)))
+    for s, t in prog.params.items():
+        env[s] = _sds(t._value)
+
+    for i, op in enumerate(prog.ops):
+        # only SLOT operands go abstract; raw args (shape lists, axis
+        # ints, bools) are closed over exactly as _replay passes them —
+        # eval_shape would otherwise abstract an axis into a tracer
+        arg_pos, kw_keys, in_sds = [], [], []
+        missing = False
+        for p, a in enumerate(op.arg_slots):
+            if isinstance(a, _Slot):
+                v = env.get(a.idx)
+                if v is None:
+                    missing = True
+                    break
+                arg_pos.append(p)
+                in_sds.append(v)
+        if not missing:
+            for k, v in op.kwarg_slots.items():
+                if isinstance(v, _Slot):
+                    sv = env.get(v.idx)
+                    if sv is None:
+                        missing = True
+                        break
+                    kw_keys.append(k)
+                    in_sds.append(sv)
+        if missing:
+            # a structural error (use-before-def) the graph verifier owns;
+            # keep replaying from the build-time values
+            outs = None
+        else:
+            def _call(*slot_vals, _op=op, _pos=arg_pos, _keys=kw_keys):
+                a = list(_op.arg_slots)
+                it = iter(slot_vals)
+                for p in _pos:
+                    a[p] = next(it)
+                kw = dict(_op.kwarg_slots)
+                for k in _keys:
+                    kw[k] = next(it)
+                return _op.fn(*a, **kw)
+
+            try:
+                out = jax.eval_shape(_call, *in_sds)
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+            except Exception as e:
+                findings.append(Finding(
+                    "abstract-eval-failed", WARNING if bump == 0 else ERROR,
+                    f"op does not abstract-eval on "
+                    f"{'build' if bump == 0 else 'resized dynamic'} "
+                    f"shapes: {str(e)[:200]}", op_index=i, op_name=op.name))
+                outs = None
+        if outs is None:
+            # back-fill from the tensors recorded at build so downstream
+            # ops still get checked
+            ka = prog._keepalive
+            outs = [_sds(ka[s]._value) if s < len(ka) else None
+                    for s in op.out_slots]
+        for s, o in zip(op.out_slots, outs):
+            if o is not None:
+                env[s] = _sds(o)
+        if on_op is not None and not missing:
+            on_op(i, op, in_sds, [env.get(s) for s in op.out_slots])
+    return env, findings
+
+
+def check_dtypes(prog, check_poly=True):
+    """Dtype-drift + shape-polymorphism findings for a Program."""
+    from ..amp.auto_cast import downcast_out_list, white_list
+
+    findings = []
+
+    def on_op(i, op, in_sds, out_sds):
+        in_dts = [str(s.dtype) for s in in_sds if s is not None]
+        out_dts = [str(s.dtype) for s in out_sds if s is not None]
+        if any(d in _F64 for d in out_dts) and \
+                not any(d in _F64 for d in in_dts):
+            findings.append(Finding(
+                "fp64-upcast", ERROR,
+                f"op introduces {[d for d in out_dts if d in _F64]} from "
+                f"inputs {in_dts}; fp64 is emulated on TPU and silently "
+                "doubles every downstream buffer", op_index=i,
+                op_name=op.name))
+        if op.name in downcast_out_list and any(d in _LOW for d in in_dts) \
+                and any(d == "float32" for d in out_dts):
+            findings.append(Finding(
+                "amp-boundary-upcast", WARNING,
+                f"{op.name} received {sorted(set(in_dts))} but returns "
+                "float32; the recorded lowering is missing the AMP "
+                "output downcast, pulling the residual stream to fp32",
+                op_index=i, op_name=op.name))
+        if op.name in white_list:
+            float_in = {d for d in in_dts
+                        if d in _LOW or d in ("float32",) + _F64}
+            if float_in & set(_LOW) and "float32" in float_in:
+                findings.append(Finding(
+                    "mixed-precision-input", WARNING,
+                    f"{op.name} mixes {sorted(float_in)} operands; under "
+                    "the AMP master-weight contract fp32 masters stay "
+                    "outside the compute stream — a bf16 MXU op fed an "
+                    "fp32 operand upcasts the whole contraction",
+                    op_index=i, op_name=op.name))
+
+    _, replay_findings = abstract_replay(prog, bump=0, on_op=on_op)
+    findings.extend(replay_findings)
+
+    if check_poly and any(
+            any(s in (None, -1) for s in shape)
+            for (_slot, shape, _dt) in prog.feed_vars.values()):
+        # an op already broken on BUILD shapes is not a polymorphism
+        # violation — only ops that eval on build shapes but break when a
+        # dynamic dim is resized have baked the size in
+        broken = {f.op_index for f in replay_findings
+                  if f.rule == "abstract-eval-failed"}
+        _, poly = abstract_replay(prog, bump=1)
+        for f in poly:
+            if f.op_index in broken:
+                continue
+            findings.append(Finding(
+                "shape-specialization", ERROR,
+                "feed dim declared dynamic (-1) but the program bakes a "
+                f"concrete size: {f.message}", op_index=f.op_index,
+                op_name=f.op_name))
+    return findings

@@ -1,0 +1,68 @@
+"""Finding/VerifyError primitives shared by every analysis checker.
+
+The reference's graph checks fail hard inside C++ (`PADDLE_ENFORCE` in
+`framework/ir/pass.cc`, `framework/prune.cc`); here every checker returns a
+list of structured ``Finding``s so callers choose the policy — the debug-mode
+pass hooks raise on errors, the lint CLI prints and sets the exit code, and
+the observability layer exports per-rule counters either way.
+"""
+
+__all__ = ["Finding", "VerifyError", "ERROR", "WARNING", "INFO",
+           "errors", "format_findings"]
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+
+class Finding:
+    """One analysis result: a rule violation (or advisory) anchored to a
+    program op / slot / source location."""
+
+    __slots__ = ("rule", "severity", "message", "op_index", "op_name",
+                 "slot", "loc")
+
+    def __init__(self, rule, severity, message, op_index=None, op_name=None,
+                 slot=None, loc=None):
+        if severity not in (ERROR, WARNING, INFO):
+            raise ValueError(f"bad severity {severity!r}")
+        self.rule = rule
+        self.severity = severity
+        self.message = message
+        self.op_index = op_index
+        self.op_name = op_name
+        self.slot = slot
+        self.loc = loc  # "path:line" for source-lint findings
+
+    def __repr__(self):
+        where = ""
+        if self.op_index is not None:
+            where = f" @op[{self.op_index}]"
+            if self.op_name:
+                where += f" {self.op_name}"
+        elif self.loc:
+            where = f" @{self.loc}"
+        if self.slot is not None:
+            where += f" slot={self.slot}"
+        return f"[{self.severity}] {self.rule}{where}: {self.message}"
+
+
+class VerifyError(RuntimeError):
+    """Raised by ``verify(..., raise_on_error=True)`` and the debug-mode
+    pass hooks when any error-severity finding is present."""
+
+    def __init__(self, findings, context=None):
+        self.findings = list(findings)
+        head = f"program verification failed ({context})" if context \
+            else "program verification failed"
+        super().__init__(head + "\n" + format_findings(self.findings))
+
+
+def errors(findings):
+    return [f for f in findings if f.severity == ERROR]
+
+
+def format_findings(findings):
+    if not findings:
+        return "  (no findings)"
+    return "\n".join(f"  {f!r}" for f in findings)

@@ -1,0 +1,185 @@
+"""Verified miniatures of the default benchmark ladder's programs.
+
+Every config in ``benchmarks/run_all.py``'s default ladder has a tiny
+static-graph twin here — same workload class (conv+BN for resnet,
+embedding+attention-ish matmuls for gpt/bert, ragged-ish head for
+detection, table lookup for hbm_cache, per-rank collective sequences for
+allreduce) at smoke scale, recorded as a Program and pushed through the
+full analyzer (graph verifier, dtype/shape checker, donation checker,
+program lint, collective-order checker). ``tools/lint_program.py
+--ladder`` runs them in CI, and ``run_all.py --write-baseline`` refuses to
+pin a perf baseline while any of them fails verification — the ladder's
+timings are only meaningful for programs the verifier accepts.
+"""
+
+__all__ = ["LADDER_BUILDERS", "build_ladder_programs", "verify_ladder"]
+
+
+def _resnet_like():
+    """conv + batch_norm(train) + relu + pool + fc + ce — exercises the
+    _buffer_updates path the executor write-backs ride."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("image", [2, 3, 8, 8], "float32")
+        y = static.data("label", [2], "int64")
+        conv = nn.Conv2D(3, 4, 3, padding=1)
+        bn = nn.BatchNorm2D(4)
+        h = nn.functional.relu(bn(conv(x)))
+        h = nn.functional.adaptive_avg_pool2d(h, 1)
+        h = paddle.reshape(h, [2, 4])
+        w = static.create_parameter([4, 10], "float32")
+        logits = paddle.matmul(h, w)
+        loss = nn.functional.cross_entropy(logits, y)
+    return [(prog, [loss])]
+
+
+def _gpt_like():
+    """embedding + qk matmul + softmax + v matmul + lm head — the
+    attention core of the gpt/bert ladder rows."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        ids = static.data("ids", [2, 6], "int64")
+        emb = nn.Embedding(32, 8)
+        h = emb(ids)
+        wq = static.create_parameter([8, 8], "float32")
+        wk = static.create_parameter([8, 8], "float32")
+        q = paddle.matmul(h, wq)
+        k = paddle.matmul(h, wk)
+        att = nn.functional.softmax(
+            paddle.matmul(q, paddle.transpose(k, [0, 2, 1])))
+        ctx = paddle.matmul(att, h)
+        logits = paddle.matmul(ctx, paddle.transpose(emb.weight, [1, 0]))
+        loss = nn.functional.cross_entropy(
+            paddle.reshape(logits, [-1, 32]), paddle.reshape(ids, [-1]))
+    return [(prog, [loss])]
+
+
+def _bert_like():
+    """gpt core + layer_norm + dropout, then the delete_dropout pass —
+    the pass output must verify as clean as its input."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    prog = static.Program()
+    prog.random_seed = 0  # dropout: keep the replay reproducible
+    with static.program_guard(prog):
+        ids = static.data("ids", [2, 4], "int64")
+        emb = nn.Embedding(16, 8)
+        h = emb(ids)
+        h = nn.functional.dropout(h, p=0.1, training=True)
+        h = nn.functional.layer_norm(h, [8])
+        w = static.create_parameter([8, 16], "float32")
+        logits = paddle.matmul(h, w)
+        loss = nn.functional.cross_entropy(
+            paddle.reshape(logits, [-1, 16]), paddle.reshape(ids, [-1]))
+    rewritten = static.apply_pass(prog, "delete_dropout_op_pass")
+    return [(prog, [loss]), (rewritten, [loss])]
+
+
+def _detection_like():
+    """conv head over a dynamic batch dim — the variable-shape bucket
+    path; the program must stay polymorphic in the batch."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        img = static.data("img", [-1, 3, 8, 8], "float32")
+        conv = nn.Conv2D(3, 6, 3, padding=1)
+        pred = nn.functional.sigmoid(conv(img))
+        loss = paddle.mean(pred)
+    return [(prog, [loss])]
+
+
+def _hbm_cache_like():
+    """embedding-table lookup + reduce — the CTR lookup workload."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        ids = static.data("slot_ids", [4, 3], "int64")
+        table = nn.Embedding(64, 8)
+        rows = table(ids)
+        loss = paddle.sum(rows)
+    return [(prog, [loss])]
+
+
+def _allreduce_ranks():
+    """Two per-rank programs with the SAME recorded collective sequence —
+    what the transpiled/hand-built multi-device path must look like for
+    the order checker to accept it."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.core.dispatch import call_op
+
+    pairs = []
+    for _rank in range(2):
+        prog = static.Program()
+        with static.program_guard(prog):
+            g = static.data("grad", [4], "float32")
+            # identity stand-ins for the in-shard_map lowerings, stamped
+            # the way distributed.collective stamps the real ones
+            def _ar(v):
+                return v
+            _ar._collective_axis = "dp"
+            summed = call_op(_ar, g, op_name="c_allreduce")
+
+            def _bc(v):
+                return v
+            _bc._collective_axis = "dp"
+            out = call_op(_bc, summed, op_name="c_broadcast")
+            loss = paddle.sum(out)
+        pairs.append((prog, [loss]))
+    return pairs
+
+
+LADDER_BUILDERS = {
+    "resnet": _resnet_like,
+    "gpt": _gpt_like,
+    "bert": _bert_like,
+    "detection": _detection_like,
+    "hbm_cache": _hbm_cache_like,
+    "allreduce": _allreduce_ranks,
+}
+
+
+def build_ladder_programs(configs=None):
+    """name -> [(program, targets), ...]. Multi-entry lists are per-rank
+    (allreduce) or pass-rewritten variants (bert)."""
+    names = configs or sorted(LADDER_BUILDERS)
+    return {n: LADDER_BUILDERS[n]() for n in names}
+
+
+def verify_ladder(configs=None, mesh_axes=("dp",)):
+    """Run the full analyzer over every ladder program. Returns
+    ``(findings, summary)`` where summary maps config -> op counts per
+    program. Clean = no findings at all."""
+    from . import lint, verify
+    from .collectives import check_collective_order
+    from .dtype_check import check_dtypes
+
+    findings = []
+    summary = {}
+
+    def _tag(config, fs):
+        for f in fs:
+            f.message = f"[{config}] {f.message}"
+            findings.append(f)
+
+    for name, pairs in build_ladder_programs(configs).items():
+        summary[name] = [len(p.ops) for p, _t in pairs]
+        for prog, targets in pairs:
+            _tag(name, verify(prog, targets=targets, mesh_axes=mesh_axes))
+            _tag(name, check_dtypes(prog))
+            _tag(name, lint(prog))
+        if name == "allreduce":
+            _tag(name, check_collective_order([p for p, _t in pairs],
+                                              mesh_axes=mesh_axes))
+    return findings, summary

@@ -1,0 +1,225 @@
+"""Program- and repo-level lint.
+
+Two surfaces:
+
+- ``lint_program(prog)``: advisory checks over a recorded Program that are
+  legal but hurt on TPU — host callbacks embedded in the compiled stream
+  (``py_func`` lowers to ``jax.pure_callback``: a device->host->device
+  round-trip per step), eager collectives that recorded as identities, etc.
+  Op naming matches the runtime's sampled dispatch telemetry
+  (``dispatch.op_display_name``) so a hot op flagged here is the same
+  string a profile shows.
+
+- ``lint_source(paths)``: AST lint over repo python — the two rule families
+  the CI gate runs on every PR:
+  * ``nondeterminism-in-traced``: wall-clock / RNG host calls inside a
+    ``@to_static``-decorated function. The trace bakes the value at compile
+    time (a ``Date``-like constant frozen into the program), so the
+    compiled step silently disagrees with the eager one.
+  * ``eager-jnp-in-hot-path``: device-touching ``jnp.*`` calls in the
+    dispatch/observability hot paths outside an ``enabled()``-style guard —
+    one stray ``jnp.zeros`` in ``call_op`` is a device allocation per op
+    dispatch.
+"""
+import ast
+import os
+
+from .findings import ERROR, WARNING, Finding
+
+__all__ = ["lint_program", "lint_source", "HOT_PATHS"]
+
+# host-callback op names: each is a device->host round-trip inside the
+# compiled program (stalls the TPU pipeline every step)
+_HOST_CALLBACK_OPS = frozenset({"py_func", "pure_callback", "host_callback"})
+
+# hot-path functions (relpath -> function names) where an unguarded
+# device-touching jnp call is a per-op-dispatch cost
+HOT_PATHS = {
+    os.path.join("paddle_tpu", "core", "dispatch.py"): {
+        "call_op", "call_op_nograd", "_call_op_impl",
+        "_call_op_nograd_impl", "_observed", "unwrap", "wrap",
+    },
+    os.path.join("paddle_tpu", "observability", "tracing.py"): {
+        "trace_span", "count", "enabled", "now_ns",
+    },
+}
+
+# jnp attributes that are metadata-only (no device work) and allowed in
+# hot paths
+_JNP_META_OK = frozenset({"shape", "ndim", "dtype", "result_type", "size"})
+
+# nondeterministic host calls that a trace would freeze into the program
+_NONDET_CALLS = {
+    ("time", "time"), ("time", "time_ns"), ("time", "perf_counter"),
+    ("time", "monotonic"), ("datetime", "now"), ("datetime", "utcnow"),
+    ("datetime", "today"), ("date", "today"), ("os", "urandom"),
+    ("uuid", "uuid1"), ("uuid", "uuid4"),
+}
+_NONDET_NP_RANDOM = frozenset({
+    "rand", "randn", "randint", "random", "normal", "uniform", "choice",
+    "permutation", "shuffle", "random_sample", "standard_normal",
+})
+
+
+def lint_program(prog):
+    findings = []
+    for i, op in enumerate(prog.ops):
+        if op.name in _HOST_CALLBACK_OPS:
+            findings.append(Finding(
+                "host-callback-in-program", WARNING,
+                f"{op.name} embeds a host python callback in the compiled "
+                "stream — a device->host->device round-trip per run "
+                "(unsupported on backends without host send/recv)",
+                op_index=i, op_name=op.name))
+    if prog.ops and prog.random_seed is None and any(
+            op.name in ("dropout", "gaussian_random", "uniform_random")
+            for op in prog.ops):
+        findings.append(Finding(
+            "unseeded-random-op", WARNING,
+            "program records RNG ops but Program.random_seed is unset; "
+            "replays are not reproducible across processes"))
+    return findings
+
+
+# -- source lint ----------------------------------------------------------
+
+def _attr_chain(node):
+    """'a.b.c' for an Attribute/Name chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_to_static_decorated(fn_node):
+    for dec in fn_node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        chain = _attr_chain(target) or ""
+        if chain.split(".")[-1] == "to_static":
+            return True
+    return False
+
+
+def _nondet_reason(chain):
+    if chain is None:
+        return None
+    parts = chain.split(".")
+    if len(parts) >= 2 and (parts[-2], parts[-1]) in _NONDET_CALLS:
+        return f"{parts[-2]}.{parts[-1]}()"
+    if parts[0] == "random" and len(parts) == 2:
+        return f"random.{parts[1]}()"
+    if len(parts) >= 3 and parts[-2] == "random" and \
+            parts[0] in ("np", "numpy") and parts[-1] in _NONDET_NP_RANDOM:
+        return f"{chain}() (module-level numpy RNG; use a seeded "\
+               "RandomState/Generator outside the traced fn)"
+    return None
+
+
+class _TracedFnChecker(ast.NodeVisitor):
+    """Flags nondeterministic host calls inside to_static-decorated fns."""
+
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+        self._in_traced = 0
+
+    def _visit_fn(self, node):
+        traced = _is_to_static_decorated(node)
+        self._in_traced += traced
+        self.generic_visit(node)
+        self._in_traced -= traced
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Call(self, node):
+        if self._in_traced:
+            reason = _nondet_reason(_attr_chain(node.func))
+            if reason:
+                self.findings.append(Finding(
+                    "nondeterminism-in-traced", ERROR,
+                    f"{reason} inside a @to_static function: the trace "
+                    "bakes the value at compile time, so the compiled "
+                    "step replays a frozen constant",
+                    loc=f"{self.path}:{node.lineno}"))
+        self.generic_visit(node)
+
+
+class _HotPathChecker(ast.NodeVisitor):
+    """Flags device-touching jnp calls in hot-path fns outside an
+    enabled()-style guard."""
+
+    def __init__(self, path, hot_fns, findings):
+        self.path = path
+        self.hot_fns = hot_fns
+        self.findings = findings
+        self._hot = 0
+        self._guarded = 0
+
+    def _visit_fn(self, node):
+        hot = node.name in self.hot_fns
+        self._hot += hot
+        self.generic_visit(node)
+        self._hot -= hot
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _visit_fn
+
+    def visit_If(self, node):
+        guard = "enabled(" in ast.unparse(node.test) or \
+            "_OBSERVER_LIST" in ast.unparse(node.test)
+        self._guarded += guard
+        self.generic_visit(node)
+        self._guarded -= guard
+
+    def visit_Call(self, node):
+        if self._hot and not self._guarded:
+            chain = _attr_chain(node.func) or ""
+            parts = chain.split(".")
+            if len(parts) >= 2 and parts[0] in ("jnp", "jax") and \
+                    parts[-1] not in _JNP_META_OK and \
+                    (parts[0] == "jnp" or
+                     (len(parts) >= 3 and parts[1] == "numpy")):
+                self.findings.append(Finding(
+                    "eager-jnp-in-hot-path", ERROR,
+                    f"unguarded {chain}() in hot-path function — a "
+                    "device op per dispatch; gate it behind the "
+                    "observability enabled() guard or hoist it",
+                    loc=f"{self.path}:{node.lineno}"))
+        self.generic_visit(node)
+
+
+def lint_source(paths=None, repo_root=None):
+    """AST-lint python sources. Default: the registered hot-path files plus
+    every file in ``paths``. Returns findings; files that fail to parse are
+    reported, not raised."""
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    findings = []
+    targets = []
+    if paths:
+        targets.extend(paths)
+    else:
+        targets.extend(os.path.join(repo_root, p) for p in HOT_PATHS)
+    seen = set()
+    for path in targets:
+        path = os.path.abspath(path)
+        if path in seen or not os.path.isfile(path):
+            continue
+        seen.add(path)
+        rel = os.path.relpath(path, repo_root)
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "syntax-error", ERROR, str(e), loc=f"{rel}:{e.lineno}"))
+            continue
+        _TracedFnChecker(rel, findings).visit(tree)
+        hot_fns = HOT_PATHS.get(rel)
+        if hot_fns:
+            _HotPathChecker(rel, hot_fns, findings).visit(tree)
+    return findings

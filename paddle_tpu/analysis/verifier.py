@@ -1,0 +1,161 @@
+"""Graph verifier over the recorded Program op-list.
+
+The structural half of what the reference's C++ side enforces around its ~80
+IR passes and `framework/prune.cc` (var presence, op input/output coverage,
+no dangling references after a rewrite), restated for the collapsed
+trace->XLA IR: slots instead of VarDescs, an ordered op-list instead of a
+block graph. A pass or prune that produces a use-before-def slot, drops a
+producer out from under `_buffer_updates`, or double-writes a slot used to
+surface only as an opaque XLA error (or silent wrong numbers) at compile
+time; here it surfaces as a structured ``Finding`` before compile.
+"""
+from ..static.program import _Slot
+from .findings import ERROR, WARNING, Finding
+
+__all__ = ["check_graph", "in_slots"]
+
+
+def in_slots(op):
+    """All slot indices an op record reads, positional + keyword."""
+    return [a.idx for a in op.arg_slots if isinstance(a, _Slot)] + \
+           [v.idx for v in op.kwarg_slots.values() if isinstance(v, _Slot)]
+
+
+def check_graph(prog, targets=None):
+    """Structural verification of a Program. ``targets`` (optional fetch
+    tensors/slots) additionally enables dead-op detection — without a fetch
+    set every unread output is a potential fetch and dead-ness is
+    undecidable."""
+    findings = []
+    nslots = prog._slot_count
+    feed_slots = {v[0] for v in prog.feed_vars.values()}
+    param_slots = set(prog.params)
+    inputs = feed_slots | param_slots
+
+    overlap = feed_slots & param_slots
+    for s in sorted(overlap):
+        findings.append(Finding(
+            "feed-param-overlap", ERROR,
+            "slot is both a feed placeholder and a program input "
+            "(parameter/buffer); replay would silently prefer the feed",
+            slot=s))
+
+    produced_at = {}   # slot -> first producing op index
+    read_slots = set()
+    for i, op in enumerate(prog.ops):
+        for s in in_slots(op):
+            read_slots.add(s)
+            if s < 0 or s >= nslots:
+                findings.append(Finding(
+                    "dangling-slot", ERROR,
+                    f"op reads slot {s} outside the program's slot space "
+                    f"(0..{nslots - 1})", op_index=i, op_name=op.name,
+                    slot=s))
+            elif s not in inputs and s not in produced_at:
+                findings.append(Finding(
+                    "use-before-def", ERROR,
+                    f"op reads slot {s} before any op produces it and it "
+                    "is neither a feed nor a program input (broken pass "
+                    "or prune?)", op_index=i, op_name=op.name, slot=s))
+        for s in op.out_slots:
+            if s < 0 or s >= nslots:
+                findings.append(Finding(
+                    "dangling-slot", ERROR,
+                    f"op writes slot {s} outside the program's slot space",
+                    op_index=i, op_name=op.name, slot=s))
+            elif s in produced_at:
+                findings.append(Finding(
+                    "duplicate-slot-write", ERROR,
+                    f"slot {s} already written by op[{produced_at[s]}]; "
+                    "replay is order-dependent and XLA buffer reuse is "
+                    "ambiguous", op_index=i, op_name=op.name, slot=s))
+            else:
+                produced_at[s] = i
+            if s in inputs:
+                findings.append(Finding(
+                    "input-overwrite", WARNING,
+                    f"op overwrites program input slot {s} "
+                    f"({'feed' if s in feed_slots else 'param/buffer'}); "
+                    "under donation the original buffer is gone",
+                    op_index=i, op_name=op.name, slot=s))
+
+    # feed/param coverage: inputs nothing reads bloat the jit signature
+    # (the prune() bug class) and usually mean a pass forgot to filter
+    for name, (s, _shape, _dtype) in sorted(prog.feed_vars.items()):
+        if s not in read_slots:
+            findings.append(Finding(
+                "unused-feed", WARNING,
+                f"feed {name!r} (slot {s}) is read by no op", slot=s))
+    for s in sorted(param_slots):
+        if s not in read_slots and s not in prog._buffer_updates:
+            findings.append(Finding(
+                "unused-program-input", WARNING,
+                f"program input slot {s} "
+                f"({getattr(prog.params[s], 'name', None)!r}) is read by "
+                "no op; it bloats the compiled signature (prune should "
+                "have filtered it)", slot=s))
+
+    # _buffer_updates: write-back aliases must point at live producers
+    for b, o in sorted(prog._buffer_updates.items()):
+        if o not in produced_at:
+            findings.append(Finding(
+                "dangling-buffer-update", ERROR,
+                f"buffer slot {b} is updated from slot {o}, which no "
+                "recorded op produces (producer pruned without filtering "
+                "_buffer_updates?)", slot=b))
+        if b >= nslots or b < 0:
+            findings.append(Finding(
+                "dangling-slot", ERROR,
+                f"buffer update targets slot {b} outside the slot space",
+                slot=b))
+        elif b not in param_slots:
+            findings.append(Finding(
+                "buffer-not-persistable", WARNING,
+                f"buffer update targets slot {b} which is not a program "
+                "input; the executor's write-back would KeyError",
+                slot=b))
+
+    loss = prog._loss_slot
+    if loss is not None and loss not in produced_at and loss not in inputs:
+        findings.append(Finding(
+            "dangling-loss-slot", ERROR,
+            f"loss slot {loss} is produced by no op (loss op pruned?)",
+            slot=loss))
+
+    if targets is not None:
+        findings.extend(_check_dead_ops(prog, targets, produced_at))
+    return findings
+
+
+def _check_dead_ops(prog, targets, produced_at):
+    """Backward liveness from the fetch set (+ loss + buffer updates):
+    ops contributing to none of them are dead weight the compiler must
+    still trace through (reference: prune.cc removes them)."""
+    findings = []
+    needed = set()
+    for t in (targets if isinstance(targets, (list, tuple)) else [targets]):
+        s = t if isinstance(t, int) else prog._slot_of(t, create=False)
+        if s is None:
+            findings.append(Finding(
+                "unknown-target", ERROR,
+                f"dead-op analysis target {getattr(t, 'name', t)!r} is not "
+                "recorded in this program"))
+            continue
+        needed.add(s)
+    if prog._loss_slot is not None:
+        needed.add(prog._loss_slot)
+    needed.update(prog._buffer_updates.values())
+    live = [False] * len(prog.ops)
+    for i in range(len(prog.ops) - 1, -1, -1):
+        op = prog.ops[i]
+        if any(s in needed for s in op.out_slots):
+            live[i] = True
+            needed.update(in_slots(op))
+    for i, op in enumerate(prog.ops):
+        if not live[i]:
+            findings.append(Finding(
+                "dead-op", WARNING,
+                "op contributes to no fetch target, loss, or buffer "
+                "update (prune would drop it)", op_index=i,
+                op_name=op.name))
+    return findings

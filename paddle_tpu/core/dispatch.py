@@ -15,7 +15,16 @@ from . import autograd
 from .dtype import is_inexact
 
 __all__ = ["call_op", "call_op_nograd", "wrap", "unwrap", "_STATIC_HOOK",
-           "add_observer", "remove_observer", "OpCapture", "capture_ops"]
+           "add_observer", "remove_observer", "OpCapture", "capture_ops",
+           "op_display_name"]
+
+
+def op_display_name(fn, op_name=None):
+    """Canonical op name — the ONE naming scheme shared by program
+    records, the sampled dispatch telemetry, and the static analyzer's
+    lint, so a hot op flagged by analysis is the same string a runtime
+    profile shows."""
+    return op_name or getattr(fn, "__name__", None) or "op"
 
 # When paddle.static program_guard is active, this holds Program.record and
 # every op call is captured into the program instead of the autograd tape.
@@ -201,7 +210,7 @@ def call_op(fn, *args, op_name=None, **kwargs):
     outputs (mixed-dtype ops are built as composites in the ops library).
     """
     if _OBSERVER_LIST is not None and _STATIC_HOOK[0] is None:
-        name = op_name or getattr(fn, "__name__", "op")
+        name = op_display_name(fn, op_name)
         return _observed(
             name, lambda: _call_op_impl(fn, *args, op_name=op_name, **kwargs))
     return _call_op_impl(fn, *args, op_name=op_name, **kwargs)
@@ -228,7 +237,7 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
     if _CAPTURE.stack:
         _note_capture_inputs(args, kwargs)
 
-    name = op_name or getattr(fn, "__name__", "op")
+    name = op_display_name(fn, op_name)
     fn = _amp_wrap_fn(fn, name, args)
 
     def g(*diff_vals):
@@ -240,7 +249,7 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
     outs, vjp_fn = jax.vjp(g, *diff_vals)
     out_meta = [(jnp.shape(o), o.dtype) for o in outs]
     node = autograd.TapeNode(vjp_fn, list(diff_tensors), out_meta,
-                             name=op_name or getattr(fn, "__name__", "op"),
+                             name=name,
                              pure_fn=g,
                              in_dtypes=[v.dtype for v in diff_vals])
 
@@ -260,7 +269,7 @@ def _call_op_impl(fn, *args, op_name=None, **kwargs):
 def call_op_nograd(fn, *args, op_name=None, **kwargs):
     """Run without recording (non-diff inputs, no_grad scope, or int ops)."""
     if _OBSERVER_LIST is not None and _STATIC_HOOK[0] is None:
-        name = op_name or getattr(fn, "__name__", "op")
+        name = op_display_name(fn, op_name)
         return _observed(
             name,
             lambda: _call_op_nograd_impl(fn, *args, op_name=op_name, **kwargs))
@@ -283,7 +292,7 @@ def _call_op_nograd_impl(fn, *args, op_name=None, **kwargs):
     capturing = bool(_CAPTURE.stack)
     if capturing:
         _note_capture_inputs(args, kwargs)
-    name = op_name or getattr(fn, "__name__", "op")
+    name = op_display_name(fn, op_name)
     fn = _amp_wrap_fn(fn, name, args)
     a = _amp_cast(name, [unwrap(x) for x in args])
     k = {key: unwrap(v) for key, v in kwargs.items()}

@@ -169,7 +169,13 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                # no lax.pprod: product via gather+reduce
                ReduceOp.PROD: lambda v, a: jnp.prod(
                    jax.lax.all_gather(v, a), axis=0)}
-        out = call_op(lambda v: fns[op](v, ax), tensor, op_name="c_allreduce")
+        def _ar(v):
+            return fns[op](v, ax)
+        # axis stamp consumed by paddle_tpu.analysis.collectives: recorded
+        # per-rank programs carry the mesh axis so the order checker can
+        # match collective sequences across ranks
+        _ar._collective_axis = ax
+        out = call_op(_ar, tensor, op_name="c_allreduce")
         tensor._value = out._value
         tensor._tape_node = out._tape_node
         tensor._tape_index = out._tape_index
@@ -200,8 +206,10 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     ax = _axis(group)
     if _in_named_trace(ax):
         _check_subgroup_in_trace(group, ax)
-        out = call_op(
-            lambda v: jax.lax.all_gather(v, ax), tensor, op_name="c_allgather")
+        def _ag(v):
+            return jax.lax.all_gather(v, ax)
+        _ag._collective_axis = ax
+        out = call_op(_ag, tensor, op_name="c_allgather")
         n = out.shape[0]
         for i in range(n):
             tensor_list.append(out[i])
@@ -242,6 +250,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
             return jax.lax.psum_scatter(jnp.stack(vs), ax,
                                         scatter_dimension=0, tiled=False)
 
+        _rs._collective_axis = ax
         out = call_op(_rs, *tensor_list, op_name="c_reducescatter")
         tensor._value = out._value
         return tensor
@@ -296,6 +305,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             masked = jnp.where(idx == src, v, jnp.zeros_like(v))
             # psum promotes bool→int32; restore the caller's dtype
             return jax.lax.psum(masked, ax).astype(v.dtype)
+        _bcast._collective_axis = ax
         out = call_op(_bcast, tensor, op_name="c_broadcast")
         tensor._value = out._value
         tensor._tape_node = out._tape_node
@@ -325,6 +335,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             idx = jax.lax.axis_index(ax)
             stacked = jnp.stack([unwrap(t) for t in tensor_list])
             return stacked[idx]
+        _scatter._collective_axis = ax
         out = call_op(_scatter, tensor, op_name="c_scatter")
         tensor._value = out._value
         return tensor
@@ -370,9 +381,10 @@ def p2p_transfer(tensor, src, dst, group=None):
                 "eager multi-process p2p_transfer is not supported; wrap it "
                 "in shard_map with the group's mesh axis bound")
         return tensor  # world of one: transfer-to-self
-    out = call_op(
-        lambda v: jax.lax.ppermute(v, ax, perm=[(src, dst)]),
-        tensor, op_name="p2p_transfer")
+    def _pp(v):
+        return jax.lax.ppermute(v, ax, perm=[(src, dst)])
+    _pp._collective_axis = ax
+    out = call_op(_pp, tensor, op_name="p2p_transfer")
     return out
 
 

@@ -263,6 +263,16 @@ class StaticFunction:
                 # jax.monitoring hook into jit_backend_compile_ns
                 _obs.count("jit_cache_miss")
                 _obs.count("jit_compile_ns", _obs.now_ns() - t0)
+            from ..analysis import debug_enabled
+            if debug_enabled():
+                # analysis debug mode: the fresh build's state partition
+                # must be hazard-free before the entry is ever run
+                from ..analysis import VerifyError, errors
+                bad = errors(self.verify())
+                if bad:
+                    raise VerifyError(
+                        bad, context=f"to_static build of "
+                        f"{getattr(self, '__name__', 'fn')!r}")
             self._cache[key] = entry
         else:
             _obs.count("jit_cache_hit", cat="jit")
@@ -623,6 +633,16 @@ class StaticFunction:
                 "paddle_tpu.nn.control_flow (cond/while_loop), or decorate "
                 "a plain `def` (lambdas cannot be AST-transformed).")
         return True
+
+    def verify(self):
+        """Static-analysis check of the compiled step's state partition
+        (paddle_tpu.analysis.check_static_function): donated /
+        read-only / skipped state classes must be disjoint. Returns the
+        findings; exported as analysis counters."""
+        from ..analysis import _export, check_static_function
+        findings = check_static_function(self)
+        _export(findings)
+        return findings
 
     # paddle API compat
     @property

@@ -40,7 +40,10 @@ def embedding(x, weight, padding_idx=None, sparse=False):
         return out
 
     if not sparse:
-        return call_op(_embed, weight, unwrap(x), op_name="embedding")
+        # x rides through call_op as a Tensor operand so static recording
+        # slots the ids feed (unwrap here would bake the placeholder
+        # value into the program — replay would look up zeros forever)
+        return call_op(_embed, weight, x, op_name="embedding")
 
     from ...core import autograd
     from ...core.selected_rows import SelectedRows

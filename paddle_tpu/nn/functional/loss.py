@@ -22,9 +22,14 @@ def _reduce(val, reduction):
 def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0):
-    lbl = unwrap(label)
-
-    def _ce(logits, *rest):
+    # label threads through call_op as an operand: under static recording
+    # it must resolve to a SLOT, not close over the placeholder value — a
+    # closed-over label would bake the build-time feed into the program
+    # (the analyzer's unused-feed/feed-coverage check catches this class).
+    # The reference gives Label no @GRAD (soft or hard), so the gradient
+    # is stopped inside the traced fn even for float soft labels.
+    def _ce(logits, lbl, *rest):
+        lbl = jax.lax.stop_gradient(lbl)
         w = rest[0] if weight is not None else None
         if use_softmax and not soft_label and label_smoothing == 0.0:
             # fused hard-label path: loss = logsumexp - picked, with fp32
@@ -90,7 +95,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
                 return jnp.sum(loss) / jnp.maximum(denom, 1)
         return _reduce(loss, reduction)
 
-    args = (input,) + ((weight,) if weight is not None else ())
+    args = (input, label) + ((weight,) if weight is not None else ())
     return call_op(_ce, *args, op_name="cross_entropy")
 
 

@@ -166,6 +166,21 @@ def _install_jax_hook():
 
 # -- sampled op-dispatch observer -----------------------------------------
 
+_op_label_re = None
+
+
+def _op_label(name):
+    """Sanitize an op name into a Prometheus label value. Op names come
+    from ``dispatch.op_display_name`` — the same string the analyzer's
+    program lint and a chrome-trace profile show — so the per-op series
+    and static findings join on the label."""
+    global _op_label_re
+    if _op_label_re is None:
+        import re
+        _op_label_re = re.compile(r'[^0-9A-Za-z_./:-]')
+    return _op_label_re.sub("_", name)
+
+
 class _SampledOpObserver:
     """Per-op spans through the core.dispatch observer seam, sampled by
     period so the op hot path stays cheap (one counter increment per op,
@@ -184,9 +199,15 @@ class _SampledOpObserver:
     def end(self, token, name, outputs):
         if token is None:
             return
-        profiler.record_span(f"op/{name}", "dispatch", token,
-                             profiler._now_ns())
+        end_ns = profiler._now_ns()
+        profiler.record_span(f"op/{name}", "dispatch", token, end_ns)
         monitor.stat_add("dispatch_sampled_ops", 1)
+        # per-op export (label-suffixed counters ride both exporters'
+        # label-aware name path): sampled call count + sampled wall ns,
+        # keyed by the canonical dispatch op name
+        key = _op_label(name)
+        monitor.stat_add('dispatch_op_sampled{op="%s"}' % key, 1)
+        monitor.stat_add('dispatch_op_ns{op="%s"}' % key, end_ns - token)
 
 
 def enable(categories=None, dispatch_sample_rate=0.01):

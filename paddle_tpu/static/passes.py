@@ -32,13 +32,35 @@ def list_passes():
 
 
 def apply_pass(program, names):
-    """reference: ir::Pass::Apply / paddle.static.apply_build_strategy."""
+    """reference: ir::Pass::Apply / paddle.static.apply_build_strategy.
+
+    Every registered pass must return a NEW Program (inputs shared, ops
+    rewritten). The rewritten clone's compile cache is always cleared —
+    a pass sharing ``_compiled`` with its input would replay stale
+    executables of the pre-rewrite op-list. In analysis debug mode
+    (``analysis.set_debug(True)`` / ``PADDLE_TPU_VERIFY=1``) the contract
+    is enforced and each pass output runs through the graph verifier, the
+    fluid-era "Pass validates the graph" behavior."""
+    from .. import analysis
     if isinstance(names, str):
         names = [names]
     for n in names:
         if n not in _PASS_REGISTRY:
             raise KeyError(f"unknown pass {n!r}; known: {list_passes()}")
-        program = _PASS_REGISTRY[n](program)
+        out = _PASS_REGISTRY[n](program)
+        if analysis.debug_enabled():
+            if not isinstance(out, Program) or out is program:
+                raise analysis.VerifyError(
+                    [analysis.Finding(
+                        "pass-contract", analysis.ERROR,
+                        f"pass {n!r} must return a new Program; got "
+                        f"{'the input program unchanged' if out is program else type(out).__name__}")],
+                    context=f"apply_pass({n!r})")
+            analysis.verify(out, raise_on_error=True,
+                            context=f"after pass {n!r}")
+        if isinstance(out, Program) and out is not program:
+            out._compiled = {}
+        program = out
     return program
 
 
@@ -49,10 +71,17 @@ def _shallow_clone(prog, ops):
     p._slot_count = prog._slot_count
     p._keepalive = prog._keepalive
     p.feed_vars = prog.feed_vars
+    p._pruned_feeds = set(prog._pruned_feeds)
     p.params = prog.params
     p._produced = prog._produced
     p._buffer_updates = dict(prog._buffer_updates)
     p.random_seed = prog.random_seed
+    # training identity survives a rewrite: a pass over a train program
+    # must return a program that still trains (clone(for_test) is the
+    # one that deliberately drops the optimizer)
+    p._optimizer = prog._optimizer
+    p._loss_slot = prog._loss_slot
+    p._ps_ctx = prog._ps_ctx
     return p
 
 
@@ -79,28 +108,65 @@ def remove_stat_update_pass(prog):
 def prune(prog, targets):
     """Backward slice to the ops that contribute to `targets` (reference:
     framework/prune.cc — feed/fetch-driven pruning used by
-    save_inference_model). Returns a new Program."""
-    needed = set()
+    save_inference_model). Returns a new Program.
+
+    Buffer-update producers ride with their consumers: if a kept op reads
+    an aliased buffer (batch_norm reading its running stats), the op
+    producing that buffer's update is kept too — in the reference the
+    MeanOut/VarianceOut stat outputs belong to the batch_norm op itself,
+    so a fetch-slice through BN keeps them; here the stat update is a
+    separate recorded op and joins the slice by fixpoint. (An eval-clone
+    has no stat-update ops, so inference pruning still drops them.)"""
+    roots = set()
     for t in (targets if isinstance(targets, (list, tuple)) else [targets]):
         s = prog._slot_of(t, create=False)
         if s is None:
             raise ValueError(f"target {getattr(t, 'name', t)!r} is not "
                              "recorded in this program")
-        needed.add(s)
-    kept = []
-    for op in reversed(prog.ops):
-        if any(s in needed for s in op.out_slots):
-            kept.append(op)
-            for a in op.arg_slots:
-                if isinstance(a, _Slot):
-                    needed.add(a.idx)
-            for v in op.kwarg_slots.values():
-                if isinstance(v, _Slot):
-                    needed.add(v.idx)
-    kept.reverse()
+        roots.add(s)
+    while True:
+        needed = set(roots)
+        kept = []
+        for op in reversed(prog.ops):
+            if any(s in needed for s in op.out_slots):
+                kept.append(op)
+                for a in op.arg_slots:
+                    if isinstance(a, _Slot):
+                        needed.add(a.idx)
+                for v in op.kwarg_slots.values():
+                    if isinstance(v, _Slot):
+                        needed.add(v.idx)
+        kept.reverse()
+        out_slots = {s for op in kept for s in op.out_slots}
+        extra = {o for b, o in prog._buffer_updates.items()
+                 if b in needed and o not in out_slots}
+        if extra <= roots:  # nothing new reachable: fixpoint
+            break
+        roots |= extra
     p = _shallow_clone(prog, kept)
     # buffer updates whose producing op was pruned are dropped
-    out_slots = {s for op in kept for s in op.out_slots}
     p._buffer_updates = {b: o for b, o in p._buffer_updates.items()
                          if o in out_slots}
+    # a slice that loses the loss op is an inference slice: drop the
+    # training identity rather than keep a dangling loss slot
+    if p._loss_slot is not None and p._loss_slot not in out_slots \
+            and p._loss_slot not in needed:
+        p._loss_slot = None
+        p._optimizer = None
+    # inputs narrow to the slice too: params/feeds no kept op references
+    # would otherwise stay in the jit signature (every original input
+    # threaded into a program that reads none of them) and in the
+    # save_inference_model persistables set (reference: prune.cc prunes
+    # the vars alongside the ops)
+    referenced = needed | set(p._buffer_updates)
+    p.params = {s: t for s, t in prog.params.items() if s in referenced}
+    p.feed_vars = {name: v for name, v in prog.feed_vars.items()
+                   if v[0] in referenced}
+    p._pruned_feeds = set(prog._pruned_feeds) | {
+        name for name, v in prog.feed_vars.items()
+        if v[0] not in referenced}
+    from .. import analysis
+    if analysis.debug_enabled():
+        analysis.verify(p, targets=targets, raise_on_error=True,
+                        context="after prune")
     return p

@@ -54,6 +54,7 @@ class Program:
         self._slot_count = 0
         self._keepalive = []  # strong refs so id() stays valid
         self.feed_vars = {}  # name -> (slot, shape, dtype)
+        self._pruned_feeds = set()  # feed names prune() sliced away
         self.params = {}  # slot -> Parameter
         self._produced = set()  # slots written by a recorded op
         self._buffer_updates = {}  # buffer slot -> producing out slot
@@ -211,6 +212,7 @@ class Program:
         p._slot_count = self._slot_count
         p._keepalive = self._keepalive
         p.feed_vars = self.feed_vars
+        p._pruned_feeds = set(self._pruned_feeds)
         p.params = self.params
         p._produced = self._produced
         p.random_seed = self.random_seed
@@ -219,6 +221,13 @@ class Program:
     # vars exposed for program-inspection tests (meta-optimizer test analog)
     def op_names(self):
         return [op.name for op in self.ops]
+
+    def verify(self, targets=None, raise_on_error=False, **kwargs):
+        """Run the static analyzer over this program (see
+        paddle_tpu.analysis.verify)."""
+        from .. import analysis
+        return analysis.verify(self, targets=targets,
+                               raise_on_error=raise_on_error, **kwargs)
 
 
 _default_main = Program()
@@ -305,7 +314,9 @@ class Executor:
                 return x  # export/to_static tracing a program replay
             return np.asarray(x)
 
-        feed_names = sorted(feed.keys())
+        # feeds prune() sliced out of the program are ignored (the caller
+        # may feed the original dict); unknown names still KeyError
+        feed_names = sorted(n for n in feed if n not in prog._pruned_feeds)
         feed_slots = [prog.feed_vars[n][0] for n in feed_names]
         feed_vals = [_feed_val(feed[n]) for n in feed_names]
         grad_fetches = [(i, v) for i, v in enumerate(fetch_list)

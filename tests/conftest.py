@@ -13,6 +13,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 run")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle

@@ -1,0 +1,138 @@
+"""static/passes.py edge cases: pruning through _buffer_updates,
+delete_dropout on dropout-free programs, pass composition order
+(ISSUE 3 satellite)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.analysis as analysis
+from paddle_tpu import nn, static
+
+
+def _bn_prog():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4, 3, 3], "float32")
+        conv = nn.Conv2D(4, 4, 1)
+        pre = conv(x)
+        bn = nn.BatchNorm2D(4)
+        post = bn(pre)
+        loss = paddle.mean(post)
+    return prog, bn, pre, post, loss
+
+
+class TestPruneThroughBufferUpdates:
+    def test_prune_to_post_bn_keeps_updates(self):
+        prog, bn, _pre, post, _loss = _bn_prog()
+        pruned = static.prune(prog, [post])
+        assert "batch_norm_stat_update" in [op.name for op in pruned.ops]
+        assert pruned._buffer_updates  # aliases survive with the producer
+        assert analysis.verify(pruned, targets=[post]) == []
+        # executing the pruned program still write-backs the buffers
+        before = np.asarray(bn._mean.numpy()).copy()
+        exe = static.Executor()
+        exe.run(pruned,
+                feed={"x": np.random.RandomState(0)
+                      .rand(2, 4, 3, 3).astype(np.float32)},
+                fetch_list=[post])
+        assert not np.allclose(before, np.asarray(bn._mean.numpy()))
+
+    def test_prune_to_pre_bn_drops_updates(self):
+        prog, _bn, pre, _post, _loss = _bn_prog()
+        pruned = static.prune(prog, [pre])
+        names = [op.name for op in pruned.ops]
+        assert "batch_norm" not in names
+        assert "batch_norm_stat_update" not in names
+        # no dangling aliases left behind (the seeded-defect class)
+        assert pruned._buffer_updates == {}
+        assert analysis.verify(pruned, targets=[pre]) == []
+
+
+class TestPassEdgeCases:
+    def test_delete_dropout_without_dropout(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            y = paddle.tanh(x)
+        out = static.apply_pass(prog, "delete_dropout_op_pass")
+        assert out is not prog  # contract: always a new Program
+        assert out.op_names() == prog.op_names()
+        exe = static.Executor()
+        (got,) = exe.run(out, feed={"x": np.ones((2, 4), np.float32)},
+                         fetch_list=[y])
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.tanh(np.ones((2, 4))), rtol=1e-6)
+
+    def test_pass_composition_order(self):
+        def build():
+            prog = static.Program()
+            prog.random_seed = 0
+            with static.program_guard(prog):
+                x = static.data("x", [2, 4, 3, 3], "float32")
+                bn = nn.BatchNorm2D(4)
+                h = bn(x)
+                h = nn.functional.dropout(h, p=0.5, training=True)
+                paddle.mean(h)
+            return prog
+
+        a = static.apply_pass(
+            build(), ["delete_dropout_op_pass", "remove_stat_update_pass"])
+        b = static.apply_pass(
+            build(), ["remove_stat_update_pass", "delete_dropout_op_pass"])
+        assert a.op_names() == b.op_names()
+        assert a._buffer_updates == {} and b._buffer_updates == {}
+        assert "batch_norm_stat_update" not in a.op_names()
+        assert analysis.verify(a) == [] and analysis.verify(b) == []
+
+    def test_pass_output_independent_compile_cache(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            y = nn.functional.dropout(x, p=0.5, training=True)
+        exe = static.Executor()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        exe.run(prog, feed=feed, fetch_list=[y])
+        n_compiled = len(prog._compiled)
+        assert n_compiled >= 1
+        out = static.apply_pass(prog, "delete_dropout_op_pass")
+        assert out._compiled == {}  # rewritten clone never reuses stale exe
+        (got,) = exe.run(out, feed=feed, fetch_list=[y])
+        np.testing.assert_allclose(np.asarray(got), np.ones((2, 4)))
+        assert len(prog._compiled) == n_compiled  # original cache intact
+
+
+class TestPassKeepsTrainingIdentity:
+    def test_apply_pass_program_still_trains(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            w = static.create_parameter([4, 1], "float32")
+            loss = paddle.mean(paddle.matmul(x, w))
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+            opt.minimize(loss)
+        assert prog._optimizer is not None
+        out = static.apply_pass(prog, "remove_stat_update_pass")
+        # the rewritten clone keeps the training identity...
+        assert out._optimizer is prog._optimizer
+        assert out._loss_slot == prog._loss_slot
+        before = np.asarray(w.numpy()).copy()
+        exe = static.Executor()
+        exe.run(out, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+        # ...and actually updates parameters when run
+        assert not np.allclose(before, np.asarray(w.numpy()))
+
+    def test_prune_away_from_loss_drops_training(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            w = static.create_parameter([4, 1], "float32")
+            h = paddle.matmul(x, w)
+            loss = paddle.mean(h)
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+            opt.minimize(loss)
+        # slicing to the loss keeps training; slicing away from it is an
+        # inference slice and must not keep a dangling loss slot
+        assert static.prune(prog, [loss])._optimizer is not None
+        pruned = static.prune(prog, [h])
+        assert pruned._optimizer is None and pruned._loss_slot is None
+        assert analysis.verify(pruned, targets=[h]) == []

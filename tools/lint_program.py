@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Program verifier + TPU lint CLI — the repo's static-analysis gate.
+
+Reference analog: the C++-side graph checks that keep fluid's ~80 IR
+passes and `framework/prune.cc` honest, surfaced as a CI-runnable tool
+over the collapsed trace->XLA pipeline.
+
+    python tools/lint_program.py               # --ladder and --source
+    python tools/lint_program.py --ladder      # verify the benchmark
+                                               # ladder's program miniatures
+    python tools/lint_program.py --source      # AST lint (nondeterminism in
+                                               # traced fns, eager jnp in
+                                               # dispatch hot paths)
+    python tools/lint_program.py --source paddle_tpu/core/dispatch.py ...
+
+Exit codes: 0 clean, 1 any error-severity finding (warnings print but do
+not fail the gate; --strict promotes them). Wired into the verify-skill
+recipe and `benchmarks/run_all.py --write-baseline` (a perf baseline must
+not be pinned from a program the verifier rejects).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static analysis over paddle_tpu programs and sources")
+    ap.add_argument("--ladder", action="store_true",
+                    help="verify the benchmark ladder's program miniatures")
+    ap.add_argument("--source", nargs="*", metavar="PATH",
+                    help="AST-lint sources (no PATH = the registered "
+                    "hot-path files)")
+    ap.add_argument("--configs", default=None,
+                    help="comma list of ladder configs (default: all)")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings also fail the gate")
+    args = ap.parse_args(argv)
+
+    # no flags = both; either flag alone selects just that half
+    run_ladder = args.ladder or args.source is None
+    run_source = args.source is not None or not args.ladder
+
+    findings = []
+    if run_ladder:
+        # the miniatures are smoke-scale: always verify on CPU (the env
+        # var alone is not honored once an accelerator plugin is
+        # installed; the config update must come before first jax use)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from paddle_tpu.analysis import ladder
+        configs = args.configs.split(",") if args.configs else None
+        fs, summary = ladder.verify_ladder(configs=configs)
+        findings.extend(fs)
+        for name, op_counts in sorted(summary.items()):
+            print(f"ladder[{name}]: {len(op_counts)} program(s), "
+                  f"ops={op_counts}")
+    if run_source:
+        from paddle_tpu.analysis import lint_source
+        findings.extend(lint_source(paths=args.source or None))
+
+    n_err = sum(f.severity == "error" for f in findings)
+    n_warn = sum(f.severity == "warning" for f in findings)
+    for f in findings:
+        print(f)
+    print(f"lint_program: {n_err} error(s), {n_warn} warning(s), "
+          f"{len(findings) - n_err - n_warn} info")
+    if n_err or (args.strict and n_warn):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,7 @@ MODULES = [
     "paddle_tpu.optimizer.lr",
     "paddle_tpu.static",
     "paddle_tpu.jit",
+    "paddle_tpu.analysis",
     "paddle_tpu.amp",
     "paddle_tpu.io",
     "paddle_tpu.metric",
