@@ -27,6 +27,16 @@ Measured history on the shared v5e (for future rounds — don't re-try losers):
   owns that fusion. Don't retry.
 - r4 winners: k20 (+2.2% over k16) and pure-bf16 params + fp32 masters
   (+0.5%); combined 0.511 -> 0.525 MFU back-to-back.
+- r7 (CPU-small BERT config — no TPU attached to the builder): ZeRO-1/2
+  inside the scan step (scan_k*_zero{1,2} variants, bench.py --zero):
+  optimizer state sharded 1/dp in flat stores, grads reduced by bucketed
+  psum_scatter + param all_gather under shard_map. Losses bitwise-equal
+  to the replicated dp control (tests/test_zero_sharding.py); compiled
+  HLO swaps per-param all-reduce for reduce-scatter+all-gather
+  (collective_bytes counters carry the numbers). At dp=1 (single chip)
+  zero is pure overhead — the steady-state A/B
+  (scan_k20_bf16 vs scan_k20_bf16_zero1) NEEDS a multichip TPU runner;
+  the HBM headroom (state/dp) may buy back batch or k.
 - r6 (this PR, CPU-small BERT config — no TPU attached to the builder):
   scan-compiled step program vs python-unrolled control, first-call
   trace+compile+run wall time: unroll k2 17.0s / k8 82.7s / k20 267.5s
@@ -47,7 +57,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
-               scan=False):
+               scan=False, zero=0):
     """The flagship program, identical to bench.py: k training steps per
     compiled program, optimization_barrier between backward and AdamW.
     Returns (step_fn, args, model) with step_fn compiled via to_static.
@@ -59,9 +69,14 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
     scan: compile the single-step body once and roll it with lax.scan
     (to_static(one_step, scan_steps=k)); args become [k, ...]-stacked —
     the same microbatch repeated, matching the unrolled control's batch
-    reuse so the A/B isolates program structure."""
+    reuse so the A/B isolates program structure.
+
+    zero: ZeRO stage 1/2 — optimizer state sharded 1/dp over all local
+    devices, bucketed psum_scatter grad reduction + param all_gather
+    inside the scan (implies scan)."""
     import numpy as np
 
+    import jax
     import jax.lax as lax
 
     import paddle_tpu as paddle
@@ -69,6 +84,13 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
         synthetic_mlm_batch
 
     paddle.seed(0)
+    if zero:
+        scan = True
+        from paddle_tpu.distributed import parallel_env
+        dp = jax.device_count()
+        parallel_env.set_mesh(parallel_env.make_mesh({"dp": dp}))
+        if batch % dp:
+            batch = max(dp, batch - batch % dp)
     cfg = BertConfig(vocab_size=30720, hidden_dropout=0.0,
                      attention_dropout=0.0)
     model = BertForPretraining(cfg)
@@ -77,6 +99,8 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
                                  learning_rate=1e-4,
                                  multi_precision=pure_bf16)
+    if zero:
+        opt._zero_enable(axis="dp", stage=zero)
     params = list(model.parameters())
 
     def one_step(ids, tok, labels, nsp_labels):
@@ -97,7 +121,8 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
     ids, tok, labels, nsp = synthetic_mlm_batch(batch, seq,
                                                 vocab_size=cfg.vocab_size)
     if scan:
-        step = paddle.jit.to_static(one_step, scan_steps=k)
+        step = paddle.jit.to_static(one_step, scan_steps=k,
+                                    dp_axis="dp" if zero else None)
         stack = lambda a: np.broadcast_to(a, (k,) + a.shape).copy()
         ids, tok, labels, nsp = (stack(a) for a in (ids, tok, labels, nsp))
     else:
@@ -112,11 +137,11 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
 
 
 def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2,
-                pure_bf16=False, white=(), scan=False):
+                pure_bf16=False, white=(), scan=False, zero=0):
     seq = 512
     step, args, model = build_step(k=k, batch=batch, seq=seq,
                                    pure_bf16=pure_bf16, white=white,
-                                   scan=scan)
+                                   scan=scan, zero=zero)
     last = (lambda l: l[-1]) if scan else (lambda l: l)
     t_compile = time.perf_counter()
     for _ in range(warmup):
@@ -139,11 +164,16 @@ def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2,
 
 
 def parse_spec(spec):
-    """'[scan_]k<N>[_b<N>][_bf16][_wsm][_wln]' -> run_variant kwargs."""
-    kw = {"k": 16, "batch": 16, "pure_bf16": False, "scan": False}
+    """'[scan_]k<N>[_b<N>][_bf16][_wsm][_wln][_zero<S>]' -> run_variant
+    kwargs (e.g. scan_k20_bf16_zero1)."""
+    kw = {"k": 16, "batch": 16, "pure_bf16": False, "scan": False,
+          "zero": 0}
     white = []
     for part in spec.split("_"):
         if part == "scan":
+            kw["scan"] = True
+        elif part in ("zero1", "zero2"):
+            kw["zero"] = int(part[-1])
             kw["scan"] = True
         elif part == "bf16":
             kw["pure_bf16"] = True

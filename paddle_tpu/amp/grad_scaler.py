@@ -48,7 +48,7 @@ class GradScaler:
         from ..ops.math import multiply
         return multiply(var, Tensor(self._scale._value))
 
-    def unscale_(self, optimizer):
+    def unscale_(self, optimizer, _check_finite=True):
         from ..core.selected_rows import SelectedRows
         if not self._enable:
             return
@@ -65,24 +65,83 @@ class GradScaler:
                 p._grad = SelectedRows(sr.rows, v, sr.height)
             else:
                 g = p._grad * inv.astype(p._grad.dtype)
-                found = found | ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+                if _check_finite:
+                    found = found | ~jnp.all(jnp.isfinite(
+                        g.astype(jnp.float32)))
                 p._grad = g
-        self._found_inf = found
+        self._found_inf = found if _check_finite else None
+
+    @staticmethod
+    def _dp_found(found):
+        """Under a manual dp axis the unscale ran on LOCAL gradients: a
+        rank-local inf must skip the update on EVERY rank or params
+        diverge across the mesh."""
+        import jax
+
+        from ..distributed import parallel_env
+        ax = parallel_env.current_dp_axis()
+        if ax is not None and parallel_env.axis_bound(ax):
+            return jax.lax.psum(found.astype(jnp.float32), ax) > 0
+        return found
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
+        zero = getattr(optimizer, "_zero", None)
+        if zero is not None:
+            # ZeRO: defer the finite check to the optimizer's sharded
+            # step — isfinite runs over each rank's reduced bucket shard
+            # (1/dp of the work) and a tiny psum'd flag gates the update
+            if self._found_inf is False:
+                self.unscale_(optimizer, _check_finite=False)
+                zero["pending_found"] = None
+            else:
+                zero["pending_found"] = self._found_inf
+            zero["pending_scaler"] = True
+            optimizer.step()
+            found = zero.pop("last_found_inf")
+            self._found_inf = found
+            self._update(found)
+            return
         if self._found_inf is False:
             self.unscale_(optimizer)
-        found = self._found_inf
-        # check_finite_and_unscale: skip the update when non-finite
+        found = self._dp_found(self._found_inf)
+        # check_finite_and_unscale: skip the update when non-finite — the
+        # WHOLE update: params, accumulators (moments), fp32 masters and
+        # fused flat stores alike, or one overflow step writes inf/NaN
+        # moments that poison every later (finite) step
         params = [p for p in optimizer._parameters()
                   if not p.stop_gradient and p._grad is not None]
-        saved = [p._value for p in params]
+        saved = [(p, p._value) for p in params]
+        step_count = getattr(optimizer, "_step_count", None)
+        if step_count is not None:
+            # a skipped step must not advance bias correction either
+            saved.append((step_count, step_count._value))
+        accs = getattr(optimizer, "_accumulators", {})
+        pre_keys = set(accs.keys())
+        flat_stores = set()
+        for acc in accs.values():
+            store = getattr(acc, "store", None)
+            if store is not None:  # _FlatSlot view: restore the store once
+                if id(store) not in flat_stores:
+                    flat_stores.add(id(store))
+                    saved.append((store.tensor, store.tensor._value))
+            else:
+                saved.append((acc, acc._value))
         optimizer.step()
-        for p, old in zip(params, saved):
-            p._value = jnp.where(found, old, p._value)
+        for obj, old in saved:
+            obj._value = jnp.where(found, old, obj._value)
+        # accumulators born DURING the step (lazily-created fp32 masters)
+        # have no snapshot; on overflow their correct value is the
+        # restored param they were created from
+        by_id = {id(p): p for p in params}
+        for key in set(accs.keys()) - pre_keys:
+            slot, pid = key
+            p = by_id.get(pid)
+            if slot == "master" and p is not None:
+                accs[key]._value = jnp.where(
+                    found, p._value.astype(jnp.float32), accs[key]._value)
         self._update(found)
 
     def _update(self, found):

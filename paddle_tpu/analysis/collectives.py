@@ -25,9 +25,15 @@ COLLECTIVE_OPS = frozenset({
 
 
 def collective_sequence(prog):
-    """Ordered [(op_index, op_name, axis_name)] of a program's recorded
-    collectives."""
-    return [(i, op.name, getattr(op.fn, "_collective_axis", None))
+    """Ordered [(op_index, op_name, axis_name, nbytes)] of a program's
+    recorded collectives. ``nbytes`` is the payload stamp
+    ``distributed.collective`` leaves on the lowering
+    (``fn._collective_nbytes``; None when the lowering predates the
+    stamp) — it is what lets the order checker see a rank-divergent
+    BUCKET layout, where op kind and axis agree at every position but
+    the payloads crossing the wire do not."""
+    return [(i, op.name, getattr(op.fn, "_collective_axis", None),
+             getattr(op.fn, "_collective_nbytes", None))
             for i, op in enumerate(prog.ops) if op.name in COLLECTIVE_OPS]
 
 
@@ -47,7 +53,7 @@ def check_collectives(prog, mesh_axes=None):
     findings = []
     if mesh_axes is None:
         mesh_axes = _mesh_axes()
-    for i, name, ax in collective_sequence(prog):
+    for i, name, ax, _nbytes in collective_sequence(prog):
         if ax is None:
             findings.append(Finding(
                 "collective-axis-unknown", WARNING,
@@ -78,13 +84,22 @@ def check_collective_order(programs, mesh_axes=None):
                 f"rank {r} issues {len(seq)} collectives but rank 0 "
                 f"issues {len(ref)} — the mesh deadlocks at the first "
                 "unmatched collective"))
-        for k, ((_, n0, a0), (_, n1, a1)) in enumerate(zip(ref, seq)):
+        for k, ((_, n0, a0, b0), (_, n1, a1, b1)) in enumerate(zip(ref, seq)):
             if n0 != n1 or a0 != a1:
                 findings.append(Finding(
                     "collective-order-mismatch", ERROR,
                     f"position {k}: rank 0 issues {n0}(axis={a0!r}) but "
                     f"rank {r} issues {n1}(axis={a1!r}) — mismatched "
                     "collectives cross-match on the wire and deadlock",
+                    op_index=seq[k][0], op_name=n1))
+            elif b0 is not None and b1 is not None and b0 != b1:
+                findings.append(Finding(
+                    "collective-order-mismatch", ERROR,
+                    f"position {k}: rank 0's {n0}(axis={a0!r}) carries "
+                    f"{b0} bytes but rank {r}'s carries {b1} — the ranks "
+                    "disagree on the bucket layout (same op kind, "
+                    "different payload cross-matches on the wire: data "
+                    "corruption or a hang)",
                     op_index=seq[k][0], op_name=n1))
     for r, p in enumerate(programs):
         for f in check_collectives(p, mesh_axes=mesh_axes):

@@ -19,7 +19,7 @@ Donation in this stack appears at three seams:
    it out from under the read).
 """
 from ..core.tensor import Parameter
-from .findings import ERROR, INFO, Finding
+from .findings import ERROR, INFO, WARNING, Finding
 from .verifier import in_slots
 
 __all__ = ["check_donation", "check_static_function"]
@@ -87,7 +87,8 @@ def check_donation(prog, donated=None):
 def check_static_function(sfn):
     """Partition-consistency check for a built ``StaticFunction`` (unrolled
     or scan): the donated / read-only / skipped classes must be disjoint,
-    for values and grads alike."""
+    for values and grads alike; PartitionSpec-sharded state (ZeRO stores)
+    must be threaded, never captured."""
     part = getattr(sfn, "_last_partition", None)
     if part is None:
         return [Finding(
@@ -108,4 +109,25 @@ def check_static_function(sfn):
                 "buffer must not also be threaded as a plain input "
                 "(XLA may alias it to an output and free it under the "
                 "other read)"))
+    # sharded state the program neither reads nor writes: harmless to
+    # the program (unused tracers drop out of the jaxpr) but a smell —
+    # either a stale store from a dead optimizer still registered, or a
+    # live store whose layout this step silently won't maintain
+    for uid in sorted(set(part.get("sharded", ()))
+                      & set(part.get("skipped", ()))):
+        findings.append(Finding(
+            "sharded-state-skipped", WARNING,
+            f"state uid {uid!r} carries a PartitionSpec but the compiled "
+            "step neither reads nor writes it — stale ZeRO store, or a "
+            "sharded buffer this program won't maintain"))
+    if part.get("dp_axis") is not None:
+        survivors = set(part.get("donated_grads", ()))
+        sharded = set(part.get("sharded", ()))
+        for uid in sorted(survivors & sharded):
+            findings.append(Finding(
+                "sharded-grad-carry", ERROR,
+                f"grad of sharded state uid {uid!r} survives the "
+                "dp-sharded scan carry — per-rank partial gradients of "
+                "sharded state cannot reassemble at the carry boundary; "
+                "consume them inside the step (opt.step + clear_grad)"))
     return findings

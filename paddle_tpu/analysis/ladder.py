@@ -140,6 +140,44 @@ def _allreduce_ranks():
     return pairs
 
 
+def _zero1_ranks():
+    """Two per-rank programs with the ZeRO-1 collective schedule —
+    bucketed grad reduce-scatter (two comm buckets) followed by the
+    refreshed-param all-gather, payload-stamped the way
+    distributed.collective stamps the real lowerings. The order checker
+    must accept matching ranks (and tests seed the divergent-bucket
+    variant it must reject)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.core.dispatch import call_op
+
+    pairs = []
+    for _rank in range(2):
+        prog = static.Program()
+        with static.program_guard(prog):
+            g0 = static.data("grad_bucket0", [8, 16], "float32")
+            g1 = static.data("grad_bucket1", [4, 16], "float32")
+
+            def _rs(v, _nbytes):
+                def fn(x):
+                    return x
+                fn._collective_axis = "dp"
+                fn._collective_nbytes = _nbytes
+                return call_op(fn, v, op_name="c_reducescatter")
+
+            s0 = _rs(g0, 8 * 16 * 4)
+            s1 = _rs(g1, 4 * 16 * 4)
+
+            def _ag(x):
+                return x
+            _ag._collective_axis = "dp"
+            _ag._collective_nbytes = (8 + 4) * 16 * 4
+            out = call_op(_ag, s0, op_name="c_allgather")
+            loss = paddle.sum(out) + paddle.sum(s1)
+        pairs.append((prog, [loss]))
+    return pairs
+
+
 LADDER_BUILDERS = {
     "resnet": _resnet_like,
     "gpt": _gpt_like,
@@ -147,6 +185,7 @@ LADDER_BUILDERS = {
     "detection": _detection_like,
     "hbm_cache": _hbm_cache_like,
     "allreduce": _allreduce_ranks,
+    "zero1": _zero1_ranks,
 }
 
 
@@ -179,7 +218,7 @@ def verify_ladder(configs=None, mesh_axes=("dp",)):
             _tag(name, verify(prog, targets=targets, mesh_axes=mesh_axes))
             _tag(name, check_dtypes(prog))
             _tag(name, lint(prog))
-        if name == "allreduce":
+        if name in ("allreduce", "zero1"):
             _tag(name, check_collective_order([p for p, _t in pairs],
                                               mesh_axes=mesh_axes))
     return findings, summary

@@ -1,0 +1,81 @@
+"""Gradient bucket assignment (reference: `imperative/reducer.cc`
+group-size logic behind DataParallel's ``comm_buffer_size``).
+
+One collective per *bucket* instead of one per parameter: reduction
+latency amortizes over ``comm_buffer_size`` MB of payload, and — in the
+compiled ZeRO step — the reduce-scatter of bucket i overlaps the backward
+compute that produces bucket i+1 (XLA's async collective scheduling does
+the overlap; the bucket boundary is what gives it independent work).
+
+Both consumers share this module:
+- the eager ``DataParallel.apply_collective_grads`` fused allreduce, and
+- the optimizer's ZeRO step, whose flat per-bucket stores (moments /
+  masters) are laid out with exactly these assignments.
+"""
+import numpy as np
+
+from .. import monitor
+
+__all__ = ["bucket_params", "bucket_nbytes", "DEFAULT_COMM_BUFFER_MB"]
+
+DEFAULT_COMM_BUFFER_MB = 25.0  # reference DataParallel default
+
+
+def _param_nbytes(p):
+    """Reduction payload of one parameter's gradient: grads are reduced in
+    fp32 regardless of param dtype (the optimizer casts before the
+    update), so 4 bytes/element."""
+    shape = tuple(p._value.shape)
+    return int(np.prod(shape, dtype=np.int64)) * 4 if shape else 4
+
+
+def bucket_params(params, comm_buffer_mb=DEFAULT_COMM_BUFFER_MB,
+                  last_comm_buffer_mb=None, counter_prefix=None):
+    """Greedy in-order assignment of ``params`` into buckets capped at
+    ``comm_buffer_mb`` MB of fp32 gradient payload (the final bucket is
+    capped at ``last_comm_buffer_mb`` when given, mirroring the reference's
+    ``last_comm_buffer_size``). Order is preserved — bucket layout must be
+    identical on every rank or the collective schedules diverge.
+
+    Returns a list of non-empty lists of params. A parameter larger than
+    the cap gets a bucket of its own.
+    """
+    params = list(params)
+    if not params:
+        return []
+    cap = max(float(comm_buffer_mb), 0.0) * 1024 * 1024
+    buckets = [[]]
+    fill = 0.0
+    for p in params:
+        nb = _param_nbytes(p)
+        if buckets[-1] and fill + nb > cap:
+            buckets.append([])
+            fill = 0.0
+        buckets[-1].append(p)
+        fill += nb
+    if (last_comm_buffer_mb is not None and len(buckets) > 1):
+        # re-split the tail so the final bucket stays under the last cap:
+        # small trailing buckets flush the pipeline sooner (reference
+        # reducer.cc's last-group special case)
+        last_cap = max(float(last_comm_buffer_mb), 0.0) * 1024 * 1024
+        tail = buckets.pop()
+        cur, fill = [], 0.0
+        for p in tail:
+            nb = _param_nbytes(p)
+            if cur and fill + nb > last_cap:
+                buckets.append(cur)
+                cur, fill = [], 0.0
+            cur.append(p)
+            fill += nb
+        if cur:
+            buckets.append(cur)
+    if counter_prefix:
+        monitor.stat_add(f"{counter_prefix}_buckets", len(buckets))
+        monitor.stat_add(f"{counter_prefix}_bucket_bytes",
+                         sum(bucket_nbytes(b) for b in buckets))
+    return buckets
+
+
+def bucket_nbytes(bucket):
+    """Total fp32 gradient payload of one bucket."""
+    return sum(_param_nbytes(p) for p in bucket)

@@ -108,6 +108,27 @@ class ReduceOp:
     PROD = "prod"
     AVG = "avg"
 
+    ALL = frozenset({"sum", "max", "min", "prod", "avg"})
+
+
+def _validate_reduce_op(op, supported=None):
+    """Reject unknown/unsupported ReduceOp values with a real error
+    instead of a KeyError deep in the lowering table."""
+    if op not in ReduceOp.ALL:
+        raise ValueError(
+            f"unknown ReduceOp {op!r}; expected one of "
+            f"{sorted(ReduceOp.ALL)} (use the ReduceOp.* constants)")
+    if supported is not None and op not in supported:
+        raise NotImplementedError(
+            f"ReduceOp {op!r} is not supported by this collective "
+            f"(supported: {sorted(supported)})")
+
+
+def _tensor_nbytes(value):
+    shape = tuple(jnp.shape(value))
+    n = int(np.prod(shape)) if shape else 1
+    return n * np.dtype(value.dtype).itemsize
+
 
 class Group:
     """A communicator: a mesh axis name (+ rank list for bookkeeping)."""
@@ -133,15 +154,8 @@ _group_count = 0
 
 def _in_named_trace(axis_name):
     """True when called under shard_map with this axis bound."""
-    if axis_name is None:
-        return False
-    try:
-        jax.lax.axis_index(axis_name)
-        return True
-    except NameError:
-        return False
-    except Exception:
-        return False
+    from . import parallel_env
+    return parallel_env.axis_bound(axis_name)
 
 
 def new_group(ranks=None, backend=None, axis_name=None):
@@ -160,6 +174,7 @@ def _axis(group):
 
 @_instrumented
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    _validate_reduce_op(op)
     ax = _axis(group)
     if _in_named_trace(ax):
         _check_subgroup_in_trace(group, ax)
@@ -171,10 +186,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                    jax.lax.all_gather(v, a), axis=0)}
         def _ar(v):
             return fns[op](v, ax)
-        # axis stamp consumed by paddle_tpu.analysis.collectives: recorded
-        # per-rank programs carry the mesh axis so the order checker can
-        # match collective sequences across ranks
+        # axis + payload stamps consumed by paddle_tpu.analysis.collectives:
+        # recorded per-rank programs carry the mesh axis AND the payload
+        # size so the order checker can match collective sequences (and
+        # flag rank-divergent bucket layouts) across ranks
         _ar._collective_axis = ax
+        _ar._collective_nbytes = _tensor_nbytes(unwrap(tensor))
         out = call_op(_ar, tensor, op_name="c_allreduce")
         tensor._value = out._value
         tensor._tape_node = out._tape_node
@@ -209,6 +226,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         def _ag(v):
             return jax.lax.all_gather(v, ax)
         _ag._collective_axis = ax
+        _ag._collective_nbytes = _tensor_nbytes(unwrap(tensor))
         out = call_op(_ag, tensor, op_name="c_allgather")
         n = out.shape[0]
         for i in range(n):
@@ -238,10 +256,19 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     (reference: c_reducescatter_op.cc). Traced path rides
     lax.psum_scatter over the mesh axis; single-process eager reduces
     the local list (the degenerate world, like all_reduce above)."""
-    if op != ReduceOp.SUM:
-        raise NotImplementedError(
-            "reduce_scatter supports ReduceOp.SUM (the reference op is "
-            "sum-only too)")
+    _validate_reduce_op(op, supported={ReduceOp.SUM})
+    if tensor_list:
+        # every entry is one rank's contribution: mismatched shapes used
+        # to surface as a cryptic jnp.stack broadcast failure deep in the
+        # lowering — validate up front with the offending entry named
+        shapes = [tuple(jnp.shape(unwrap(t))) for t in tensor_list]
+        dtypes = [np.dtype(unwrap(t).dtype) for t in tensor_list]
+        for i, (s, d) in enumerate(zip(shapes, dtypes)):
+            if s != shapes[0] or d != dtypes[0]:
+                raise ValueError(
+                    f"reduce_scatter needs identical per-rank shapes/"
+                    f"dtypes; entry 0 is {shapes[0]}/{dtypes[0]} but "
+                    f"entry {i} is {s}/{d}")
     ax = _axis(group)
     if _in_named_trace(ax):
         _check_subgroup_in_trace(group, ax)
@@ -251,6 +278,8 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                                         scatter_dimension=0, tiled=False)
 
         _rs._collective_axis = ax
+        _rs._collective_nbytes = sum(_tensor_nbytes(unwrap(t))
+                                     for t in tensor_list)
         out = call_op(_rs, *tensor_list, op_name="c_reducescatter")
         tensor._value = out._value
         return tensor

@@ -35,6 +35,10 @@ class DistributedStrategy:
         self.sharding_configs = {
             "stage": 1,
             "segment_broadcast_MB": 32.0,
+            # gradient-reduction bucket cap for the ZeRO-1/2 flat path
+            # (the dygraph analog of segment_broadcast_MB): one
+            # psum_scatter per comm_buffer_size_MB of fp32 grads
+            "comm_buffer_size_MB": 25.0,
             "offload": False,
         }
         # pipeline (reference :950)

@@ -3,17 +3,35 @@
 assigns each param's optimizer state to one sharding rank, prunes the rest,
 and inserts broadcasts; helpers `sharding/shard.py`, `sharding/prune.py`).
 
-TPU: assignment/pruning/broadcast are all replaced by a PartitionSpec on the
-accumulator: GSPMD materializes 1/N of each moment per chip and the compiled
-update runs sharded (grads arrive reduce-scattered to match). `stage>=3`
-additionally shards the parameters (see meta_parallel.sharding_parallel)."""
-from ..meta_parallel.sharding_parallel import shard_spec_for, _axis_degree
+Two TPU-native mechanisms, picked per optimizer:
+
+1. **Flat sharded stores (ZeRO-1/2, the compiled fast path)** — the
+   optimizer's state is re-laid-out into per-bucket flat [rows, 1024]
+   stores sharded 1/degree per rank (``Optimizer._zero_enable``), and the
+   step switches to bucketed ``psum_scatter`` gradient reduction +
+   shard-local update + param ``all_gather``. Buckets are sized from the
+   strategy's ``comm_buffer_size_MB`` (the reference
+   ``segment_broadcast_MB`` analog for the dygraph path). This is what the
+   scan-compiled ``to_static(..., dp_axis=...)`` step program runs.
+
+2. **Layout annotation (the GSPMD fallback)** — a PartitionSpec on each
+   per-param accumulator tensor; GSPMD materializes 1/N of each moment per
+   chip and schedules the collectives implicitly. Kept for optimizers the
+   flat path rejects (per-param lr scales, non-elementwise updates, sparse
+   grads) — correctness is unchanged, only the explicit bucketing/byte
+   accounting is lost.
+
+`stage>=3` additionally shards the parameters (see
+meta_parallel.sharding_parallel)."""
+import warnings
+
 from ..base import topology as topo_mod
+from ..meta_parallel.sharding_parallel import _axis_degree, shard_spec_for
 
 
 def shard_optimizer_state(optimizer, mesh=None, axis=topo_mod.AXIS_SHARD):
-    """Annotate every optimizer accumulator with a sharding PartitionSpec.
-    Returns number of accumulators sharded."""
+    """Annotate every optimizer accumulator with a sharding PartitionSpec
+    (the GSPMD fallback layout). Returns number of accumulators sharded."""
     if mesh is None:
         hcg = topo_mod.get_hybrid_communicate_group()
         mesh = hcg.mesh if hcg is not None else None
@@ -22,7 +40,9 @@ def shard_optimizer_state(optimizer, mesh=None, axis=topo_mod.AXIS_SHARD):
     if getattr(optimizer, "_fuse_acc", False):
         raise NotImplementedError(
             "optimizer-state sharding annotates per-param accumulator "
-            "tensors; use an optimizer without fuse_accumulators=True")
+            "tensors; fuse_accumulators=True optimizers shard through "
+            "the ZeRO flat path (Optimizer._zero_enable / "
+            "DygraphShardingOptimizer) instead")
     for (_slot, _pid), acc in optimizer._accumulators.items():
         spec = shard_spec_for(tuple(acc._value.shape), axis, degree)
         if spec is not None:
@@ -33,9 +53,16 @@ def shard_optimizer_state(optimizer, mesh=None, axis=topo_mod.AXIS_SHARD):
 
 class DygraphShardingOptimizer:
     """Reference-shaped wrapper: holds the inner optimizer whose state has
-    been sharded over the sharding axis."""
+    been sharded over the sharding axis.
 
-    def __init__(self, inner_optimizer, hcg=None, axis=None):
+    Prefers the ZeRO flat path (``inner._zero_enable``): bucketed
+    psum_scatter reduction + 1/degree flat stores, driven by the
+    strategy's ``sharding_configs`` (``stage`` 1/2,
+    ``comm_buffer_size_MB``). Falls back to per-accumulator
+    PartitionSpec annotation when the optimizer can't run flat."""
+
+    def __init__(self, inner_optimizer, hcg=None, axis=None, strategy=None,
+                 stage=None, comm_buffer_mb=None):
         self._inner = inner_optimizer
         hcg = hcg or topo_mod.get_hybrid_communicate_group()
         if axis is None:
@@ -44,8 +71,41 @@ class DygraphShardingOptimizer:
                     and hcg.get_sharding_parallel_world_size() > 1
                     else topo_mod.AXIS_DATA)
         self._axis = axis
-        self._n_sharded = shard_optimizer_state(
-            inner_optimizer, mesh=hcg.mesh if hcg else None, axis=axis)
+        cfg = {}
+        if strategy is not None:
+            cfg = getattr(strategy, "sharding_configs", None) or {}
+        if stage is None:
+            stage = int(cfg.get("stage", 1))
+        if comm_buffer_mb is None:
+            comm_buffer_mb = cfg.get("comm_buffer_size_MB",
+                                     cfg.get("segment_broadcast_MB", 25.0))
+        self._stage = min(int(stage), 2)  # stage 3 = param layout, not ours
+        mesh = hcg.mesh if hcg else None
+        self._zero_flat = False
+        trainable = [p for p in inner_optimizer._parameters()
+                     if not p.stop_gradient]
+        if mesh is None or not trainable:
+            # topology-only HCG (no real devices) or a fully-frozen
+            # model: layout annotation is still meaningful (and a no-op
+            # respectively) where the flat path would refuse
+            self._n_sharded = shard_optimizer_state(
+                inner_optimizer, mesh=mesh, axis=axis)
+            return
+        try:
+            # a conflicting prior _zero_enable raises RuntimeError and
+            # must propagate — swallowing it would silently keep a
+            # layout the strategy asked to replace
+            self._n_sharded = inner_optimizer._zero_enable(
+                axis=axis, mesh=mesh, stage=self._stage,
+                comm_buffer_mb=float(comm_buffer_mb))
+            self._zero_flat = True
+        except NotImplementedError as e:
+            warnings.warn(
+                f"ZeRO flat sharding unavailable for "
+                f"{type(inner_optimizer).__name__} ({e}); falling back to "
+                "GSPMD accumulator-layout annotation")
+            self._n_sharded = shard_optimizer_state(
+                inner_optimizer, mesh=mesh, axis=axis)
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

@@ -33,7 +33,8 @@ class StrategyCompiler:
 
         if hcg is not None and (strategy.sharding
                                 or hcg.get_sharding_parallel_world_size() > 1):
-            chosen["sharding"] = lambda opt: DygraphShardingOptimizer(opt, hcg)
+            chosen["sharding"] = lambda opt: DygraphShardingOptimizer(
+                opt, hcg, strategy=strategy)
 
         if strategy.dgc:
             # reference dgc_optimizer._can_apply: only Momentum (not Adam)

@@ -28,9 +28,8 @@ from ..base import topology as topo_mod
 
 
 def _axis_degree(mesh, axis):
-    if mesh is None:
-        return 1
-    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    from ... import parallel_env
+    return parallel_env.axis_degree(mesh, axis)
 
 
 def shard_spec_for(shape, axis, degree):
@@ -72,19 +71,57 @@ def shard_parameters(layers, axis=topo_mod.AXIS_SHARD, mesh=None):
 class ShardingParallel(Layer):
     """Dygraph-API sharding wrapper (reference:
     fleet/meta_parallel/sharding_parallel.py:23). Wrapping a model under an
-    active mesh applies the stage-3 parameter layout; stages 1/2 only touch
-    optimizer state (see fleet.distributed_optimizer)."""
+    active mesh applies the stage-3 parameter layout; stages 1/2 shard
+    optimizer state (see fleet.distributed_optimizer /
+    ``Optimizer._zero_enable``) and this wrapper supplies the data-plane
+    glue: the batch PartitionSpec over the sharding axis, the
+    ``dp_axis`` to hand ``to_static(scan_steps=k, dp_axis=...)`` so the
+    step compiles as the shard_map program whose gradient reduction is
+    the bucketed psum_scatter, and the eager fused-allreduce fallback."""
 
     def __init__(self, layers, hcg, strategy=None):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
         stage = 1
+        comm_mb = 25.0
         if strategy is not None and getattr(strategy, "sharding_configs", None):
-            stage = int(strategy.sharding_configs.get("stage", 1))
+            cfg = strategy.sharding_configs
+            stage = int(cfg.get("stage", 1))
+            comm_mb = float(cfg.get("comm_buffer_size_MB",
+                                    cfg.get("segment_broadcast_MB", 25.0)))
         self._stage = stage
+        self._comm_buffer_mb = comm_mb
+        degree = (hcg.get_sharding_parallel_world_size()
+                  if hcg is not None else 1)
+        self._axis = (topo_mod.AXIS_SHARD if degree > 1 else
+                      topo_mod.AXIS_DATA)
         if stage >= 3:
             shard_parameters(layers, mesh=hcg.mesh if hcg else None)
+        elif hcg is not None and hcg.mesh is not None:
+            for p in layers.parameters():
+                if p.pspec is None:
+                    p.pspec = PartitionSpec()  # ZeRO-1/2: replicated params
+
+    @property
+    def dp_axis(self):
+        """Mesh axis for ``to_static(..., dp_axis=model.dp_axis)``."""
+        return self._axis
+
+    @property
+    def batch_pspec(self):
+        return PartitionSpec(self._axis)
+
+    def scale_loss(self, loss):
+        return loss  # grads average inside the reduction, like DataParallel
+
+    def apply_collective_grads(self):
+        """Eager fallback: fused bucketed allreduce, sharing the
+        DataParallel reducer (the compiled path replaces this with the
+        in-trace psum_scatter)."""
+        from ...parallel import fused_allreduce_grads
+        return fused_allreduce_grads(self._layers.parameters(),
+                                     self._comm_buffer_mb)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

@@ -1,17 +1,70 @@
 """DataParallel (reference: `python/paddle/fluid/dygraph/parallel.py:382` +
 C++ `imperative/reducer.cc` bucketed allreduce).
 
-TPU re-design: no gradient reducer exists — the wrapped model's training step,
-compiled with @to_static over the active mesh, shards the batch on the 'dp'
-axis and XLA emits the gradient all-reduce (fused, overlapped with backward
-by the compiler — the analog of reducer.cc's bucketing/overlap). The wrapper
-keeps the reference API surface: it marks batch inputs with a dp sharding
-spec and replicates parameters.
+TPU re-design: no gradient reducer exists on the compiled path — the
+wrapped model's training step, compiled with @to_static over the active
+mesh, shards the batch on the 'dp' axis and the gradient reduction is
+either GSPMD-inserted or (under ``to_static(dp_axis=...)``) issued
+explicitly by the optimizer. The wrapper keeps the reference API surface:
+it marks batch inputs with a dp sharding spec and replicates parameters.
+
+``comm_buffer_size`` drives the EAGER path the same way reducer.cc's
+groups drive the reference: ``apply_collective_grads()`` fuses gradients
+into comm_buffer_size-MB flat buckets, one all_reduce per bucket
+(cross-process when launched multi-process; the degenerate identity in a
+single-controller world), and splits the reduced flat back into the
+per-param grads. The same bucket assignment seeds the compiled ZeRO
+step's psum_scatter layout (see distributed.bucketing).
 """
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec
 
+from .. import monitor
+from ..core.selected_rows import SelectedRows
+from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
-from . import parallel_env
+from . import bucketing, collective, parallel_env
+
+
+def fused_allreduce_grads(params, comm_buffer_mb=25.0,
+                          last_comm_buffer_mb=1.0, group=None):
+    """Eager fused gradient allreduce: comm_buffer_mb-MB flat f32 buckets,
+    one c_allreduce per bucket, grads averaged over the group world and
+    split back in place. Returns the bucket count (counters:
+    ``dp_fused_buckets`` / ``dp_fused_bytes``)."""
+    params = [p for p in params
+              if not p.stop_gradient and p._grad is not None
+              and not isinstance(p._grad, SelectedRows)]
+    if not params:
+        return 0
+    buckets = bucketing.bucket_params(params, comm_buffer_mb,
+                                      last_comm_buffer_mb)
+    for bucket in buckets:
+        flats = []
+        for p in bucket:
+            g = p._grad
+            if g.dtype != jnp.float32:
+                g = g.astype(jnp.float32)
+            flats.append(jnp.ravel(g))
+        fused = Tensor(flats[0] if len(flats) == 1
+                       else jnp.concatenate(flats))
+        # AVG so the divisor always matches the world that actually
+        # summed — the mesh-axis degree inside a named trace, the
+        # process count eagerly (a hand-rolled /nranks gets the traced
+        # case wrong: psum over dp with a process-count divisor of 1)
+        collective.all_reduce(fused, op=collective.ReduceOp.AVG,
+                              group=group)
+        off = 0
+        for p in bucket:
+            size = int(np.prod(p._value.shape)) if p._value.shape else 1
+            seg = fused._value[off:off + size].reshape(p._value.shape)
+            p._grad = seg.astype(p._grad.dtype) \
+                if p._grad.dtype != jnp.float32 else seg
+            off += size
+        monitor.stat_add("dp_fused_bytes", fused._value.nbytes)
+    monitor.stat_add("dp_fused_buckets", len(buckets))
+    return len(buckets)
 
 
 class DataParallel(Layer):
@@ -21,6 +74,9 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self._dp_axis = "dp"
+        self._comm_buffer_mb = float(comm_buffer_size)
+        self._last_comm_buffer_mb = float(last_comm_buffer_size)
+        self._group = group
         mesh = parallel_env.current_mesh()
         if mesh is not None and self._dp_axis in mesh.axis_names:
             for p in layers.parameters():
@@ -34,12 +90,20 @@ class DataParallel(Layer):
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
-    # reference API compat: no-op on TPU (XLA fuses the grad allreduce)
     def scale_loss(self, loss):
+        # reference semantics: grads average over the data-parallel world;
+        # the averaging happens in apply_collective_grads (sum of
+        # grad/nranks), so the loss itself passes through
         return loss
 
     def apply_collective_grads(self):
-        pass
+        """Eager-path fused gradient allreduce: comm_buffer_size-MB flat
+        buckets, one c_allreduce per bucket, split back (reference:
+        reducer.cc groups). Sparse (SelectedRows) grads are skipped —
+        they cannot ride a flat buffer."""
+        return fused_allreduce_grads(
+            self._layers.parameters(), self._comm_buffer_mb,
+            self._last_comm_buffer_mb, group=self._group)
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

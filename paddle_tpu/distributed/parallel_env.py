@@ -15,6 +15,14 @@ from jax.sharding import Mesh
 
 _mesh = None
 
+# the manual data-parallel axis bound by a to_static(dp_axis=...) trace.
+# While a dp-sharded step program is being traced (analysis or real), the
+# optimizer/AMP layers consult this to route gradient reduction through
+# explicit per-rank collectives (psum / psum_scatter) instead of relying
+# on GSPMD's implicit insertion. A plain list cell, not a contextvar: the
+# trace is single-threaded and the cell is only set around pure_fn calls.
+_dp_axis = [None]
+
 
 def current_mesh():
     return _mesh
@@ -24,6 +32,49 @@ def set_mesh(mesh):
     global _mesh
     _mesh = mesh
     return mesh
+
+
+def current_dp_axis():
+    """The manual dp axis of the to_static step being traced, or None."""
+    return _dp_axis[0]
+
+
+class dp_axis_ctx:
+    """Bind the manual dp axis for the duration of a step-program trace."""
+
+    def __init__(self, axis):
+        self.axis = axis
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = _dp_axis[0]
+        _dp_axis[0] = self.axis
+        return self
+
+    def __exit__(self, *exc):
+        _dp_axis[0] = self._saved
+        return False
+
+
+def axis_bound(axis):
+    """True when `axis` is a bound named axis here (inside shard_map with
+    the axis manual). False in eager code and in abstract analysis traces
+    — callers use this to pick real collectives vs shape-preserving
+    simulations."""
+    if axis is None:
+        return False
+    try:
+        jax.lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def axis_degree(mesh, axis):
+    """Size of a mesh axis (1 when the mesh or axis is absent)."""
+    if mesh is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
 
 
 def make_mesh(axes, devices=None):

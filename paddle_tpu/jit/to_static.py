@@ -99,6 +99,80 @@ def _leaf_key(x):
         return ("static", repr(x))
 
 
+def _shard_map():
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def jnp_issubdtype(dtype):
+    """Inexact leaves are pmean-able; ints (indices, counters) must be
+    rank-invariant already and pass through untouched."""
+    return np.issubdtype(np.dtype(dtype), np.inexact)
+
+
+def _abstract_arg(v):
+    """ShapeDtypeStruct twin of a call argument (sharding kept for jax
+    Arrays) — lets the AOT ``lower().compile()`` stats path re-derive the
+    exact program without pinning live HBM buffers in the entry."""
+    if isinstance(v, jax.Array):
+        try:
+            multi = len(v.sharding.device_set) > 1
+        except Exception:
+            multi = False
+        if multi:
+            # mesh-resident state keeps its layout; single-device args
+            # (host-fed batches) stay unconstrained — mixing their
+            # default placement with the mesh's would fail AOT lowering
+            return jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                        sharding=v.sharding)
+        return jax.ShapeDtypeStruct(v.shape, v.dtype)
+    arr = np.asarray(v)
+    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+
+def _is_sharded_spec(spec):
+    return spec is not None and any(s is not None for s in spec)
+
+
+def _axis_sizes(mesh):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def _local_shape(shape, spec, mesh):
+    """Per-rank block shape of a global array under a PartitionSpec."""
+    if spec is None:
+        return tuple(shape)
+    sizes = _axis_sizes(mesh)
+    shape = list(shape)
+    for d, s in enumerate(spec):
+        if s is None:
+            continue
+        for name in (s if isinstance(s, tuple) else (s,)):
+            f = sizes.get(name, 1)
+            if shape[d] % f:
+                raise ValueError(
+                    f"dim {d} of shape {tuple(shape)} is not divisible by "
+                    f"mesh axis {name!r} (size {f})")
+            shape[d] //= f
+    return tuple(shape)
+
+
+def _global_shape(shape, spec, mesh):
+    """Inverse of _local_shape: scale a per-rank block back up."""
+    if spec is None:
+        return tuple(shape)
+    sizes = _axis_sizes(mesh)
+    shape = list(shape)
+    for d, s in enumerate(spec):
+        if s is None:
+            continue
+        for name in (s if isinstance(s, tuple) else (s,)):
+            shape[d] *= sizes.get(name, 1)
+    return tuple(shape)
+
+
 def _analysis_trace(pure_fn, state_vals, dyn_template, grad_vals, n, info):
     """Abstractly trace ``pure_fn(state, dyn, grads)`` and decide which
     state/grad inputs the program actually reads. Fills ``info`` (via the
@@ -172,7 +246,7 @@ class StaticFunction:
     """
 
     def __init__(self, fn, input_spec=None, donate_state=True,
-                 scan_steps=None):
+                 scan_steps=None, dp_axis=None):
         self._fn = fn
         self._cache = {}
         self._donate = donate_state
@@ -180,6 +254,12 @@ class StaticFunction:
         if scan_steps is not None and int(scan_steps) < 1:
             raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
         self._scan_steps = int(scan_steps) if scan_steps is not None else None
+        if dp_axis is not None and self._scan_steps is None:
+            raise ValueError(
+                "dp_axis is an option of the scan step program; pass "
+                "scan_steps=k (k=1 compiles a single-step scan)")
+        self._dp_axis = dp_axis
+        self._last_aux = None
         functools.update_wrapper(self, fn)
 
     # -- sharding helpers -------------------------------------------------
@@ -276,10 +356,64 @@ class StaticFunction:
             self._cache[key] = entry
         else:
             _obs.count("jit_cache_hit", cat="jit")
-        compiled, out_wrap = entry
+        compiled, out_wrap, aux = entry
+        self._last_aux = aux
 
         out_flat = compiled(dyn_vals)
         return out_wrap(out_flat)
+
+    def _make_aux(self, get_jitted, **meta):
+        """Per-entry introspection handle: captures abstract twins of the
+        first call's arguments, from which the optimized (post-SPMD) HLO
+        can be re-derived on demand — the source of truth for in-trace
+        collective byte accounting. The lazy ``lower().compile()`` is a
+        second backend compile, paid only when stats are requested."""
+        aux = dict(meta)
+
+        def capture(args):
+            if "example_args" not in aux:
+                aux["example_args"] = jax.tree_util.tree_map(
+                    _abstract_arg, args)
+
+        def hlo_text():
+            if "hlo" not in aux:
+                ex = aux.get("example_args")
+                if ex is None:
+                    raise RuntimeError(
+                        "program has not executed yet; run the step once "
+                        "before asking for its compiled HLO")
+                aux["hlo"] = get_jitted().lower(*ex).compile().as_text()
+            return aux["hlo"]
+
+        aux["capture"] = capture
+        aux["hlo_text"] = hlo_text
+        return aux
+
+    def hlo_text(self):
+        """Optimized (post-SPMD-partitioning) HLO of the most recent
+        entry — the program XLA actually runs, GSPMD/shard_map collectives
+        included."""
+        if self._last_aux is None:
+            raise RuntimeError("no compiled entry yet; call the step once")
+        return self._last_aux["hlo_text"]()
+
+    def collective_stats(self):
+        """In-trace collective accounting of the most recent entry: one
+        record per (op, axis) with call count and payload bytes, parsed
+        from the compiled HLO (closing the 'in-trace collectives are
+        invisible to python timers' gap — see observability.hlo_bytes)."""
+        from ..observability import hlo_bytes
+        return hlo_bytes.collective_stats(self.hlo_text(),
+                                          mesh=self._mesh())
+
+    def export_collective_bytes(self):
+        """Export collective_stats() into the shared monitor registry as
+        ``collective_bytes{op=...,axis=...}`` / ``collective_count{...}``
+        counters; returns the stats."""
+        from ..observability import hlo_bytes
+        stats = self.collective_stats()
+        hlo_bytes.export_collective_bytes(stats)
+        return stats
 
     def _place_args(self, dyn_vals, mesh):
         """Respect explicit input shardings; default: leave placement to jax
@@ -299,20 +433,36 @@ class StaticFunction:
                       out_template, info):
         """The functionalized user step: ``(state, dyn, grads) -> (outs,
         new_state, new_grads)``. Fills ``out_template``/``info`` as a side
-        effect of tracing (both build modes share it)."""
+        effect of tracing (both build modes share it).
+
+        Under ``dp_axis`` the body runs per-rank inside shard_map: the dp
+        axis is published (``parallel_env.current_dp_axis``) so the
+        optimizer/AMP layers route gradient reduction through explicit
+        collectives, and the user outputs — per-rank partial losses over
+        the local microbatch — are pmean'd back to the global value the
+        replicated program would have returned."""
         fn = self._fn
+        dp_axis = self._dp_axis
 
         def pure_fn(state_vals, dyn_vals, grad_vals):
+            from ..distributed import parallel_env
             leaves = list(template_leaves)
             for i, v in zip(dyn_idx, dyn_vals):
                 leaves[i] = Tensor(v)
             args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
-            with _StateSwap(state_items, state_vals, grad_vals) as swap:
+            with _StateSwap(state_items, state_vals, grad_vals) as swap, \
+                    parallel_env.dp_axis_ctx(dp_axis):
                 out = fn(*args, **kwargs)
                 out_leaves, out_treedef = jax.tree_util.tree_flatten(
                     out, is_leaf=lambda x: isinstance(x, Tensor))
                 out_vals = [l._value if isinstance(l, Tensor) else l
                             for l in out_leaves]
+                if dp_axis is not None and parallel_env.axis_bound(dp_axis):
+                    out_vals = [
+                        jax.lax.pmean(v, dp_axis)
+                        if (hasattr(v, "dtype")
+                            and jnp_issubdtype(v.dtype)) else v
+                        for v in out_vals]
                 out_template["treedef"] = out_treedef
                 new_state, new_grads = swap.capture()
             info["w_val"] = [nv is not ov
@@ -418,6 +568,9 @@ class StaticFunction:
             "skipped": [uids[i] for i in skip_val_idx],
             "donated_grads": [uids[i] for i in don_grad_idx],
             "readonly_grads": [uids[i] for i in ro_grad_idx],
+            "sharded": [uids[i] for i in range(n)
+                        if _is_sharded_spec(state_items[i][1].pspec)],
+            "dp_axis": None,
         }
 
         # direct Tensor references per partition: the per-call hot path
@@ -428,13 +581,16 @@ class StaticFunction:
         rog_ts = [state_items[i][1] for i in ro_grad_idx]
         outg_ts = [state_items[i][1] for i in out_grad_idx]
 
+        aux = self._make_aux(lambda: jitted, kind="unrolled")
+
         def compiled(dyn_vals):
-            out_flat, new_w, new_g = jitted(
-                [t._value for t in don_ts],
-                [t._grad for t in dong_ts],
-                dyn_vals,
-                [t._value for t in ro_ts],
-                [t._grad for t in rog_ts])
+            args = ([t._value for t in don_ts],
+                    [t._grad for t in dong_ts],
+                    dyn_vals,
+                    [t._value for t in ro_ts],
+                    [t._grad for t in rog_ts])
+            aux["capture"](args)
+            out_flat, new_w, new_g = jitted(*args)
             for t, v in zip(don_ts, new_w):
                 t._value = v
             for t, g in zip(outg_ts, new_g):
@@ -446,7 +602,7 @@ class StaticFunction:
                        for v in out_flat]
             return jax.tree_util.tree_unflatten(out_template["treedef"], wrapped)
 
-        return compiled, out_wrap
+        return compiled, out_wrap, aux
 
     def _build_scan(self, treedef, template_leaves, dyn_idx, state_items):
         """Scan-compiled step program: trace the single-step body once and
@@ -471,36 +627,118 @@ class StaticFunction:
         "no grad yet"), and a grad the body CLEARS (opt.clear_grad) flows
         to the next step as zeros and is written back as ``None`` after
         the scan, matching the unrolled program observably.
+
+        ``dp_axis``: the whole scan runs inside ``shard_map`` with that
+        mesh axis manual — the body sees per-rank microbatch shards and
+        per-rank shards of any PartitionSpec-sharded carry state (the
+        ZeRO optimizer stores), gradient reduction happens through the
+        explicit collectives the optimizer issues (per-param psum for the
+        replicated control, bucketed psum_scatter + all_gather under
+        ZeRO), and the grad-presence fixpoint runs over LOCAL (per-shard)
+        shapes so the analysis trace matches the shard_map body exactly.
         """
         import jax.numpy as jnp
+        from jax.sharding import PartitionSpec
 
         k = self._scan_steps
+        dp_axis = self._dp_axis
+        mesh = self._mesh()
+        if dp_axis is not None:
+            if mesh is None:
+                raise RuntimeError(
+                    f"dp_axis={dp_axis!r} needs an active device mesh "
+                    "(fleet.init or parallel_env.set_mesh)")
+            sizes = _axis_sizes(mesh)
+            if dp_axis not in sizes:
+                raise ValueError(
+                    f"mesh axes {list(sizes)} have no {dp_axis!r}")
+            for name, size in sizes.items():
+                if name != dp_axis and size != 1:
+                    raise NotImplementedError(
+                        f"the dp-sharded scan step binds every mesh axis "
+                        f"manually; axis {name!r} has size {size} — build "
+                        "the step mesh with only the dp axis > 1")
+            dp = sizes[dp_axis]
         out_template = {}
         info = {}
         pure_fn = self._make_pure_fn(treedef, template_leaves, dyn_idx,
                                      state_items, out_template, info)
         n = len(state_items)
         state_vals = [t._value for _, t in state_items]
+        state_specs = [t.pspec for _, t in state_items]
 
         # single-step abstract templates from the [k, ...]-stacked args
         dyn_stacked = [template_leaves[i]._value
                        if isinstance(template_leaves[i], Tensor)
                        else template_leaves[i] for i in dyn_idx]
+        xs_specs = None
+        if dp_axis is not None:
+            user_specs = getattr(self, "_arg_pspecs", None)
+            # default microbatch sharding is only safe when EVERY stacked
+            # arg agrees on the dim-1 size (features + labels of one
+            # batch); a lone divisible aux input must not get silently
+            # split 1/dp — that computes on a fraction of its values
+            dim1 = {tuple(np.shape(v))[1] for v in dyn_stacked
+                    if len(np.shape(v)) >= 2}
+            auto_ok = len(dim1) == 1 and next(iter(dim1)) % dp == 0
+            if not auto_ok and user_specs is None and dp > 1:
+                import warnings
+                warnings.warn(
+                    f"dp_axis={dp_axis!r}: stacked inputs disagree on a "
+                    f"microbatch dim (dim-1 sizes {sorted(dim1)}); all "
+                    "inputs stay REPLICATED per rank — set "
+                    "`sfn._arg_pspecs` to shard the batch explicitly")
+            xs_specs = []
+            for j, v in enumerate(dyn_stacked):
+                shape = tuple(np.shape(v))
+                if user_specs is not None and j < len(user_specs) \
+                        and user_specs[j] is not None:
+                    spec = user_specs[j]
+                elif auto_ok and len(shape) >= 2:
+                    # microbatch dim of the [k, batch, ...] stack
+                    spec = PartitionSpec(None, dp_axis)
+                else:
+                    spec = PartitionSpec()
+                if len(spec) > 0 and spec[0] is not None:
+                    raise ValueError(
+                        f"xs arg {j}: the leading [k] scan dim cannot be "
+                        f"sharded (spec {spec})")
+                xs_specs.append(spec)
         step_tmpl = []
-        for v in dyn_stacked:
+        for j, v in enumerate(dyn_stacked):
             shape = tuple(np.shape(v))
             if not shape or shape[0] != k:
                 raise ValueError(
                     f"scan_steps={k}: every dynamic input must be stacked "
                     f"[k, ...]; got shape {shape}")
+            if dp_axis is not None:
+                shape = _local_shape(shape, xs_specs[j], mesh)
             step_tmpl.append(jax.ShapeDtypeStruct(shape[1:],
                                                   np.dtype(v.dtype)))
 
-        # grad-presence fixpoint (presence only grows, so it terminates)
+        # analysis templates: sharded state enters the shard_map body as
+        # its per-rank block, so the fixpoint must trace local shapes
+        if dp_axis is not None:
+            a_state = [jax.ShapeDtypeStruct(
+                           _local_shape(np.shape(v), spec, mesh),
+                           np.dtype(v.dtype))
+                       if _is_sharded_spec(spec) else v
+                       for v, spec in zip(state_vals, state_specs)]
+        else:
+            a_state = state_vals
+
+        # grad-presence fixpoint (presence only grows, so it terminates);
+        # grads follow their tensor's layout (localize like the values)
         grad_tmpl = [t._grad for _, t in state_items]
+        if dp_axis is not None:
+            grad_tmpl = [jax.ShapeDtypeStruct(
+                             _local_shape(np.shape(g), spec, mesh),
+                             np.dtype(g.dtype))
+                         if g is not None and _is_sharded_spec(spec) else g
+                         for g, spec in zip(grad_tmpl, state_specs)]
         for _ in range(n + 1):
             closed, val_used, grad_used = _analysis_trace(
-                pure_fn, state_vals, step_tmpl, grad_tmpl, n, info)
+                pure_fn, a_state, step_tmpl, grad_tmpl, n, info)
             out_avals = list(closed.out_avals)
             pos = info["n_out"] + n
             created = []
@@ -529,10 +767,16 @@ class StaticFunction:
                          if i not in carry_grad_idx and i not in ro_grad_idx]
         # zeros template per carried grad: the scan-carry aval (used both
         # for the initial carry when the live grad is None and for the
-        # cleared-inside-the-step substitution)
+        # cleared-inside-the-step substitution). Under dp_axis the body
+        # shape is the per-rank block; the init zeros built OUTSIDE the
+        # shard_map need the global shape.
         carry_g_sds = {i: (tuple(grad_tmpl[i].shape),
                            np.dtype(grad_tmpl[i].dtype))
                        for i in carry_grad_idx}
+        carry_g_init = {
+            i: ((_global_shape(shape, state_specs[i], mesh)
+                 if dp_axis is not None else shape), dt)
+            for i, (shape, dt) in carry_g_sds.items()}
 
         def pure_fn2(carry_vals, carry_grads, xs_stacked, ro_vals, ro_grads):
             def body(carry, xs):
@@ -568,7 +812,25 @@ class StaticFunction:
             return list(ys), f_vals, f_grads
 
         donate = (0, 1) if self._donate else ()
-        jitted = jax.jit(pure_fn2, donate_argnums=donate)
+        if dp_axis is not None:
+            def _spec(i):
+                return (state_specs[i] if state_specs[i] is not None
+                        else PartitionSpec())
+            cv_specs = [_spec(i) for i in carry_val_idx]
+            cg_specs = [_spec(i) for i in carry_grad_idx]
+            ro_specs = [_spec(i) for i in ro_val_idx]
+            rog_specs = [_spec(i) for i in ro_grad_idx]
+            # ys are pmean'd replicated in the body; final carry values
+            # reassemble per their PartitionSpec
+            smapped = _shard_map()(
+                pure_fn2, mesh=mesh,
+                in_specs=(cv_specs, cg_specs, list(xs_specs), ro_specs,
+                          rog_specs),
+                out_specs=(PartitionSpec(), cv_specs, cg_specs),
+                check_rep=False)
+            jitted = jax.jit(smapped, donate_argnums=donate)
+        else:
+            jitted = jax.jit(pure_fn2, donate_argnums=donate)
 
         uids = [uid for uid, _ in state_items]
         self._last_partition = {
@@ -577,6 +839,9 @@ class StaticFunction:
             "skipped": [uids[i] for i in skip_val_idx],
             "donated_grads": [uids[i] for i in carry_grad_idx],
             "readonly_grads": [uids[i] for i in ro_grad_idx],
+            "sharded": [uids[i] for i in range(n)
+                        if _is_sharded_spec(state_specs[i])],
+            "dp_axis": dp_axis,
             "scan_steps": k,
         }
 
@@ -585,17 +850,21 @@ class StaticFunction:
         cg_ts = [state_items[i][1] for i in carry_grad_idx]
         rog_ts = [state_items[i][1] for i in ro_grad_idx]
 
+        aux = self._make_aux(lambda: jitted, kind="scan", scan_steps=k,
+                             dp_axis=dp_axis)
+
         def compiled(dyn_vals):
             init_grads = []
             for i, t in zip(carry_grad_idx, cg_ts):
                 g = t._grad
                 if g is None:
-                    shape, dt = carry_g_sds[i]
+                    shape, dt = carry_g_init[i]
                     g = jnp.zeros(shape, dt)
                 init_grads.append(g)
-            ys, f_vals, f_grads = jitted(
-                [t._value for t in carry_ts], init_grads, dyn_vals,
-                [t._value for t in ro_ts], [t._grad for t in rog_ts])
+            args = ([t._value for t in carry_ts], init_grads, dyn_vals,
+                    [t._value for t in ro_ts], [t._grad for t in rog_ts])
+            aux["capture"](args)
+            ys, f_vals, f_grads = jitted(*args)
             for t, v in zip(carry_ts, f_vals):
                 t._value = v
             for i, t, g in zip(carry_grad_idx, cg_ts, f_grads):
@@ -608,7 +877,7 @@ class StaticFunction:
             return jax.tree_util.tree_unflatten(out_template["treedef"],
                                                 wrapped)
 
-        return compiled, out_wrap
+        return compiled, out_wrap, aux
 
     def _try_ast_fallback(self, cause):
         """Swap self._fn for its dy2static-transformed version once."""
@@ -655,17 +924,26 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              scan_steps=None, **kwargs):
+              scan_steps=None, dp_axis=None, **kwargs):
     """Decorator / wrapper, usable as @to_static or to_static(fn).
 
     ``scan_steps=k`` compiles ``function`` (the single-step body) as a
     ``jax.lax.scan`` over k inner steps: dynamic args must arrive
     ``[k, ...]``-stacked (one microbatch per inner step) and per-step
     outputs return ``[k, ...]``-stacked. Compile time is ~independent of
-    k, vs linear in k for a python-unrolled loop over the body."""
+    k, vs linear in k for a python-unrolled loop over the body.
+
+    ``dp_axis='dp'`` runs the scan inside ``shard_map`` with that mesh
+    axis manual: the microbatch is split 1/dp per rank, gradient
+    reduction goes through the explicit collectives the optimizer
+    issues — per-param psum for a replicated optimizer, bucketed
+    ``psum_scatter`` + param ``all_gather`` after
+    ``optimizer._zero_enable()`` (ZeRO-1/2) — and PartitionSpec-sharded
+    optimizer state rides the donated carry as per-rank shards. User
+    outputs (losses/metrics) are pmean'd over the axis."""
     if function is None:
         return lambda fn: to_static(fn, input_spec=input_spec,
-                                    scan_steps=scan_steps)
+                                    scan_steps=scan_steps, dp_axis=dp_axis)
     if isinstance(function, StaticFunction):
         return function
     # Layers: wrap forward, keep the layer object semantics
@@ -673,10 +951,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     if isinstance(function, Layer):
         layer = function
         static_forward = StaticFunction(layer.forward, input_spec,
-                                        scan_steps=scan_steps)
+                                        scan_steps=scan_steps,
+                                        dp_axis=dp_axis)
         layer.forward = static_forward
         return layer
-    return StaticFunction(function, input_spec, scan_steps=scan_steps)
+    return StaticFunction(function, input_spec, scan_steps=scan_steps,
+                          dp_axis=dp_axis)
 
 
 class InputSpec:

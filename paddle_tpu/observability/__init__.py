@@ -24,8 +24,9 @@ tokens/s / MFU / data-wait gauges into it. The perf gate:
 ``python tools/perf_gate.py --baseline BASELINE.json``.
 """
 from .. import profiler as _profiler
-from . import export, gate, step, tracing  # noqa: F401
+from . import export, gate, hlo_bytes, step, tracing  # noqa: F401
 from .gate import compare, load_results  # noqa: F401
+from .hlo_bytes import collective_stats, export_collective_bytes  # noqa: F401
 from .step import StepTimer  # noqa: F401
 from .tracing import (CATEGORIES, count, current_span, disable,  # noqa: F401
                       enable, enabled, trace_span)
@@ -33,7 +34,8 @@ from .tracing import (CATEGORIES, count, current_span, disable,  # noqa: F401
 __all__ = [
     "enable", "disable", "enabled", "trace_span", "current_span", "count",
     "CATEGORIES", "StepTimer", "export_chrome_trace",
-    "tracing", "export", "gate", "step",
+    "collective_stats", "export_collective_bytes",
+    "tracing", "export", "gate", "hlo_bytes", "step",
 ]
 
 

@@ -107,11 +107,14 @@ def prometheus_text(prefix=PROM_PREFIX):
     """Render counters + gauges + collector pulls in the Prometheus text
     exposition format."""
     lines = []
+    typed = set()
     for name, value in sorted(monitor.stats().items()):
         mname = f"{prefix}_{_prom_name(name)}"
-        lines.append(f"# TYPE {mname} counter")
+        base = mname.split("{", 1)[0]
+        if base not in typed:  # one TYPE line per family, labels aside
+            typed.add(base)
+            lines.append(f"# TYPE {base} counter")
         lines.append(f"{mname} {value}")
-    typed = set()
     for name, value in sorted(collected().items()):
         mname = f"{prefix}_{_prom_name(name)}"
         base = mname.split("{", 1)[0]

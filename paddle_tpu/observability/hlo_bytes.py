@@ -1,0 +1,126 @@
+"""In-trace collective byte accounting from compiled (post-SPMD) HLO.
+
+The eager per-collective counters in ``distributed.collective`` only see
+python-dispatched calls; collectives that GSPMD or shard_map insert INTO
+a compiled step are invisible to python timers (the long-standing ROADMAP
+gap). The compiled executable's HLO text is the ground truth: every
+``all-reduce`` / ``reduce-scatter`` / ``all-gather`` / ``all-to-all`` /
+``collective-permute`` appears with its operand/result shapes and replica
+groups. This module parses that text into per-(op, axis) payload counters
+so the ZeRO A/B ("psum_scatter + all_gather replacing full psum") is a
+number, not a narrative.
+
+Payload convention: ``bytes = max(operand bytes, result bytes)`` per op —
+the full-tensor side of the transfer (all-gather's result, reduce-scatter
+and all-reduce's operand), which is what the ring actually moves up to the
+(n-1)/n factor. Counts are static occurrences in the program text: an op
+inside a scan/while body is counted once, not trip-count times.
+
+Axis attribution: HLO carries replica groups, not mesh axis names; a
+group size that matches exactly one axis of the active mesh gets that
+axis's name, anything ambiguous is labeled ``size<N>``.
+"""
+import re
+
+from .. import monitor
+
+__all__ = ["collective_stats", "export_collective_bytes", "COLLECTIVE_HLO_OPS"]
+
+COLLECTIVE_HLO_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+                      "all-to-all", "collective-permute")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"\b([a-z]+[0-9]+|pred)\[([0-9,]*)\]")
+_OP_RE = re.compile(
+    r"=\s+(.*?)\s+(" + "|".join(COLLECTIVE_HLO_OPS) + r")(-start)?\(")
+_GROUPS_RE = re.compile(r"replica_groups=(\{\{[^}]*\}[^}]*\}|\[[0-9,]+\]"
+                        r"<=\[[0-9,]+\])")
+
+
+def _shape_bytes(text, largest=False):
+    """Payload bytes over the `dtype[dims]` shapes in `text`: the sum
+    (tuple shapes contribute each element — fused multi-tensor
+    collectives), or with ``largest`` the single biggest shape (async
+    ``-start`` result tuples repeat the operand buffer next to the
+    result; summing would double-count)."""
+    total, best = 0, 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue  # token types etc. carry no payload
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * size
+        best = max(best, n * size)
+    return best if largest else total
+
+
+def _group_size(line):
+    """Participant count per replica group on this op's line."""
+    m = _GROUPS_RE.search(line)
+    if not m:
+        return None
+    text = m.group(1)
+    if text.startswith("{"):
+        first = text[2:].split("}", 1)[0]
+        return len([x for x in first.split(",") if x.strip() != ""])
+    # iota form [g0,g1,...]<=[n]: first dim is the group count, the rest
+    # multiply out to the group size
+    dims = [int(x) for x in text[1:].split("]", 1)[0].split(",")]
+    size = 1
+    for d in dims[1:]:
+        size *= d
+    return size
+
+
+def _axis_name(group_size, mesh):
+    if group_size is None or mesh is None:
+        return "unknown" if group_size is None else f"size{group_size}"
+    matches = [name for name, size in
+               zip(mesh.axis_names, mesh.devices.shape)
+               if size == group_size]
+    if len(matches) == 1:
+        return matches[0]
+    return f"size{group_size}"
+
+
+def collective_stats(hlo_text, mesh=None):
+    """Parse compiled HLO into ``{(op, axis): {"count", "bytes"}}``-shaped
+    records: a list of dicts with keys ``op``, ``axis``, ``count``,
+    ``bytes`` sorted by descending bytes."""
+    acc = {}
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        result_text, op, is_start = m.group(1), m.group(2), bool(m.group(3))
+        operand_text = line[m.end():]
+        nbytes = max(_shape_bytes(result_text, largest=is_start),
+                     _shape_bytes(operand_text, largest=is_start))
+        axis = _axis_name(_group_size(line), mesh)
+        key = (op, axis)
+        slot = acc.setdefault(key, {"op": op, "axis": axis, "count": 0,
+                                    "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += nbytes
+    return sorted(acc.values(), key=lambda s: -s["bytes"])
+
+
+def export_collective_bytes(stats):
+    """Push parsed stats into the shared monitor registry as
+    ``collective_bytes{op=...,axis=...}`` / ``collective_count{...}``
+    counters (labels render through the Prometheus exporter like the PS
+    per-table series). Counters accumulate across exports — export once
+    per compiled program, not per step."""
+    for s in stats:
+        labels = 'op="%s",axis="%s"' % (s["op"], s["axis"])
+        monitor.stat_add("collective_bytes{%s}" % labels, s["bytes"])
+        monitor.stat_add("collective_count{%s}" % labels, s["count"])
+    return stats

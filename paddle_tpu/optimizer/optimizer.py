@@ -83,14 +83,18 @@ class _FlatSlot:
 
 
 class _FlatStore:
-    """One [rows, 1024] f32 buffer per accumulator slot name."""
+    """One [rows, 1024] f32 buffer per accumulator slot name. ``pad_rows``
+    appends zero rows so the row count divides the ZeRO shard degree (each
+    rank then owns a contiguous, equally-sized row range)."""
 
-    def __init__(self, fills):
+    def __init__(self, fills, pad_rows=0):
         assert fills, "a flat store always covers at least one param"
         rows = []
         for n_rows, size, fill in fills:
             seg = jnp.full((n_rows * _FLAT_LANES,), fill, jnp.float32)
             rows.append(seg.reshape(n_rows, _FLAT_LANES))
+        if pad_rows:
+            rows.append(jnp.zeros((pad_rows, _FLAT_LANES), jnp.float32))
         self.tensor = Tensor(jnp.concatenate(rows))
         self.tensor.persistable = True
         self.tensor._mark_stateful()
@@ -113,7 +117,117 @@ class _FlatStore:
         self.pending = []
 
 
+class _ZeroBucket:
+    """Flat row layout of one gradient-reduction bucket (ZeRO-1/2).
+
+    All of the bucket's per-param tensors (grads, moments, fp32 masters,
+    params during the update) share this [rows, 1024] layout: per-param
+    row-aligned segments, total rows padded to a multiple of the shard
+    degree so ``lax.psum_scatter(..., scatter_dimension=0, tiled=True)``
+    hands each rank a contiguous [rows/degree, 1024] shard that lines up
+    exactly with its shard of the bucket's moment/master stores."""
+
+    __slots__ = ("index", "params", "sizes", "shapes", "n_rows", "row_offs",
+                 "rows", "pad_rows", "degree", "has_master")
+
+    def __init__(self, index, params, degree):
+        self.index = index
+        self.params = list(params)
+        self.degree = max(int(degree), 1)
+        self.sizes, self.shapes, self.n_rows, self.row_offs = [], [], [], []
+        self.has_master = False
+        off = 0
+        for p in self.params:
+            shape = tuple(p._value.shape)
+            size = int(np.prod(shape)) if shape else 1
+            n_rows = -(-size // _FLAT_LANES)
+            self.sizes.append(size)
+            self.shapes.append(shape)
+            self.n_rows.append(n_rows)
+            self.row_offs.append(off)
+            off += n_rows
+        self.pad_rows = (-off) % self.degree
+        self.rows = off + self.pad_rows
+
+    @property
+    def shard_rows(self):
+        return self.rows // self.degree
+
+    def fills(self, fill=0.0):
+        """_FlatStore fill spec covering this bucket's param segments."""
+        return [(n, s, fill) for n, s in zip(self.n_rows, self.sizes)]
+
+    def flatten(self, vals):
+        """Per-param f32 arrays -> the [rows, 1024] bucket layout."""
+        segs = []
+        for v, n_rows, size in zip(vals, self.n_rows, self.sizes):
+            flat = jnp.ravel(v)
+            pad = n_rows * _FLAT_LANES - size
+            if pad:
+                flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+            segs.append(flat.reshape(n_rows, _FLAT_LANES))
+        if self.pad_rows:
+            segs.append(jnp.zeros((self.pad_rows, _FLAT_LANES), jnp.float32))
+        return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+
+    def unflatten(self, rows):
+        """[rows, 1024] bucket layout -> per-param arrays (store dtype)."""
+        return [rows[off:off + n].reshape(-1)[:size].reshape(shape)
+                for off, n, size, shape in zip(self.row_offs, self.n_rows,
+                                               self.sizes, self.shapes)]
+
+    def shard_of(self, rows_full, axis, bound):
+        """This rank's [rows/degree, width] shard of a full row-aligned
+        array (the [rows, 1024] bucket or a [rows, 1] row mask). With the
+        axis bound (inside shard_map) the rank index is dynamic; in the
+        abstract analysis trace rank 0's slice stands in (shape is all
+        that matters there)."""
+        if bound:
+            idx = jax.lax.axis_index(axis)
+            return jax.lax.dynamic_slice(
+                rows_full, (idx * self.shard_rows, 0),
+                (self.shard_rows, rows_full.shape[1]))
+        return jax.lax.slice_in_dim(rows_full, 0, self.shard_rows, axis=0)
+
+    def row_mask(self, flags):
+        """[rows, 1] bool numpy mask, True over the segments of params
+        whose flag is set (padding rows False)."""
+        parts = [np.full((n, 1), bool(f)) for n, f in zip(self.n_rows, flags)]
+        if self.pad_rows:
+            parts.append(np.zeros((self.pad_rows, 1), bool))
+        return np.concatenate(parts)
+
+
+class _ZeroView:
+    """Stands in for a parameter during the flat shard update: carries the
+    flat param shard as ``_value`` and the markers that keep per-param
+    decay out of the (already pre-decayed) flat path."""
+
+    def __init__(self, value, name, decay_mask=None):
+        self._value = value
+        self.name = name
+        self._zero_predecayed = True
+        if decay_mask is not None:
+            self._zero_decay_mask = decay_mask
+
+
+class _Box:
+    """Minimal settable accumulator proxy for ``_apply_one``."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+
 class Optimizer:
+    # ZeRO sharded-step support: None until _zero_enable() partitions the
+    # state. _zero_compatible=False marks optimizers whose update is not
+    # elementwise (norm-trust-ratio / RNG updates can't run on a flat
+    # shard and reassemble to the replicated answer).
+    _zero = None
+    _zero_compatible = True
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, fuse_accumulators=False):
         if parameters is None:
@@ -236,6 +350,11 @@ class Optimizer:
         """Apply L2/L1 'regularizer-style' decay into the gradient (the
         reference's regularizer path; AdamW-style decoupled decay overrides)."""
         from ..regularizer import L1Decay, L2Decay
+        if getattr(p, "_zero_predecayed", False):
+            # flat ZeRO view: decay was already applied per-param on the
+            # full gradient before bucketing (per-param regularizers can't
+            # be expressed on the concatenated shard)
+            return g
         wd = self._weight_decay
         reg = getattr(p, "regularizer", None) or wd
         if isinstance(reg, L2Decay):
@@ -246,7 +365,365 @@ class Optimizer:
             return g + reg * p._value
         return g
 
+    # -- ZeRO-1/2 sharded step --------------------------------------------
+    def _zero_enable(self, axis=None, mesh=None, stage=1,
+                     comm_buffer_mb=None, last_comm_buffer_mb=None):
+        """Partition this optimizer's state for ZeRO-1/2 data parallelism
+        over one mesh axis: moments (and fp32 masters under
+        multi_precision) move into per-bucket flat [rows, 1024] stores
+        sharded 1/degree per rank (PartitionSpec(axis, None)); ``step()``
+        switches to the sharded update — bucketed psum_scatter gradient
+        reduction, shard-local update math, all_gather of refreshed
+        params. Buckets are sized from ``comm_buffer_mb`` (the
+        DataParallel ``comm_buffer_size`` knob) so the reduction of
+        bucket i can overlap the backward compute of bucket i+1.
+
+        stage 1 vs 2 differ only in gradient lifetime: both reduce via
+        psum_scatter, but stage 2 frees (clears) each param's full
+        gradient the moment its bucket shard is consumed, so no full
+        gradient outlives the update. Returns the number of accumulator
+        views sharded."""
+        from jax.sharding import PartitionSpec
+        from ..core import state as state_mod
+        from ..distributed import bucketing, parallel_env
+        if self._zero is not None:
+            same = (axis in (None, self._zero["axis"])
+                    and int(stage) == self._zero["stage"]
+                    and (comm_buffer_mb is None
+                         or float(comm_buffer_mb)
+                         == self._zero["comm_buffer_mb"]))
+            if not same:
+                raise RuntimeError(
+                    f"ZeRO already enabled with axis="
+                    f"{self._zero['axis']!r} stage={self._zero['stage']} "
+                    f"comm_buffer_mb={self._zero['comm_buffer_mb']}; "
+                    f"re-enabling with (axis={axis!r}, stage={stage}, "
+                    f"comm_buffer_mb={comm_buffer_mb}) would silently "
+                    "keep the old layout — build a fresh optimizer")
+            return self._zero["n_sharded"]
+        if not self._zero_compatible:
+            raise NotImplementedError(
+                f"{type(self).__name__} has a non-elementwise update "
+                "(norm/trust-ratio or RNG terms) and cannot run sharded; "
+                "ZeRO supports SGD/Momentum/Adam/AdamW-family optimizers")
+        if self._grad_clip is not None:
+            raise NotImplementedError(
+                "ZeRO sharded step does not compose with grad_clip yet "
+                "(the global norm spans every shard); clip before "
+                "assigning gradients or disable sharding")
+        mesh = mesh if mesh is not None else parallel_env.current_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                "ZeRO needs an active device mesh (fleet.init or "
+                "paddle_tpu.distributed.parallel_env.set_mesh)")
+        axis = axis or "dp"
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh {mesh.axis_names} has no axis {axis!r}")
+        if int(stage) not in (1, 2):
+            raise ValueError(f"ZeRO stage must be 1 or 2, got {stage}")
+        degree = parallel_env.axis_degree(mesh, axis)
+        params = [p for p in self._parameters() if not p.stop_gradient]
+        if not params:
+            raise ValueError("ZeRO sharding needs trainable parameters")
+        lp = (jnp.bfloat16, jnp.float16)
+        for p in params:
+            if p.__dict__.get("optimize_attr", {}).get(
+                    "learning_rate", 1.0) != 1.0:
+                raise NotImplementedError(
+                    f"param {p.name} has a per-param lr scale; the flat "
+                    "sharded update applies one lr per bucket")
+            if p.pspec is not None and any(s is not None for s in p.pspec):
+                raise NotImplementedError(
+                    f"param {p.name} already carries layout {p.pspec}; "
+                    "ZeRO-1/2 shards the optimizer state of REPLICATED "
+                    "parameters (ZeRO-3/mp params are out of scope)")
+        if comm_buffer_mb is None:
+            comm_buffer_mb = bucketing.DEFAULT_COMM_BUFFER_MB
+        pids = {id(p) for p in params}
+        slots = sorted({s for (s, pid) in self._accumulators
+                        if pid in pids and s != "master"})
+
+        def _drop(t):
+            if getattr(t, "_state_uid", None) is not None:
+                state_mod.unregister(t._state_uid)
+
+        buckets, stores = [], []
+        for bi, bparams in enumerate(bucketing.bucket_params(
+                params, comm_buffer_mb, last_comm_buffer_mb,
+                counter_prefix="zero")):
+            zb = _ZeroBucket(bi, bparams, degree)
+            zb.has_master = (bool(getattr(self, "_multi_precision", False))
+                             and any(p._value.dtype in lp for p in bparams))
+            sdict = {}
+            for slot in slots + (["master"] if zb.has_master else []):
+                store = _FlatStore(zb.fills(), pad_rows=zb.pad_rows)
+                store.tensor.pspec = PartitionSpec(axis, None)
+                store.tensor.name = f"zero_{slot}_b{bi}"
+                sdict[slot] = store
+            # migrate existing accumulator/master values into the sharded
+            # views (warm restarts / loaded state survive the re-layout)
+            for p, off, n_rows, size, shape in zip(
+                    zb.params, zb.row_offs, zb.n_rows, zb.sizes, zb.shapes):
+                for slot in slots:
+                    view = _FlatSlot(sdict[slot], off, n_rows, size, shape)
+                    old = self._accumulators.get((slot, id(p)))
+                    if old is not None:
+                        view.set_value(old._value)
+                        if not isinstance(old, _FlatSlot):
+                            _drop(old)
+                    self._accumulators[(slot, id(p))] = view
+                if zb.has_master:
+                    view = _FlatSlot(sdict["master"], off, n_rows, size,
+                                     shape)
+                    old = self._accumulators.pop(("master", id(p)), None)
+                    view.set_value(old._value if old is not None
+                                   else p._value.astype(jnp.float32))
+                    if old is not None and not isinstance(old, _FlatSlot):
+                        _drop(old)
+                    self._accumulators[("master", id(p))] = view
+            from jax.sharding import NamedSharding
+            for store in sdict.values():
+                # resident sharded from day one: the 1/degree HBM saving
+                # is a property of the layout, not of the first step
+                store.flush()
+                store.tensor._value = jax.device_put(
+                    store.tensor._value,
+                    NamedSharding(mesh, store.tensor.pspec))
+            buckets.append(zb)
+            stores.append(sdict)
+        for store in self._flat_stores.values():  # superseded fused stores
+            _drop(store.tensor)
+        self._flat_stores = {}
+        n_sharded = sum(len(sd) for sd in stores)
+        self._zero = {
+            "axis": axis, "mesh": mesh, "stage": int(stage),
+            "degree": degree, "buckets": buckets, "stores": stores,
+            "slots": slots, "n_sharded": n_sharded,
+            "comm_buffer_mb": float(comm_buffer_mb),
+        }
+        return n_sharded
+
+    def _zero_state_bytes(self):
+        """Per-rank bytes of the sharded optimizer-state stores (the HBM
+        the ZeRO layout actually costs one chip): sum of shard sizes."""
+        cfg = self._zero
+        if cfg is None:
+            return sum(
+                int(np.prod(t._value.shape) if t._value.shape else 1)
+                * t._value.dtype.itemsize
+                for t in self._accumulators.values()
+                if not isinstance(t, _FlatSlot)) + sum(
+                int(np.prod(s.tensor._value.shape))
+                * s.tensor._value.dtype.itemsize
+                for s in self._flat_stores.values())
+        return sum(zb.shard_rows * _FLAT_LANES * 4 * len(sdict)
+                   for zb, sdict in zip(cfg["buckets"], cfg["stores"]))
+
+    def _reduce_dp_grads(self, axis):
+        """The replicated (non-ZeRO) control under a manual dp axis: one
+        full-tensor pmean per parameter gradient — exactly the per-param
+        psum the bucketed psum_scatter path replaces."""
+        from ..core.selected_rows import SelectedRows
+        from ..distributed import parallel_env
+        bound = parallel_env.axis_bound(axis)
+        for p in self._parameters():
+            g = p._grad
+            if g is None:
+                continue
+            if isinstance(g, SelectedRows):
+                raise NotImplementedError(
+                    "sparse (SelectedRows) gradients cannot be reduced "
+                    "over a manual dp axis; use the GSPMD path")
+            if g.dtype != jnp.float32:
+                g = g.astype(jnp.float32)
+            if bound:
+                g = jax.lax.pmean(g, axis)
+            p._grad = g
+
+    def _zero_step(self):
+        """The sharded update: per bucket, psum_scatter the flat gradient
+        (each rank keeps the mean-reduced [rows/degree, 1024] shard),
+        run the optimizer's elementwise update on that shard against the
+        sharded moment/master stores, and all_gather the refreshed
+        parameters back to every rank. Elementwise math on a shard equals
+        elementwise math on the whole, so losses and params match the
+        replicated control bit-for-bit."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from .. import monitor
+        from ..core.selected_rows import SelectedRows
+        from ..distributed import parallel_env
+        cfg = self._zero
+        axis, degree, stage = cfg["axis"], cfg["degree"], cfg["stage"]
+        mesh = cfg["mesh"]
+        cur = parallel_env.current_dp_axis()
+        if cur is not None and cur != axis:
+            raise RuntimeError(
+                f"ZeRO state is sharded over {axis!r} but the step program "
+                f"binds dp axis {cur!r}")
+        dp_mode = cur == axis  # manual-axis (shard_map) trace, local shapes
+        bound = dp_mode and parallel_env.axis_bound(axis)
+        scaler_pending = cfg.pop("pending_scaler", False)
+        pending_found = cfg.pop("pending_found", None)
+        for p in self._parameters():
+            if isinstance(p._grad, SelectedRows):
+                raise NotImplementedError(
+                    "ZeRO sharded step does not support sparse "
+                    "(SelectedRows) gradients")
+        prev_step = self._step_count._value
+        self._step_count._value = prev_step + 1
+        lr = self._lr.value()
+        shard_spec = NamedSharding(mesh, PartitionSpec(axis, None))
+        repl_spec = NamedSharding(mesh, PartitionSpec())
+
+        def _constrain(v, spec):
+            # traced: a GSPMD layout hint; eager: an actual device_put so
+            # the stores stay resident in their sharded layout
+            if isinstance(v, jax.core.Tracer):
+                return jax.lax.with_sharding_constraint(v, spec)
+            return jax.device_put(v, spec)
+
+        # pass 1: reduce every bucket (the collectives issue back-to-back
+        # so XLA can overlap bucket i's reduction with bucket i+1's
+        # producers), tracking grad presence and shard finiteness
+        reduced, all_ok = [], None
+        for zb in cfg["buckets"]:
+            vals, present = [], []
+            for p in zb.params:
+                g = p._grad
+                present.append(g is not None)
+                if g is None:
+                    g = jnp.zeros(tuple(p._value.shape), jnp.float32)
+                else:
+                    if g.dtype != jnp.float32:
+                        g = g.astype(jnp.float32)
+                    g = self._decayed_grad(p, g)
+                vals.append(g)
+            gfull = zb.flatten(vals)
+            if bound:
+                gred = jax.lax.psum_scatter(
+                    gfull, axis, scatter_dimension=0, tiled=True) / degree
+            elif dp_mode:
+                # abstract analysis trace: rank-0-shaped stand-in
+                gred = zb.shard_of(gfull, axis, bound=False) / degree
+            else:
+                # GSPMD/eager world: gradients are already globally
+                # reduced; the constraint shards the update compute (and
+                # lets the partitioner fold the grad all-reduce into a
+                # reduce-scatter on backends that support it)
+                gred = _constrain(gfull, shard_spec)
+            if scaler_pending and pending_found is None:
+                ok = jnp.all(jnp.isfinite(gred))
+                all_ok = ok if all_ok is None else (all_ok & ok)
+            reduced.append((gred, present))
+
+        found_inf = None
+        if scaler_pending:
+            found_inf = (pending_found if pending_found is not None
+                         else ~all_ok)
+            if bound:  # a shard-local inf must skip the update everywhere
+                found_inf = jax.lax.psum(
+                    found_inf.astype(jnp.float32), axis) > 0
+            # a skipped step does not exist: bias correction must not
+            # advance past it (reference SkipUpdate leaves beta-pows)
+            self._step_count._value = jnp.where(found_inf, prev_step,
+                                                self._step_count._value)
+
+        # pass 2: shard-local update + param all_gather per bucket
+        n_bytes = 0
+        for zb, sdict, (gred, present) in zip(cfg["buckets"], cfg["stores"],
+                                              reduced):
+            if zb.has_master:
+                psrc = sdict["master"].tensor._value
+                if not dp_mode:
+                    psrc = _constrain(psrc, shard_spec)
+            else:
+                pfull = zb.flatten([p._value.astype(jnp.float32)
+                                    if p._value.dtype != jnp.float32
+                                    else p._value for p in zb.params])
+                psrc = (zb.shard_of(pfull, axis, bound) if dp_mode
+                        else _constrain(pfull, shard_spec))
+            dmask = None
+            if getattr(self, "_decay_fn", None) is not None:
+                dm = zb.row_mask([self._decay_fn(p.name)
+                                  for p in zb.params]).astype(np.float32)
+                dmask = jnp.asarray(dm)
+                if dp_mode:
+                    dmask = zb.shard_of(dmask, axis, bound)
+            view = _ZeroView(psrc, f"zero_b{zb.index}", decay_mask=dmask)
+            boxes = {}
+            for slot in cfg["slots"]:
+                boxes[slot] = _Box(sdict[slot].tensor._value
+                                   if dp_mode else
+                                   _constrain(sdict[slot].tensor._value,
+                                              shard_spec))
+                self._accumulators[(slot, id(view))] = boxes[slot]
+            try:
+                new_p = self._apply_one(view, gred, lr)
+            finally:
+                for slot in cfg["slots"]:
+                    del self._accumulators[(slot, id(view))]
+            if not all(present):
+                # params without a grad this step hold still (the control
+                # skips them entirely); row-granular because segments are
+                # row-aligned
+                keep = jnp.asarray(zb.row_mask(present))
+                if dp_mode:
+                    keep = zb.shard_of(keep, axis, bound)
+                new_p = jnp.where(keep, new_p, psrc)
+                for slot in cfg["slots"]:
+                    boxes[slot]._value = jnp.where(
+                        keep, boxes[slot]._value,
+                        sdict[slot].tensor._value if dp_mode else
+                        _constrain(sdict[slot].tensor._value, shard_spec))
+            if found_inf is not None:
+                # overflow skips the WHOLE update — moments and master
+                # included, or one inf gradient poisons the optimizer
+                # state for every later step (reference adam SkipUpdate)
+                new_p = jnp.where(found_inf, psrc, new_p)
+                for slot in cfg["slots"]:
+                    boxes[slot]._value = jnp.where(
+                        found_inf,
+                        sdict[slot].tensor._value if dp_mode else
+                        _constrain(sdict[slot].tensor._value, shard_spec),
+                        boxes[slot]._value)
+            for slot in cfg["slots"]:
+                sdict[slot].tensor._value = (
+                    boxes[slot]._value if dp_mode
+                    else _constrain(boxes[slot]._value, shard_spec))
+            if zb.has_master:
+                sdict["master"].tensor._value = (
+                    new_p if dp_mode else _constrain(new_p, shard_spec))
+            if bound:
+                full_new = jax.lax.all_gather(new_p, axis, axis=0,
+                                              tiled=True)
+            elif dp_mode:  # analysis stand-in: shape only
+                full_new = jnp.concatenate([new_p] * degree, axis=0)
+            else:
+                full_new = _constrain(new_p, repl_spec)
+            for p, seg in zip(zb.params, zb.unflatten(full_new)):
+                # found_inf already gated new_p shard-side: on overflow
+                # the gathered rows reassemble the pre-step values
+                p._value = (seg.astype(p._value.dtype)
+                            if seg.dtype != p._value.dtype else seg)
+                if stage >= 2 or dp_mode:
+                    # stage 2: no full gradient outlives its bucket. Any
+                    # stage under a manual dp axis: the un-reduced LOCAL
+                    # grads must never escape the step (they are
+                    # rank-divergent and would poison a replicated carry)
+                    p._grad = None
+            n_bytes += zb.rows * _FLAT_LANES * 4
+        monitor.stat_add("zero_steps")
+        monitor.stat_add("zero_reduced_bytes", n_bytes)
+        if scaler_pending:
+            cfg["last_found_inf"] = found_inf
+
     def step(self):
+        from ..distributed import parallel_env
+        if self._zero is not None:
+            return self._zero_step()
+        dp_axis = parallel_env.current_dp_axis()
+        if dp_axis is not None:
+            self._reduce_dp_grads(dp_axis)
         from ..core.selected_rows import SelectedRows
         params_grads = [(p, p._grad) for p in self._parameters()
                         if not p.stop_gradient and p._grad is not None]
@@ -500,6 +977,12 @@ class AdamW(Adam):
         m._value, v._value = new_m, new_v
         lr_t = self._bias_corrected_lr(lr)
         out = p._value - lr_t * new_m / (jnp.sqrt(new_v) + self._eps)
+        mask = getattr(p, "_zero_decay_mask", None)
+        if mask is not None:
+            # flat ZeRO shard: apply_decay_param_fun becomes a per-row
+            # 0/1 mask (segments are row-aligned); x*1.0 and x-0.0 are
+            # exact, so this matches the per-param branch bit-for-bit
+            return out - lr * self._coeff * (mask * p._value)
         if self._decay_fn is None or self._decay_fn(p.name):
             out = out - lr * self._coeff * p._value
         return out
@@ -604,6 +1087,8 @@ class Adamax(Optimizer):
 class Lamb(Optimizer):
     """reference: operators/optimizers/lamb_op.h + fleet lamb_optimizer.py."""
 
+    _zero_compatible = False  # per-param trust ratio needs whole-tensor norms
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, name=None):
@@ -636,6 +1121,8 @@ class Lamb(Optimizer):
 
 class Lars(Momentum):
     """LARS (reference: operators/optimizers/lars_momentum_op.cc)."""
+
+    _zero_compatible = False  # local-lr needs whole-tensor norms
 
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, parameters=None, grad_clip=None,
@@ -757,6 +1244,8 @@ class Dpsgd(Optimizer):
     private SGD: per-step l2 clip to `clip`, gaussian noise of scale
     sigma/batch_size, then the sgd step. Noise draws ride the global
     functional RNG, so runs are reproducible under paddle.seed."""
+
+    _zero_compatible = False  # per-param clip norm + RNG draws
 
     def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
                  sigma=1.0, parameters=None, grad_clip=None, name=None):

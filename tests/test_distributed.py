@@ -130,7 +130,8 @@ def test_mp_grads_match_unsharded():
 
 
 def test_sharding_zero_specs_applied():
-    """ZeRO: distributed_optimizer must shard opt accumulators over dp
+    """ZeRO: distributed_optimizer must shard opt state over dp — the
+    flat stores carry PartitionSpec('dp', None) and live 1/8 per rank
     (program-inspection analog of sharding meta-optimizer tests)."""
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
@@ -140,8 +141,10 @@ def test_sharding_zero_specs_applied():
     m = nn.Linear(64, 64)
     opt = fleet.distributed_optimizer(
         paddle.optimizer.Adam(parameters=m.parameters()))
-    specs = [acc.pspec for acc in opt._inner._accumulators.values()]
-    assert any(s == P("dp") for s in specs), specs
+    zero = opt._inner._zero
+    assert zero is not None and zero["axis"] == "dp"
+    specs = [sd[s].tensor.pspec for sd in zero["stores"] for s in sd]
+    assert specs and all(sp == P("dp", None) for sp in specs), specs
 
     # and the sharded step still trains correctly
     def step(xb):

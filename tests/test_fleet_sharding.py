@@ -60,8 +60,9 @@ Y = np.random.RandomState(1).randint(0, 8, 8).astype("int64")
 
 
 def test_zero1_state_sharded_and_parity():
-    """Stage-1: accumulators sharded over the sharding axis; loss matches the
-    unsharded baseline (the check_with_place analog)."""
+    """Stage-1: the optimizer's moments re-lay-out into flat stores
+    sharded over the sharding axis; loss matches the unsharded baseline
+    (the check_with_place analog)."""
     # baseline
     m0 = _mlp(3)
     opt0 = paddle.optimizer.Adam(learning_rate=0.05,
@@ -75,18 +76,19 @@ def test_zero1_state_sharded_and_parity():
     opt = fleet.distributed_optimizer(opt, strategy)
 
     inner = opt._inner._inner  # HybridParallelOptimizer -> DygraphSharding -> Adam
-    specs = [acc.pspec for acc in inner._accumulators.values()]
-    assert any(s is not None and "sharding" in str(s) for s in specs), specs
+    assert inner._zero is not None and inner._zero["axis"] == "sharding"
+    stores = [sd[slot] for sd in inner._zero["stores"] for slot in sd]
+    specs = [st.tensor.pspec for st in stores]
+    assert specs and all("sharding" in str(s) for s in specs), specs
 
     losses = _train(m, opt, X, Y)
     np.testing.assert_allclose(base, losses, rtol=2e-5)
 
-    # the moment arrays must actually live sharded across the 8 devices
-    sharded = [acc for acc in inner._accumulators.values()
-               if acc.pspec is not None and any(acc.pspec)]
-    assert sharded
-    arr = sharded[0]._value
+    # the moment stores must actually live sharded across the 8 devices
+    arr = stores[0].tensor._value
     assert len(arr.sharding.device_set) == 8
+    # ... at 1/8 of the store per rank
+    assert arr.addressable_shards[0].data.shape[0] == arr.shape[0] // 8
 
 
 def test_zero3_params_sharded_and_parity():

