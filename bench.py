@@ -48,15 +48,26 @@ def main(argv=None):
     ap.add_argument("--k", type=int, default=None,
                     help="dispatch-amortization factor (steps per "
                          "compiled program); default 20 TPU / 2 CPU")
-    ap.add_argument("--zero", type=int, default=0, choices=(0, 1, 2),
+    ap.add_argument("--zero", type=int, default=0, choices=(0, 1, 2, 3),
                     help="ZeRO stage: shard optimizer state (moments + "
                          "fp32 masters) 1/dp per chip, bucketed "
                          "psum_scatter grad reduction + param all_gather "
                          "inside the scan step (implies --scan; dp = all "
-                         "local devices)")
+                         "local devices). Stage 3 also shards the "
+                         "PARAMETERS 1/dp: per-bucket all_gather "
+                         "materializes them just-in-time before forward "
+                         "and the update writes only shard rows")
+    ap.add_argument("--accumulate", type=int, default=1,
+                    help="gradient-accumulation window: group the k "
+                         "inner steps into k/N windows, optimizer "
+                         "update + reduce/all_gather once per window "
+                         "(cuts collective bytes per step ~N x for "
+                         "zero<=1; needs k %% N == 0)")
     args_cli = ap.parse_args(argv)
     if args_cli.zero:
         args_cli.scan = True  # ZeRO is an option of the scan step program
+    if args_cli.accumulate > 1:
+        args_cli.scan = True  # accumulation windows live in the scan step
 
     import jax
     import jax.lax as lax
@@ -119,10 +130,16 @@ def main(argv=None):
         # [k, ...]-stacked batch is the scan xs (same microbatch repeated
         # here, matching the unrolled control's batch reuse). Under
         # --zero the scan runs inside shard_map over 'dp' and the AdamW
-        # update is the sharded bucketed-psum_scatter step.
+        # update is the sharded bucketed-psum_scatter step. --accumulate
+        # groups the k steps into windows with one update each.
+        if args_cli.accumulate > 1 and k % args_cli.accumulate:
+            raise SystemExit(f"--k {k} must be a multiple of "
+                             f"--accumulate {args_cli.accumulate}")
         step = paddle.jit.to_static(
             one_step, scan_steps=k,
-            dp_axis="dp" if args_cli.zero else None)
+            dp_axis="dp" if args_cli.zero else None,
+            accumulate_steps=(args_cli.accumulate
+                              if args_cli.accumulate > 1 else None))
     else:
         def k_steps(ids, tok, labels, nsp_labels):
             for _ in range(k):
@@ -202,17 +219,23 @@ def main(argv=None):
     t = timer.telemetry()
     print(f"# backend={backend} batch={batch} seq={seq} k={k} "
           f"structure={'scan' if args_cli.scan else 'unroll'} "
-          f"zero={args_cli.zero} "
+          f"zero={args_cli.zero} accumulate={args_cli.accumulate} "
           f"mfu={mfu:.3f} timer_mfu={t.get('mfu', 0.0):.3f} "
           f"loss={loss_val:.3f}", file=sys.stderr)
-    if args_cli.zero:
+    if args_cli.zero or args_cli.accumulate > 1:
         # after the timed windows (the AOT stats path recompiles once):
-        # the psum_scatter-vs-psum evidence for this structure
+        # the psum_scatter-vs-psum evidence for this structure, plus the
+        # per-execution view (trip-count-weighted) that shows the
+        # accumulation window dividing reduction traffic
         try:
             stats = step.export_collective_bytes()
             top = ", ".join(f"{s['op']}[{s['axis']}] {s['bytes']}B"
                             f"x{s['count']}" for s in stats[:4])
             print(f"# in-trace collectives: {top}", file=sys.stderr)
+            per_exec = step.collective_stats(per_execution=True)
+            top = ", ".join(f"{s['op']}[{s['axis']}] {s['bytes']}B"
+                            f"x{s['count']}" for s in per_exec[:4])
+            print(f"# per-execution collectives: {top}", file=sys.stderr)
         except Exception as e:  # stats are evidence, never a bench failure
             print(f"# in-trace collectives unavailable: {e}",
                   file=sys.stderr)

@@ -27,6 +27,20 @@ Measured history on the shared v5e (for future rounds — don't re-try losers):
   owns that fusion. Don't retry.
 - r4 winners: k20 (+2.2% over k16) and pure-bf16 params + fp32 masters
   (+0.5%); combined 0.511 -> 0.525 MFU back-to-back.
+- r8 (CPU-small, 8-dev host mesh — no TPU attached to the builder):
+  ZeRO-3 (scan_k*_zero3, bench.py --zero 3) shards the PARAMETERS 1/dp on
+  top of the zero1/2 state sharding: per-bucket all_gather materializes
+  them just-in-time before forward, the update writes only shard rows —
+  per-chip model state (params + moments + masters) is O(params/dp) and
+  losses/params stay bitwise-equal to the replicated control
+  (tests/test_zero_sharding.py). Gradient accumulation
+  (scan_k*_zero1_acc<a>, bench.py --accumulate a) fires the
+  reduce/update/all_gather once per a-step window: per-execution
+  collective bytes (collective_stats(per_execution=True)) drop exactly
+  a× for zero1 on the CPU A/B. Steady-state TPU rows for
+  scan_k20_bf16_zero3 and scan_k20_bf16_zero1_acc4 vs scan_k20_bf16
+  still NEED a multichip TPU runner — at dp=1 both are pure overhead;
+  zero3's win is HBM headroom (batch/k buyback), acc's is wire time.
 - r7 (CPU-small BERT config — no TPU attached to the builder): ZeRO-1/2
   inside the scan step (scan_k*_zero{1,2} variants, bench.py --zero):
   optimizer state sharded 1/dp in flat stores, grads reduced by bucketed
@@ -57,7 +71,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
-               scan=False, zero=0):
+               scan=False, zero=0, accumulate=1):
     """The flagship program, identical to bench.py: k training steps per
     compiled program, optimization_barrier between backward and AdamW.
     Returns (step_fn, args, model) with step_fn compiled via to_static.
@@ -71,9 +85,14 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
     the same microbatch repeated, matching the unrolled control's batch
     reuse so the A/B isolates program structure.
 
-    zero: ZeRO stage 1/2 — optimizer state sharded 1/dp over all local
-    devices, bucketed psum_scatter grad reduction + param all_gather
-    inside the scan (implies scan)."""
+    zero: ZeRO stage 1/2/3 — optimizer state (and, at stage 3, the
+    parameters themselves, gathered just-in-time per bucket before the
+    forward) sharded 1/dp over all local devices, bucketed psum_scatter
+    grad reduction + param all_gather inside the scan (implies scan).
+
+    accumulate: gradient-accumulation window — group the k inner steps
+    into k/accumulate windows with one optimizer update (and one
+    reduce/all_gather round for zero<=1) each (implies scan)."""
     import numpy as np
 
     import jax
@@ -84,6 +103,9 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
         synthetic_mlm_batch
 
     paddle.seed(0)
+    if accumulate > 1:
+        scan = True
+        assert k % accumulate == 0, (k, accumulate)
     if zero:
         scan = True
         from paddle_tpu.distributed import parallel_env
@@ -122,7 +144,10 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
                                                 vocab_size=cfg.vocab_size)
     if scan:
         step = paddle.jit.to_static(one_step, scan_steps=k,
-                                    dp_axis="dp" if zero else None)
+                                    dp_axis="dp" if zero else None,
+                                    accumulate_steps=(accumulate
+                                                      if accumulate > 1
+                                                      else None))
         stack = lambda a: np.broadcast_to(a, (k,) + a.shape).copy()
         ids, tok, labels, nsp = (stack(a) for a in (ids, tok, labels, nsp))
     else:
@@ -137,11 +162,13 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
 
 
 def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2,
-                pure_bf16=False, white=(), scan=False, zero=0):
+                pure_bf16=False, white=(), scan=False, zero=0,
+                accumulate=1):
     seq = 512
     step, args, model = build_step(k=k, batch=batch, seq=seq,
                                    pure_bf16=pure_bf16, white=white,
-                                   scan=scan, zero=zero)
+                                   scan=scan, zero=zero,
+                                   accumulate=accumulate)
     last = (lambda l: l[-1]) if scan else (lambda l: l)
     t_compile = time.perf_counter()
     for _ in range(warmup):
@@ -164,16 +191,20 @@ def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2,
 
 
 def parse_spec(spec):
-    """'[scan_]k<N>[_b<N>][_bf16][_wsm][_wln][_zero<S>]' -> run_variant
-    kwargs (e.g. scan_k20_bf16_zero1)."""
+    """'[scan_]k<N>[_b<N>][_bf16][_wsm][_wln][_zero<S>][_acc<N>]' ->
+    run_variant kwargs (e.g. scan_k20_bf16_zero3,
+    scan_k20_bf16_zero1_acc4)."""
     kw = {"k": 16, "batch": 16, "pure_bf16": False, "scan": False,
-          "zero": 0}
+          "zero": 0, "accumulate": 1}
     white = []
     for part in spec.split("_"):
         if part == "scan":
             kw["scan"] = True
-        elif part in ("zero1", "zero2"):
+        elif part in ("zero1", "zero2", "zero3"):
             kw["zero"] = int(part[-1])
+            kw["scan"] = True
+        elif part.startswith("acc") and part[3:].isdigit():
+            kw["accumulate"] = int(part[3:])
             kw["scan"] = True
         elif part == "bf16":
             kw["pure_bf16"] = True

@@ -52,6 +52,20 @@ class GradScaler:
         from ..core.selected_rows import SelectedRows
         if not self._enable:
             return
+        from ..distributed import parallel_env
+        accum_win = parallel_env.current_accum()
+        if accum_win is not None and accum_win[0] == "accum":
+            # mid-window unscale cannot compose with accumulation: the
+            # NEXT micro step's backward adds SCALED gradients onto the
+            # just-unscaled sum and the mix is garbage on every path.
+            # The boundary step unscales the whole window once.
+            raise RuntimeError(
+                "scaler.unscale_ inside a gradient-accumulation window "
+                "(to_static(accumulate_steps=a)) mixes unscaled and "
+                "scaled micro gradients; rely on scaler.step at the "
+                "window boundary (it unscales the accumulated window "
+                "once), or clip via optimizer grad_clip which runs "
+                "after that unscale")
         inv = 1.0 / self._scale._value
         found = jnp.zeros((), jnp.bool_)
         for p in optimizer._parameters():
@@ -88,12 +102,37 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
+        from ..distributed import parallel_env
+        accum_win = parallel_env.current_accum()
+        if accum_win is not None and accum_win[0] == "accum":
+            # non-boundary micro step of an accumulation window: grads
+            # stay SCALED and accumulate through the carry; unscale,
+            # found-inf and the loss-scale update all run once per window
+            # at the boundary step (an inf in any micro step survives the
+            # accumulation sum, so the window-wide check sees it)
+            optimizer.step()
+            return
         zero = getattr(optimizer, "_zero", None)
         if zero is not None:
             # ZeRO: defer the finite check to the optimizer's sharded
             # step — isfinite runs over each rank's reduced bucket shard
             # (1/dp of the work) and a tiny psum'd flag gates the update
-            if self._found_inf is False:
+            if accum_win is not None and zero["stage"] >= 2:
+                # stage-2/3 windows folded SCALED mean-shards into the
+                # sharded accumulator; unscaling the last micro's
+                # per-param grads would miss it — defer the whole-window
+                # unscale to the combined shard inside the step
+                if self._found_inf is not False:
+                    raise NotImplementedError(
+                        "manual scaler.unscale_ at an accumulation-window "
+                        "boundary cannot compose with ZeRO stage>=2: the "
+                        "earlier micro steps are already folded into the "
+                        "sharded accumulator still scaled. Let "
+                        "scaler.step unscale the window, or use ZeRO "
+                        "stage<=1")
+                zero["pending_found"] = None
+                zero["pending_inv_scale"] = 1.0 / self._scale._value
+            elif self._found_inf is False:
                 self.unscale_(optimizer, _check_finite=False)
                 zero["pending_found"] = None
             else:

@@ -25,15 +25,20 @@ COLLECTIVE_OPS = frozenset({
 
 
 def collective_sequence(prog):
-    """Ordered [(op_index, op_name, axis_name, nbytes)] of a program's
-    recorded collectives. ``nbytes`` is the payload stamp
+    """Ordered [(op_index, op_name, axis_name, nbytes, every)] of a
+    program's recorded collectives. ``nbytes`` is the payload stamp
     ``distributed.collective`` leaves on the lowering
     (``fn._collective_nbytes``; None when the lowering predates the
     stamp) — it is what lets the order checker see a rank-divergent
-    BUCKET layout, where op kind and axis agree at every position but
-    the payloads crossing the wire do not."""
+    BUCKET layout, where op kind and axis agree at every position but the
+    payloads crossing the wire do not. ``every`` is the cadence stamp
+    (``fn._collective_every``): 1 for a per-step collective, a>1 for one
+    that fires once per a-step gradient-accumulation window — the order
+    checker uses it to tell a deliberate per-window reduction apart from
+    rank divergence (None when unstamped)."""
     return [(i, op.name, getattr(op.fn, "_collective_axis", None),
-             getattr(op.fn, "_collective_nbytes", None))
+             getattr(op.fn, "_collective_nbytes", None),
+             getattr(op.fn, "_collective_every", None))
             for i, op in enumerate(prog.ops) if op.name in COLLECTIVE_OPS]
 
 
@@ -53,7 +58,7 @@ def check_collectives(prog, mesh_axes=None):
     findings = []
     if mesh_axes is None:
         mesh_axes = _mesh_axes()
-    for i, name, ax, _nbytes in collective_sequence(prog):
+    for i, name, ax, _nbytes, _every in collective_sequence(prog):
         if ax is None:
             findings.append(Finding(
                 "collective-axis-unknown", WARNING,
@@ -84,13 +89,28 @@ def check_collective_order(programs, mesh_axes=None):
                 f"rank {r} issues {len(seq)} collectives but rank 0 "
                 f"issues {len(ref)} — the mesh deadlocks at the first "
                 "unmatched collective"))
-        for k, ((_, n0, a0, b0), (_, n1, a1, b1)) in enumerate(zip(ref, seq)):
+        for k, ((_, n0, a0, b0, e0), (_, n1, a1, b1, e1)) in enumerate(
+                zip(ref, seq)):
             if n0 != n1 or a0 != a1:
                 findings.append(Finding(
                     "collective-order-mismatch", ERROR,
                     f"position {k}: rank 0 issues {n0}(axis={a0!r}) but "
                     f"rank {r} issues {n1}(axis={a1!r}) — mismatched "
                     "collectives cross-match on the wire and deadlock",
+                    op_index=seq[k][0], op_name=n1))
+            elif e0 is not None and e1 is not None and e0 != e1:
+                # cadence stamps make window reductions first-class: two
+                # ranks disagreeing on WHEN a reduction fires is a real
+                # skew (one blocks every step, the other once per
+                # window), while matching stamps let a per-window
+                # schedule verify clean instead of reading as divergence
+                findings.append(Finding(
+                    "collective-cadence-mismatch", ERROR,
+                    f"position {k}: rank 0 fires {n0}(axis={a0!r}) every "
+                    f"{e0} step(s) but rank {r} every {e1} — a per-step "
+                    "reduction on one rank cross-matches a per-window "
+                    "(gradient-accumulation) reduction on the other and "
+                    "the mesh deadlocks inside the first window",
                     op_index=seq[k][0], op_name=n1))
             elif b0 is not None and b1 is not None and b0 != b1:
                 findings.append(Finding(

@@ -112,9 +112,13 @@ def check_static_function(sfn):
     # sharded state the program neither reads nor writes: harmless to
     # the program (unused tracers drop out of the jaxpr) but a smell —
     # either a stale store from a dead optimizer still registered, or a
-    # live store whose layout this step silently won't maintain
+    # live store whose layout this step silently won't maintain.
+    # Carry-optional state (the ZeRO gradient-accumulation stores, live
+    # only under to_static(accumulate_steps=a)) is exempt: a
+    # non-accumulating step legitimately skips it.
+    optional = set(part.get("carry_optional", ()))
     for uid in sorted(set(part.get("sharded", ()))
-                      & set(part.get("skipped", ()))):
+                      & set(part.get("skipped", ())) - optional):
         findings.append(Finding(
             "sharded-state-skipped", WARNING,
             f"state uid {uid!r} carries a PartitionSpec but the compiled "

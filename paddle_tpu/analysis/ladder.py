@@ -178,6 +178,46 @@ def _zero1_ranks():
     return pairs
 
 
+def _zero3_ranks():
+    """Two per-rank programs with the ZeRO-3 + gradient-accumulation
+    collective schedule: the per-bucket param all-gather fires every
+    micro step (cadence 1, ag -> forward), while the bucketed gradient
+    reduce-scatter is window-gated (cadence 4: one reduction per 4-step
+    accumulation window, the ``to_static(accumulate_steps=4)`` shape)
+    and the update writes only shard rows — no trailing param
+    all-gather. The cadence stamps are what keep the order checker from
+    reading the window-gated reduction as rank divergence; tests seed
+    the per-step-vs-per-window mismatch it must reject."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.core.dispatch import call_op
+
+    def _stamped(op_name, nbytes, every):
+        def fn(*vs):
+            return vs[0]
+        fn._collective_axis = "dp"
+        fn._collective_nbytes = nbytes
+        fn._collective_every = every
+        return lambda *vs: call_op(fn, *vs, op_name=op_name)
+
+    pairs = []
+    for _rank in range(2):
+        prog = static.Program()
+        with static.program_guard(prog):
+            pshard = static.data("param_shard_b0", [2, 16], "float32")
+            grads = static.data("grad_b0", [8, 16], "float32")
+            # ag -> fwd: params materialize just-in-time from the shard
+            full = _stamped("c_allgather", 8 * 16 * 4, 1)(pshard)
+            h = paddle.matmul(full, paddle.transpose(full, [1, 0]))
+            # rs fires once per 4-step accumulation window
+            gshard = _stamped("c_reducescatter", 8 * 16 * 4, 4)(grads)
+            # shard-local update: only the local rows are written back
+            loss = paddle.sum(h) + paddle.sum(
+                paddle.add(pshard, paddle.scale(gshard[:2], -0.01)))
+        pairs.append((prog, [loss]))
+    return pairs
+
+
 LADDER_BUILDERS = {
     "resnet": _resnet_like,
     "gpt": _gpt_like,
@@ -186,6 +226,7 @@ LADDER_BUILDERS = {
     "hbm_cache": _hbm_cache_like,
     "allreduce": _allreduce_ranks,
     "zero1": _zero1_ranks,
+    "zero3": _zero3_ranks,
 }
 
 
@@ -218,7 +259,7 @@ def verify_ladder(configs=None, mesh_axes=("dp",)):
             _tag(name, verify(prog, targets=targets, mesh_axes=mesh_axes))
             _tag(name, check_dtypes(prog))
             _tag(name, lint(prog))
-        if name in ("allreduce", "zero1"):
+        if name in ("allreduce", "zero1", "zero3"):
             _tag(name, check_collective_order([p for p, _t in pairs],
                                               mesh_axes=mesh_axes))
     return findings, summary

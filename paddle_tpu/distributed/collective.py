@@ -172,6 +172,17 @@ def _axis(group):
     return group.axis_name if group is not None else None
 
 
+def _cadence():
+    """Cadence stamp for a recorded collective lowering: 1 for a
+    per-step collective, a>1 for one recorded while a gradient
+    accumulation window's boundary step traces (it fires once per
+    a-step window). The analysis order checker uses this to tell a
+    deliberate per-window reduction apart from rank divergence."""
+    from . import parallel_env
+    acc = parallel_env.current_accum()
+    return int(acc[1]) if acc is not None and acc[0] == "fire" else 1
+
+
 @_instrumented
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     _validate_reduce_op(op)
@@ -192,6 +203,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         # flag rank-divergent bucket layouts) across ranks
         _ar._collective_axis = ax
         _ar._collective_nbytes = _tensor_nbytes(unwrap(tensor))
+        _ar._collective_every = _cadence()
         out = call_op(_ar, tensor, op_name="c_allreduce")
         tensor._value = out._value
         tensor._tape_node = out._tape_node
@@ -227,6 +239,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
             return jax.lax.all_gather(v, ax)
         _ag._collective_axis = ax
         _ag._collective_nbytes = _tensor_nbytes(unwrap(tensor))
+        _ag._collective_every = _cadence()
         out = call_op(_ag, tensor, op_name="c_allgather")
         n = out.shape[0]
         for i in range(n):
@@ -280,6 +293,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
         _rs._collective_axis = ax
         _rs._collective_nbytes = sum(_tensor_nbytes(unwrap(t))
                                      for t in tensor_list)
+        _rs._collective_every = _cadence()
         out = call_op(_rs, *tensor_list, op_name="c_reducescatter")
         tensor._value = out._value
         return tensor
@@ -335,6 +349,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             # psum promotes bool→int32; restore the caller's dtype
             return jax.lax.psum(masked, ax).astype(v.dtype)
         _bcast._collective_axis = ax
+        _bcast._collective_every = _cadence()
         out = call_op(_bcast, tensor, op_name="c_broadcast")
         tensor._value = out._value
         tensor._tape_node = out._tape_node
@@ -365,6 +380,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             stacked = jnp.stack([unwrap(t) for t in tensor_list])
             return stacked[idx]
         _scatter._collective_axis = ax
+        _scatter._collective_every = _cadence()
         out = call_op(_scatter, tensor, op_name="c_scatter")
         tensor._value = out._value
         return tensor
@@ -413,6 +429,7 @@ def p2p_transfer(tensor, src, dst, group=None):
     def _pp(v):
         return jax.lax.ppermute(v, ax, perm=[(src, dst)])
     _pp._collective_axis = ax
+    _pp._collective_every = _cadence()
     out = call_op(_pp, tensor, op_name="p2p_transfer")
     return out
 

@@ -79,7 +79,12 @@ class DygraphShardingOptimizer:
         if comm_buffer_mb is None:
             comm_buffer_mb = cfg.get("comm_buffer_size_MB",
                                      cfg.get("segment_broadcast_MB", 25.0))
-        self._stage = min(int(stage), 2)  # stage 3 = param layout, not ours
+        # stage 3 goes through the flat path too (params re-laid into
+        # sharded bucket stores) — unless distributed_model already
+        # GSPMD-annotated the params (shard_parameters), in which case
+        # _zero_enable rejects pre-annotated layouts and the
+        # annotation fallback below keeps the legacy behavior
+        self._stage = int(stage)
         mesh = hcg.mesh if hcg else None
         self._zero_flat = False
         trainable = [p for p in inner_optimizer._parameters()

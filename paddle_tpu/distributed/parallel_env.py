@@ -39,6 +39,38 @@ def current_dp_axis():
     return _dp_axis[0]
 
 
+# the gradient-accumulation window of a to_static(accumulate_steps=a) scan
+# trace: ("accum", a) while a non-boundary micro step's body is being
+# traced (optimizer/scaler updates defer, grads survive clear_grad),
+# ("fire", a) while the window-boundary step traces (the update runs once
+# over the accumulated gradients, scaled 1/a). None outside accumulation.
+_accum = [None]
+
+
+def current_accum():
+    """("accum"|"fire", window_steps) of the scan trace in progress, or
+    None when no accumulation window is active."""
+    return _accum[0]
+
+
+class accum_ctx:
+    """Bind the accumulation phase for the duration of a micro-step trace."""
+
+    def __init__(self, phase, steps):
+        assert phase in ("accum", "fire"), phase
+        self.state = (phase, int(steps))
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = _accum[0]
+        _accum[0] = self.state
+        return self
+
+    def __exit__(self, *exc):
+        _accum[0] = self._saved
+        return False
+
+
 class dp_axis_ctx:
     """Bind the manual dp axis for the duration of a step-program trace."""
 

@@ -17,6 +17,7 @@ inserts the collectives (the analog of the reference's c_allreduce insertion
 by fleet meta-optimizers).
 """
 import functools
+import weakref
 
 import jax
 import numpy as np
@@ -27,6 +28,47 @@ from ..core.tensor import Tensor
 from ..observability import tracing as _obs
 
 _is_tracing = False
+
+# step hooks: callables run inside every traced step body, after the
+# framework state swaps to tracers and before the user function — the seam
+# ZeRO-3 uses for just-in-time parameter materialization (per-bucket
+# all_gather from the sharded carry). A hook returns an optional cleanup
+# callable invoked when the body ends (success or error). Held weakly so a
+# dead owner (a dropped optimizer) stops contributing ops.
+_step_hooks = []
+
+
+def register_step_hook(hook):
+    """Register ``hook() -> cleanup|None`` to run at every step-body trace
+    entry. Hooks are held WEAKLY (bound methods via WeakMethod) so the
+    hook dies with its owner instead of pinning it — which means a bare
+    closure/lambda with no other strong reference is collected before it
+    ever fires; pass a bound method or a module-level function.
+    Re-registering the same callable is a no-op."""
+    for ref in _step_hooks:
+        if ref() == hook:
+            return hook
+    _step_hooks.append(weakref.WeakMethod(hook)
+                       if hasattr(hook, "__self__") else weakref.ref(hook))
+    return hook
+
+
+def _run_step_hooks(cleanups):
+    """Run every live hook, appending each cleanup to ``cleanups`` AS IT
+    IS PRODUCED — if a later hook raises, the caller's finally still
+    unwinds the earlier hooks' overrides instead of leaking tracers onto
+    live tensors."""
+    dead = []
+    for ref in _step_hooks:
+        h = ref()
+        if h is None:
+            dead.append(ref)
+            continue
+        c = h()
+        if c is not None:
+            cleanups.append(c)
+    for ref in dead:
+        _step_hooks.remove(ref)
 
 
 def _data_dependent_errors():
@@ -246,7 +288,7 @@ class StaticFunction:
     """
 
     def __init__(self, fn, input_spec=None, donate_state=True,
-                 scan_steps=None, dp_axis=None):
+                 scan_steps=None, dp_axis=None, accumulate_steps=None):
         self._fn = fn
         self._cache = {}
         self._donate = donate_state
@@ -259,6 +301,21 @@ class StaticFunction:
                 "dp_axis is an option of the scan step program; pass "
                 "scan_steps=k (k=1 compiles a single-step scan)")
         self._dp_axis = dp_axis
+        self._accumulate_steps = None
+        if accumulate_steps is not None:
+            a = int(accumulate_steps)
+            if self._scan_steps is None:
+                raise ValueError(
+                    "accumulate_steps is an option of the scan step "
+                    "program; pass scan_steps=k")
+            if a < 1:
+                raise ValueError(
+                    f"accumulate_steps must be >= 1, got {accumulate_steps}")
+            if a > 1 and self._scan_steps % a:
+                raise ValueError(
+                    f"scan_steps={self._scan_steps} must be a multiple of "
+                    f"accumulate_steps={a} (whole accumulation windows)")
+            self._accumulate_steps = a if a > 1 else None
         self._last_aux = None
         functools.update_wrapper(self, fn)
 
@@ -397,14 +454,19 @@ class StaticFunction:
             raise RuntimeError("no compiled entry yet; call the step once")
         return self._last_aux["hlo_text"]()
 
-    def collective_stats(self):
+    def collective_stats(self, per_execution=False):
         """In-trace collective accounting of the most recent entry: one
         record per (op, axis) with call count and payload bytes, parsed
         from the compiled HLO (closing the 'in-trace collectives are
-        invisible to python timers' gap — see observability.hlo_bytes)."""
+        invisible to python timers' gap — see observability.hlo_bytes).
+        ``per_execution=True`` multiplies ops inside while-loops by their
+        known trip counts, so a k-step scan bills its collectives k times
+        — the number that shows gradient accumulation cutting collective
+        bytes per program execution ~a×."""
         from ..observability import hlo_bytes
         return hlo_bytes.collective_stats(self.hlo_text(),
-                                          mesh=self._mesh())
+                                          mesh=self._mesh(),
+                                          per_execution=per_execution)
 
     def export_collective_bytes(self):
         """Export collective_stats() into the shared monitor registry as
@@ -452,19 +514,26 @@ class StaticFunction:
             args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
             with _StateSwap(state_items, state_vals, grad_vals) as swap, \
                     parallel_env.dp_axis_ctx(dp_axis):
-                out = fn(*args, **kwargs)
-                out_leaves, out_treedef = jax.tree_util.tree_flatten(
-                    out, is_leaf=lambda x: isinstance(x, Tensor))
-                out_vals = [l._value if isinstance(l, Tensor) else l
-                            for l in out_leaves]
-                if dp_axis is not None and parallel_env.axis_bound(dp_axis):
-                    out_vals = [
-                        jax.lax.pmean(v, dp_axis)
-                        if (hasattr(v, "dtype")
-                            and jnp_issubdtype(v.dtype)) else v
-                        for v in out_vals]
-                out_template["treedef"] = out_treedef
-                new_state, new_grads = swap.capture()
+                cleanups = []
+                try:
+                    _run_step_hooks(cleanups)
+                    out = fn(*args, **kwargs)
+                    out_leaves, out_treedef = jax.tree_util.tree_flatten(
+                        out, is_leaf=lambda x: isinstance(x, Tensor))
+                    out_vals = [l._value if isinstance(l, Tensor) else l
+                                for l in out_leaves]
+                    if dp_axis is not None \
+                            and parallel_env.axis_bound(dp_axis):
+                        out_vals = [
+                            jax.lax.pmean(v, dp_axis)
+                            if (hasattr(v, "dtype")
+                                and jnp_issubdtype(v.dtype)) else v
+                            for v in out_vals]
+                    out_template["treedef"] = out_treedef
+                    new_state, new_grads = swap.capture()
+                finally:
+                    for c in cleanups:
+                        c()
             info["w_val"] = [nv is not ov
                              for nv, ov in zip(new_state, state_vals)]
             info["w_grad"] = [ng is not og
@@ -570,6 +639,9 @@ class StaticFunction:
             "readonly_grads": [uids[i] for i in ro_grad_idx],
             "sharded": [uids[i] for i in range(n)
                         if _is_sharded_spec(state_items[i][1].pspec)],
+            "carry_optional": [uids[i] for i in range(n)
+                               if getattr(state_items[i][1],
+                                          "_carry_optional", False)],
             "dp_axis": None,
         }
 
@@ -727,8 +799,31 @@ class StaticFunction:
         else:
             a_state = state_vals
 
+        # accumulation windows trace the SAME body in two phases: "accum"
+        # (updates defer, grads survive clear_grad) for the first a-1
+        # steps of each window and "fire" (one update over the 1/a-scaled
+        # accumulated grads) for the window boundary. The phase is
+        # published through parallel_env.accum_ctx, which the
+        # optimizer/GradScaler consult.
+        a = self._accumulate_steps
+
+        def _phase_fn(phase):
+            if a is None:
+                return pure_fn
+
+            def wrapped(sv, dv, gv):
+                from ..distributed import parallel_env
+                with parallel_env.accum_ctx(phase, a):
+                    return pure_fn(sv, dv, gv)
+            return wrapped
+
+        fire_fn = _phase_fn("fire")
+        accum_fn = _phase_fn("accum") if a is not None else None
+
         # grad-presence fixpoint (presence only grows, so it terminates);
-        # grads follow their tensor's layout (localize like the values)
+        # grads follow their tensor's layout (localize like the values).
+        # With accumulation BOTH body flavors contribute: the carry must
+        # cover the union of their written state and surviving grads.
         grad_tmpl = [t._grad for _, t in state_items]
         if dp_axis is not None:
             grad_tmpl = [jax.ShapeDtypeStruct(
@@ -736,24 +831,44 @@ class StaticFunction:
                              np.dtype(g.dtype))
                          if g is not None and _is_sharded_spec(spec) else g
                          for g, spec in zip(grad_tmpl, state_specs)]
-        for _ in range(n + 1):
-            closed, val_used, grad_used = _analysis_trace(
-                pure_fn, a_state, step_tmpl, grad_tmpl, n, info)
-            out_avals = list(closed.out_avals)
-            pos = info["n_out"] + n
-            created = []
-            for i, present in enumerate(info["grad_out_mask"]):
-                if present:
-                    if grad_tmpl[i] is None:
-                        created.append((i, out_avals[pos]))
-                    pos += 1
-            if not created:
+        modes = [("fire", fire_fn)]
+        if accum_fn is not None:
+            modes.append(("accum", accum_fn))
+        mode_res = {}
+        for _ in range(2 * (n + 1)):
+            grew = False
+            for mname, mfn in modes:
+                closed, m_used, mg_used = _analysis_trace(
+                    mfn, a_state, step_tmpl, grad_tmpl, n, info)
+                mode_res[mname] = (dict(info), m_used, mg_used)
+                out_avals = list(closed.out_avals)
+                pos = info["n_out"] + n
+                for i, present in enumerate(info["grad_out_mask"]):
+                    if present:
+                        if grad_tmpl[i] is None:
+                            grad_tmpl[i] = jax.ShapeDtypeStruct(
+                                out_avals[pos].shape, out_avals[pos].dtype)
+                            grew = True
+                        pos += 1
+            if not grew:
                 break
-            for i, aval in created:
-                grad_tmpl[i] = jax.ShapeDtypeStruct(aval.shape, aval.dtype)
 
-        w_val, w_grad = info["w_val"], info["w_grad"]
-        steady_mask = list(info["grad_out_mask"])
+        fire_info, val_used, grad_used = mode_res["fire"]
+        w_val = list(fire_info["w_val"])
+        w_grad = list(fire_info["w_grad"])
+        val_used = list(val_used)
+        grad_used = dict(grad_used)
+        if "accum" in mode_res:
+            ainfo, a_used, ag_used = mode_res["accum"]
+            w_val = [x or y for x, y in zip(w_val, ainfo["w_val"])]
+            w_grad = [x or y for x, y in zip(w_grad, ainfo["w_grad"])]
+            val_used = [x or y for x, y in zip(val_used, a_used)]
+            for i, u in ag_used.items():
+                grad_used[i] = grad_used.get(i, False) or u
+        # grads written back after the call follow the BOUNDARY body's
+        # exit state (the last inner step of the last window fires)
+        steady_mask = list(fire_info["grad_out_mask"])
+        info.update(fire_info)
         carry_val_idx = [i for i in range(n) if w_val[i]]
         ro_val_idx = [i for i in range(n) if not w_val[i] and val_used[i]]
         skip_val_idx = [i for i in range(n)
@@ -779,36 +894,66 @@ class StaticFunction:
             for i, (shape, dt) in carry_g_sds.items()}
 
         def pure_fn2(carry_vals, carry_grads, xs_stacked, ro_vals, ro_grads):
-            def body(carry, xs):
-                c_vals, c_grads = carry
-                sv = [None] * n
-                gv = [None] * n
-                for i, v in zip(carry_val_idx, c_vals):
-                    sv[i] = v
-                for i, v in zip(ro_val_idx, ro_vals):
-                    sv[i] = v
-                for i in skip_val_idx:  # trace-time read of the live value
-                    sv[i] = state_items[i][1]._value
-                for i, g in zip(carry_grad_idx, c_grads):
-                    gv[i] = g
-                for i, g in zip(ro_grad_idx, ro_grads):
-                    gv[i] = g
-                for i in skip_grad_idx:
-                    gv[i] = state_items[i][1]._grad
-                out_vals, new_state, new_grads = pure_fn(sv, list(xs), gv)
-                next_grads = []
-                for i in carry_grad_idx:
-                    g = new_grads[i]
-                    if g is None:  # cleared: zeros ≡ cleared for step i+1
-                        shape, dt = carry_g_sds[i]
-                        g = jnp.zeros(shape, dt)
-                    next_grads.append(g)
-                return ([new_state[i] for i in carry_val_idx], next_grads), \
-                    tuple(out_vals)
+            def _mk_body(step_fn):
+                def body(carry, xs):
+                    c_vals, c_grads = carry
+                    sv = [None] * n
+                    gv = [None] * n
+                    for i, v in zip(carry_val_idx, c_vals):
+                        sv[i] = v
+                    for i, v in zip(ro_val_idx, ro_vals):
+                        sv[i] = v
+                    for i in skip_val_idx:  # trace-time read, live value
+                        sv[i] = state_items[i][1]._value
+                    for i, g in zip(carry_grad_idx, c_grads):
+                        gv[i] = g
+                    for i, g in zip(ro_grad_idx, ro_grads):
+                        gv[i] = g
+                    for i in skip_grad_idx:
+                        gv[i] = state_items[i][1]._grad
+                    out_vals, new_state, new_grads = step_fn(sv, list(xs),
+                                                             gv)
+                    next_grads = []
+                    for i in carry_grad_idx:
+                        g = new_grads[i]
+                        if g is None:  # cleared: zeros ≡ cleared for i+1
+                            shape, dt = carry_g_sds[i]
+                            g = jnp.zeros(shape, dt)
+                        next_grads.append(g)
+                    return ([new_state[i] for i in carry_val_idx],
+                            next_grads), tuple(out_vals)
+                return body
 
-            (f_vals, f_grads), ys = jax.lax.scan(
-                body, (list(carry_vals), list(carry_grads)),
-                tuple(xs_stacked), length=k)
+            init = (list(carry_vals), list(carry_grads))
+            if a is None:
+                (f_vals, f_grads), ys = jax.lax.scan(
+                    _mk_body(fire_fn), init, tuple(xs_stacked), length=k)
+                return list(ys), f_vals, f_grads
+
+            # accumulation windows: outer scan over k/a windows, each an
+            # inner scan of a-1 deferred micro steps plus the boundary
+            # step that fires the update — the per-window collectives
+            # appear once in this body instead of once per inner step
+            w = k // a
+            tmap = jax.tree_util.tree_map
+            xs_win = tmap(lambda x: x.reshape((w, a) + x.shape[1:]),
+                          tuple(xs_stacked))
+            accum_body = _mk_body(accum_fn)
+            fire_body = _mk_body(fire_fn)
+
+            def window(carry, xs_w):
+                carry, ys_head = jax.lax.scan(
+                    accum_body, carry, tmap(lambda x: x[:a - 1], xs_w),
+                    length=a - 1)
+                carry, ys_last = fire_body(carry,
+                                           tmap(lambda x: x[a - 1], xs_w))
+                ys_w = tmap(lambda h, l: jnp.concatenate([h, l[None]], 0),
+                            ys_head, ys_last)
+                return carry, ys_w
+
+            (f_vals, f_grads), ys = jax.lax.scan(window, init, xs_win,
+                                                 length=w)
+            ys = tmap(lambda y: y.reshape((k,) + y.shape[2:]), ys)
             return list(ys), f_vals, f_grads
 
         donate = (0, 1) if self._donate else ()
@@ -841,8 +986,12 @@ class StaticFunction:
             "readonly_grads": [uids[i] for i in ro_grad_idx],
             "sharded": [uids[i] for i in range(n)
                         if _is_sharded_spec(state_specs[i])],
+            "carry_optional": [uids[i] for i in range(n)
+                               if getattr(state_items[i][1],
+                                          "_carry_optional", False)],
             "dp_axis": dp_axis,
             "scan_steps": k,
+            "accumulate_steps": a,
         }
 
         carry_ts = [state_items[i][1] for i in carry_val_idx]
@@ -851,7 +1000,7 @@ class StaticFunction:
         rog_ts = [state_items[i][1] for i in ro_grad_idx]
 
         aux = self._make_aux(lambda: jitted, kind="scan", scan_steps=k,
-                             dp_axis=dp_axis)
+                             dp_axis=dp_axis, accumulate_steps=a)
 
         def compiled(dyn_vals):
             init_grads = []
@@ -924,7 +1073,8 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              scan_steps=None, dp_axis=None, **kwargs):
+              scan_steps=None, dp_axis=None, accumulate_steps=None,
+              **kwargs):
     """Decorator / wrapper, usable as @to_static or to_static(fn).
 
     ``scan_steps=k`` compiles ``function`` (the single-step body) as a
@@ -938,12 +1088,24 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     reduction goes through the explicit collectives the optimizer
     issues — per-param psum for a replicated optimizer, bucketed
     ``psum_scatter`` + param ``all_gather`` after
-    ``optimizer._zero_enable()`` (ZeRO-1/2) — and PartitionSpec-sharded
-    optimizer state rides the donated carry as per-rank shards. User
-    outputs (losses/metrics) are pmean'd over the axis."""
+    ``optimizer._zero_enable()`` (ZeRO; stage 3 adds per-bucket param
+    ``all_gather`` before the forward instead, with params riding the
+    carry as 1/dp shards) — and PartitionSpec-sharded optimizer state
+    rides the donated carry as per-rank shards. User outputs
+    (losses/metrics) are pmean'd over the axis.
+
+    ``accumulate_steps=a`` groups the k inner steps into k/a gradient
+    accumulation windows: the first a-1 steps of each window run with
+    optimizer/scaler updates deferred (gradients accumulate through the
+    scan carry — per-param for replicated/ZeRO-1 state, reduced into the
+    sharded per-bucket accumulator for ZeRO-2/3) and the window's last
+    step fires one update over the 1/a-scaled accumulated gradients, so
+    the reduce/update(/all_gather) collectives bill once per window
+    instead of once per step."""
     if function is None:
         return lambda fn: to_static(fn, input_spec=input_spec,
-                                    scan_steps=scan_steps, dp_axis=dp_axis)
+                                    scan_steps=scan_steps, dp_axis=dp_axis,
+                                    accumulate_steps=accumulate_steps)
     if isinstance(function, StaticFunction):
         return function
     # Layers: wrap forward, keep the layer object semantics
@@ -952,11 +1114,13 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         layer = function
         static_forward = StaticFunction(layer.forward, input_spec,
                                         scan_steps=scan_steps,
-                                        dp_axis=dp_axis)
+                                        dp_axis=dp_axis,
+                                        accumulate_steps=accumulate_steps)
         layer.forward = static_forward
         return layer
     return StaticFunction(function, input_spec, scan_steps=scan_steps,
-                          dp_axis=dp_axis)
+                          dp_axis=dp_axis,
+                          accumulate_steps=accumulate_steps)
 
 
 class InputSpec:

@@ -13,8 +13,14 @@ number, not a narrative.
 Payload convention: ``bytes = max(operand bytes, result bytes)`` per op —
 the full-tensor side of the transfer (all-gather's result, reduce-scatter
 and all-reduce's operand), which is what the ring actually moves up to the
-(n-1)/n factor. Counts are static occurrences in the program text: an op
-inside a scan/while body is counted once, not trip-count times.
+(n-1)/n factor. Counts default to static occurrences in the program text:
+an op inside a scan/while body is counted once, not trip-count times.
+``per_execution=True`` instead multiplies each op by its enclosing
+computation's execution multiplier, resolved from the ``while`` ops'
+``known_trip_count`` backend configs (nested loops multiply; loops the
+compiler could not bound fall back to 1) — the accounting that shows a
+k-step scan billing its reductions k times, and gradient accumulation
+dividing that by the window size.
 
 Axis attribution: HLO carries replica groups, not mesh axis names; a
 group size that matches exactly one axis of the active mesh gets that
@@ -91,12 +97,71 @@ def _axis_name(group_size, mesh):
     return f"size{group_size}"
 
 
-def collective_stats(hlo_text, mesh=None):
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%([\w.\-]+)\s*\(.*\{\s*$")
+_TRIP_RE = re.compile(r'"known_trip_count"\s*:\s*\{\s*"n"\s*:\s*"(\d+)"')
+_CALLEE_RES = (
+    # (pattern, trip-scaled): while bodies/conditions run trip-count
+    # times; calls/fusions/branches execute once per parent execution
+    (re.compile(r"\bbody=%([\w.\-]+)"), True),
+    (re.compile(r"\bcondition=%([\w.\-]+)"), True),
+    (re.compile(r"\bto_apply=%([\w.\-]+)"), False),
+    (re.compile(r"\bcalls=%([\w.\-]+)"), False),
+    (re.compile(r"\bbranch_computations=\{([^}]*)\}"), False),
+)
+
+
+def _comp_multipliers(hlo_text):
+    """computation name -> static execution count per program run, from
+    the call graph (ENTRY = 1, while bodies × known_trip_count, other
+    callees × 1; unknown trip counts conservatively 1)."""
+    entry = None
+    comp = None
+    edges = []  # (parent, child, weight)
+    for line in hlo_text.splitlines():
+        h = _COMP_RE.match(line)
+        if h is not None:
+            comp = h.group(1)
+            if line.startswith("ENTRY"):
+                entry = comp
+            continue
+        if comp is None:
+            continue
+        trip = _TRIP_RE.search(line)
+        n = int(trip.group(1)) if trip else 1
+        for pat, scaled in _CALLEE_RES:
+            for m in pat.finditer(line):
+                names = m.group(1)
+                for name in (x.strip().lstrip("%")
+                             for x in names.split(",")):
+                    if name:
+                        edges.append((comp, name, n if scaled else 1))
+    mult = {entry: 1}
+    for _ in range(len(edges) + 1):
+        new = {entry: 1}
+        for parent, child, wgt in edges:
+            if parent in mult and child != entry:
+                new[child] = new.get(child, 0) + mult[parent] * wgt
+        if new == mult:
+            break
+        mult = new
+    return mult
+
+
+def collective_stats(hlo_text, mesh=None, per_execution=False):
     """Parse compiled HLO into ``{(op, axis): {"count", "bytes"}}``-shaped
     records: a list of dicts with keys ``op``, ``axis``, ``count``,
-    ``bytes`` sorted by descending bytes."""
+    ``bytes`` sorted by descending bytes. With ``per_execution`` each op
+    is weighted by its computation's execution multiplier (see module
+    docstring), so counts/bytes reflect one program execution instead of
+    one program text."""
+    mults = _comp_multipliers(hlo_text) if per_execution else None
     acc = {}
+    comp = None
     for line in hlo_text.splitlines():
+        h = _COMP_RE.match(line)
+        if h is not None:
+            comp = h.group(1)
+            continue
         m = _OP_RE.search(line)
         if m is None:
             continue
@@ -108,8 +173,9 @@ def collective_stats(hlo_text, mesh=None):
         key = (op, axis)
         slot = acc.setdefault(key, {"op": op, "axis": axis, "count": 0,
                                     "bytes": 0})
-        slot["count"] += 1
-        slot["bytes"] += nbytes
+        weight = mults.get(comp, 1) if mults is not None else 1
+        slot["count"] += weight
+        slot["bytes"] += nbytes * weight
     return sorted(acc.values(), key=lambda s: -s["bytes"])
 
 

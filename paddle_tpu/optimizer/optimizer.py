@@ -57,44 +57,49 @@ class _FlatSlot:
     per-param row segments — a giant 1-D buffer provokes pathological
     re-tiling on TPU (observed: [55M, 2] padded 64x to 28 GB)."""
 
-    __slots__ = ("store", "row_off", "n_rows", "size", "shape")
+    __slots__ = ("store", "row_off", "n_rows", "size", "shape", "out_dtype")
 
-    def __init__(self, store, row_off, n_rows, size, shape):
+    def __init__(self, store, row_off, n_rows, size, shape, out_dtype=None):
         self.store = store
         self.row_off = row_off
         self.n_rows = n_rows
         self.size = size
         self.shape = shape
+        self.out_dtype = out_dtype
 
     @property
     def _value(self):
         buf = self.store.tensor._value
         rows = jax.lax.dynamic_slice(buf, (self.row_off, 0),
                                      (self.n_rows, _FLAT_LANES))
-        return rows.reshape(-1)[:self.size].reshape(self.shape)
+        out = rows.reshape(-1)[:self.size].reshape(self.shape)
+        if self.out_dtype is not None and out.dtype != self.out_dtype:
+            out = out.astype(self.out_dtype)
+        return out
 
     @_value.setter
     def _value(self, new):
         self.store.pending.append((self, new))
 
     def set_value(self, value):
-        self.store.pending.append((self, jnp.asarray(value, jnp.float32)))
+        self.store.pending.append((self, jnp.asarray(value)))
         self.store.flush()
 
 
 class _FlatStore:
-    """One [rows, 1024] f32 buffer per accumulator slot name. ``pad_rows``
-    appends zero rows so the row count divides the ZeRO shard degree (each
-    rank then owns a contiguous, equally-sized row range)."""
+    """One [rows, 1024] buffer per accumulator slot name (f32 for
+    moments/masters; ZeRO-3 parameter stores keep the params' own dtype).
+    ``pad_rows`` appends zero rows so the row count divides the ZeRO shard
+    degree (each rank then owns a contiguous, equally-sized row range)."""
 
-    def __init__(self, fills, pad_rows=0):
+    def __init__(self, fills, pad_rows=0, dtype=jnp.float32):
         assert fills, "a flat store always covers at least one param"
         rows = []
         for n_rows, size, fill in fills:
-            seg = jnp.full((n_rows * _FLAT_LANES,), fill, jnp.float32)
+            seg = jnp.full((n_rows * _FLAT_LANES,), fill, dtype)
             rows.append(seg.reshape(n_rows, _FLAT_LANES))
         if pad_rows:
-            rows.append(jnp.zeros((pad_rows, _FLAT_LANES), jnp.float32))
+            rows.append(jnp.zeros((pad_rows, _FLAT_LANES), dtype))
         self.tensor = Tensor(jnp.concatenate(rows))
         self.tensor.persistable = True
         self.tensor._mark_stateful()
@@ -113,6 +118,16 @@ class _FlatStore:
             buf = jax.lax.dynamic_update_slice(
                 buf, flat.reshape(view.n_rows, _FLAT_LANES),
                 (view.row_off, 0))
+        if (self.tensor.pspec is not None
+                and not isinstance(buf, jax.core.Tracer)):
+            # eager write into a mesh-resident sharded store: keep the
+            # 1/degree layout instead of letting the update replicate it
+            from ..distributed import parallel_env
+            mesh = parallel_env.current_mesh()
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+                buf = jax.device_put(
+                    buf, NamedSharding(mesh, self.tensor.pspec))
         self.tensor._value = buf
         self.pending = []
 
@@ -128,7 +143,8 @@ class _ZeroBucket:
     exactly with its shard of the bucket's moment/master stores."""
 
     __slots__ = ("index", "params", "sizes", "shapes", "n_rows", "row_offs",
-                 "rows", "pad_rows", "degree", "has_master")
+                 "rows", "pad_rows", "degree", "has_master", "param_dtype",
+                 "l2_rows", "l1_rows", "lr_rows")
 
     def __init__(self, index, params, degree):
         self.index = index
@@ -136,6 +152,10 @@ class _ZeroBucket:
         self.degree = max(int(degree), 1)
         self.sizes, self.shapes, self.n_rows, self.row_offs = [], [], [], []
         self.has_master = False
+        self.param_dtype = None  # stage-3 flat param store dtype
+        self.l2_rows = None  # [rows,1] decay coeff per segment (or None)
+        self.l1_rows = None
+        self.lr_rows = None  # [rows,1] per-param lr scale (or None)
         off = 0
         for p in self.params:
             shape = tuple(p._value.shape)
@@ -157,17 +177,20 @@ class _ZeroBucket:
         """_FlatStore fill spec covering this bucket's param segments."""
         return [(n, s, fill) for n, s in zip(self.n_rows, self.sizes)]
 
-    def flatten(self, vals):
-        """Per-param f32 arrays -> the [rows, 1024] bucket layout."""
+    def flatten(self, vals, dtype=jnp.float32):
+        """Per-param arrays -> the [rows, 1024] bucket layout in ``dtype``
+        (f32 for gradients/moments, the param dtype for stage-3 stores)."""
         segs = []
         for v, n_rows, size in zip(vals, self.n_rows, self.sizes):
             flat = jnp.ravel(v)
+            if flat.dtype != dtype:
+                flat = flat.astype(dtype)
             pad = n_rows * _FLAT_LANES - size
             if pad:
-                flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+                flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
             segs.append(flat.reshape(n_rows, _FLAT_LANES))
         if self.pad_rows:
-            segs.append(jnp.zeros((self.pad_rows, _FLAT_LANES), jnp.float32))
+            segs.append(jnp.zeros((self.pad_rows, _FLAT_LANES), dtype))
         return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
 
     def unflatten(self, rows):
@@ -218,6 +241,57 @@ class _Box:
 
     def __init__(self, value):
         self._value = value
+
+
+_MISSING = object()
+_ZERO3_CLASSES = {}
+
+
+def _zero3_class(cls):
+    """Subclass of a parameter class whose ``_value`` is a property over a
+    ZeRO-3 flat-store row segment. Inside a traced step, reads return the
+    just-in-time materialized (all_gathered) value the step hook installed
+    and writes stage a per-trace override; eagerly, reads slice the
+    sharded store on demand — no full-size parameter buffer stays
+    resident — and writes go through to the store rows. Instances are
+    converted in place (``__class__`` reassignment), so every existing
+    reference — layer attributes, optimizer param groups, state_dict
+    walks — sees the sharded layout without relinking."""
+    sub = _ZERO3_CLASSES.get(cls)
+    if sub is not None:
+        return sub
+
+    class _Zero3Param(cls):
+        @property
+        def _value(self):
+            d = self.__dict__
+            ov = d.get("_zero3_ov", _MISSING)
+            if ov is not _MISSING:
+                return ov
+            lazy = d.get("_zero3_lazy")
+            if lazy is not None:
+                # first in-trace read of this bucket: gather it and
+                # install overrides for every param it covers
+                lazy()
+                return d["_zero3_ov"]
+            return d["_zero3_slot"]._value
+
+        @_value.setter
+        def _value(self, new):
+            from ..jit.to_static import in_tracing
+            if in_tracing():
+                self.__dict__["_zero3_ov"] = new
+            else:
+                self.__dict__.pop("_zero3_ov", None)
+                self.__dict__.pop("_zero3_lazy", None)
+                slot = self.__dict__["_zero3_slot"]
+                slot.store.pending.append((slot, new))
+                slot.store.flush()
+
+    _Zero3Param.__name__ = cls.__name__
+    _Zero3Param.__qualname__ = cls.__qualname__
+    _ZERO3_CLASSES[cls] = _Zero3Param
+    return _Zero3Param
 
 
 class Optimizer:
@@ -341,6 +415,10 @@ class Optimizer:
             yield from group["params"]
 
     def clear_grad(self, set_to_zero=False):
+        from ..distributed import parallel_env
+        acc = parallel_env.current_accum()
+        if acc is not None and acc[0] == "accum":
+            return  # accumulation window: @GRAD survives the micro step
         for p in self._parameters():
             p._grad = None
 
@@ -368,24 +446,36 @@ class Optimizer:
     # -- ZeRO-1/2 sharded step --------------------------------------------
     def _zero_enable(self, axis=None, mesh=None, stage=1,
                      comm_buffer_mb=None, last_comm_buffer_mb=None):
-        """Partition this optimizer's state for ZeRO-1/2 data parallelism
-        over one mesh axis: moments (and fp32 masters under
-        multi_precision) move into per-bucket flat [rows, 1024] stores
-        sharded 1/degree per rank (PartitionSpec(axis, None)); ``step()``
-        switches to the sharded update — bucketed psum_scatter gradient
-        reduction, shard-local update math, all_gather of refreshed
-        params. Buckets are sized from ``comm_buffer_mb`` (the
-        DataParallel ``comm_buffer_size`` knob) so the reduction of
-        bucket i can overlap the backward compute of bucket i+1.
+        """Partition this optimizer's state for ZeRO data parallelism over
+        one mesh axis: moments (and fp32 masters under multi_precision)
+        move into per-bucket flat [rows, 1024] stores sharded 1/degree per
+        rank (PartitionSpec(axis, None)); ``step()`` switches to the
+        sharded update — bucketed psum_scatter gradient reduction,
+        shard-local update math (global-norm/value grad clipping, decay
+        and per-param lr scales applied on the flat shard views),
+        all_gather of refreshed params. Buckets are sized from
+        ``comm_buffer_mb`` (the DataParallel ``comm_buffer_size`` knob) so
+        the reduction of bucket i can overlap the backward compute of
+        bucket i+1.
 
-        stage 1 vs 2 differ only in gradient lifetime: both reduce via
+        Stages: 1 and 2 differ only in gradient lifetime — both reduce via
         psum_scatter, but stage 2 frees (clears) each param's full
         gradient the moment its bucket shard is consumed, so no full
-        gradient outlives the update. Returns the number of accumulator
-        views sharded."""
+        gradient outlives the update. Stage 3 additionally moves the
+        PARAMETERS into per-bucket flat stores sharded 1/degree (their own
+        dtype; fp32 only for mixed-dtype buckets): the live ``Parameter``
+        objects become views, full values are materialized just-in-time
+        inside the compiled step by a per-bucket ``all_gather`` before the
+        forward pass and dropped after the body, and the update writes
+        back only the local shard rows — per-chip param + optimizer HBM is
+        O(params/degree). Stages 2/3 also allocate a sharded per-bucket
+        gradient accumulator ridden by ``to_static(accumulate_steps=a)``
+        windows. Returns the number of accumulator views sharded."""
         from jax.sharding import PartitionSpec
         from ..core import state as state_mod
         from ..distributed import bucketing, parallel_env
+        from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+        from ..regularizer import L1Decay, L2Decay
         if self._zero is not None:
             same = (axis in (None, self._zero["axis"])
                     and int(stage) == self._zero["stage"]
@@ -405,12 +495,17 @@ class Optimizer:
             raise NotImplementedError(
                 f"{type(self).__name__} has a non-elementwise update "
                 "(norm/trust-ratio or RNG terms) and cannot run sharded; "
-                "ZeRO supports SGD/Momentum/Adam/AdamW-family optimizers")
-        if self._grad_clip is not None:
+                "ZeRO supports SGD/Momentum/Adam/AdamW-family optimizers "
+                "(per-tensor-norm optimizers stay out of scope of ISSUE 5: "
+                "ZeRO-3 parameter sharding)")
+        if self._grad_clip is not None and not isinstance(
+                self._grad_clip, (ClipGradByGlobalNorm, ClipGradByValue)):
             raise NotImplementedError(
-                "ZeRO sharded step does not compose with grad_clip yet "
-                "(the global norm spans every shard); clip before "
-                "assigning gradients or disable sharding")
+                f"{type(self._grad_clip).__name__} needs per-parameter "
+                "norms, which a flat bucket shard cannot reassemble; ZeRO "
+                "composes with ClipGradByGlobalNorm (psum of per-shard "
+                "square sums) and ClipGradByValue (elementwise) — "
+                "per-tensor-norm clip stays out of scope of ISSUE 5")
         mesh = mesh if mesh is not None else parallel_env.current_mesh()
         if mesh is None:
             raise RuntimeError(
@@ -419,24 +514,19 @@ class Optimizer:
         axis = axis or "dp"
         if axis not in mesh.axis_names:
             raise ValueError(f"mesh {mesh.axis_names} has no axis {axis!r}")
-        if int(stage) not in (1, 2):
-            raise ValueError(f"ZeRO stage must be 1 or 2, got {stage}")
+        if int(stage) not in (1, 2, 3):
+            raise ValueError(f"ZeRO stage must be 1, 2 or 3, got {stage}")
         degree = parallel_env.axis_degree(mesh, axis)
         params = [p for p in self._parameters() if not p.stop_gradient]
         if not params:
             raise ValueError("ZeRO sharding needs trainable parameters")
         lp = (jnp.bfloat16, jnp.float16)
         for p in params:
-            if p.__dict__.get("optimize_attr", {}).get(
-                    "learning_rate", 1.0) != 1.0:
-                raise NotImplementedError(
-                    f"param {p.name} has a per-param lr scale; the flat "
-                    "sharded update applies one lr per bucket")
             if p.pspec is not None and any(s is not None for s in p.pspec):
                 raise NotImplementedError(
                     f"param {p.name} already carries layout {p.pspec}; "
-                    "ZeRO-1/2 shards the optimizer state of REPLICATED "
-                    "parameters (ZeRO-3/mp params are out of scope)")
+                    "ZeRO shards REPLICATED parameters (tensor-parallel "
+                    "params go through the GSPMD annotation path)")
         if comm_buffer_mb is None:
             comm_buffer_mb = bucketing.DEFAULT_COMM_BUFFER_MB
         pids = {id(p) for p in params}
@@ -448,18 +538,68 @@ class Optimizer:
                 state_mod.unregister(t._state_uid)
 
         buckets, stores = [], []
+        wd = self._weight_decay
         for bi, bparams in enumerate(bucketing.bucket_params(
                 params, comm_buffer_mb, last_comm_buffer_mb,
                 counter_prefix="zero")):
             zb = _ZeroBucket(bi, bparams, degree)
             zb.has_master = (bool(getattr(self, "_multi_precision", False))
                              and any(p._value.dtype in lp for p in bparams))
+            # flat-view row metadata: regularizer decay and per-param lr
+            # scales become [rows, 1] arrays over the row-aligned segments
+            # (padding rows: coeff 0 / scale 1) so the shard update can
+            # apply them elementwise, matching the per-param control
+            l2 = np.zeros((zb.rows, 1), np.float32)
+            l1 = np.zeros((zb.rows, 1), np.float32)
+            lrs = np.ones((zb.rows, 1), np.float32)
+            any_l2 = any_l1 = any_lr = False
+            for p, off, n in zip(zb.params, zb.row_offs, zb.n_rows):
+                reg = getattr(p, "regularizer", None) or wd
+                if isinstance(reg, L2Decay) and reg.coeff:
+                    l2[off:off + n] = reg.coeff
+                    any_l2 = True
+                elif isinstance(reg, L1Decay) and reg.coeff:
+                    l1[off:off + n] = reg.coeff
+                    any_l1 = True
+                elif isinstance(reg, float) and reg != 0.0:
+                    l2[off:off + n] = reg
+                    any_l2 = True
+                scale = p.__dict__.get("optimize_attr", {}).get(
+                    "learning_rate", 1.0)
+                if scale != 1.0:
+                    lrs[off:off + n] = scale
+                    any_lr = True
+            zb.l2_rows = l2 if any_l2 else None
+            zb.l1_rows = l1 if any_l1 else None
+            zb.lr_rows = lrs if any_lr else None
             sdict = {}
             for slot in slots + (["master"] if zb.has_master else []):
                 store = _FlatStore(zb.fills(), pad_rows=zb.pad_rows)
                 store.tensor.pspec = PartitionSpec(axis, None)
                 store.tensor.name = f"zero_{slot}_b{bi}"
                 sdict[slot] = store
+            if int(stage) >= 2:
+                # sharded window accumulator for to_static's
+                # accumulate_steps: micro-step mean shards fold in here so
+                # no full gradient survives a micro step. Zeros until an
+                # accumulation window runs; carry-optional so a
+                # non-accumulating step skipping it is not a hazard.
+                store = _FlatStore(zb.fills(0.0), pad_rows=zb.pad_rows)
+                store.tensor.pspec = PartitionSpec(axis, None)
+                store.tensor.name = f"zero_gacc_b{bi}"
+                store.tensor._carry_optional = True
+                sdict["gacc"] = store
+            if int(stage) == 3:
+                pdtypes = {p._value.dtype for p in bparams}
+                zb.param_dtype = (pdtypes.pop() if len(pdtypes) == 1
+                                  else jnp.dtype(jnp.float32))
+                store = _FlatStore(zb.fills(), pad_rows=zb.pad_rows,
+                                   dtype=zb.param_dtype)
+                store.tensor.pspec = PartitionSpec(axis, None)
+                store.tensor.name = f"zero_param_b{bi}"
+                store.tensor._value = zb.flatten(
+                    [p._value for p in bparams], dtype=zb.param_dtype)
+                sdict["param"] = store
             # migrate existing accumulator/master values into the sharded
             # views (warm restarts / loaded state survive the re-layout)
             for p, off, n_rows, size, shape in zip(
@@ -489,8 +629,29 @@ class Optimizer:
                 store.tensor._value = jax.device_put(
                     store.tensor._value,
                     NamedSharding(mesh, store.tensor.pspec))
+            if int(stage) == 3:
+                # convert the live Parameter objects into store views:
+                # drop the full replicated buffer (the HBM saving), swap
+                # in the view class, and take the params out of the
+                # framework-state registry — from here on the only
+                # parameter residency is the 1/degree flat store riding
+                # the compiled step's donated carry
+                for p, off, n_rows, size, shape in zip(
+                        zb.params, zb.row_offs, zb.n_rows, zb.sizes,
+                        zb.shapes):
+                    slot = _FlatSlot(sdict["param"], off, n_rows, size,
+                                     shape, out_dtype=p._value.dtype)
+                    if p._state_uid is not None:
+                        state_mod.unregister(p._state_uid)
+                        p._state_uid = None
+                    p.__dict__.pop("_value", None)
+                    p.__class__ = _zero3_class(type(p))
+                    p.__dict__["_zero3_slot"] = slot
             buckets.append(zb)
             stores.append(sdict)
+        if int(stage) == 3:
+            from ..jit.to_static import register_step_hook
+            register_step_hook(self._zero3_materialize)
         for store in self._flat_stores.values():  # superseded fused stores
             _drop(store.tensor)
         self._flat_stores = {}
@@ -516,8 +677,10 @@ class Optimizer:
                 int(np.prod(s.tensor._value.shape))
                 * s.tensor._value.dtype.itemsize
                 for s in self._flat_stores.values())
-        return sum(zb.shard_rows * _FLAT_LANES * 4 * len(sdict)
-                   for zb, sdict in zip(cfg["buckets"], cfg["stores"]))
+        return sum(zb.shard_rows * _FLAT_LANES
+                   * np.dtype(sd.tensor._value.dtype).itemsize
+                   for zb, sdict in zip(cfg["buckets"], cfg["stores"])
+                   for sd in sdict.values())
 
     def _reduce_dp_grads(self, axis):
         """The replicated (non-ZeRO) control under a manual dp axis: one
@@ -540,18 +703,144 @@ class Optimizer:
                 g = jax.lax.pmean(g, axis)
             p._grad = g
 
+    def _zero3_materialize(self):
+        """to_static step hook (registered at stage-3 enable): arm LAZY
+        just-in-time parameter materialization — the first in-trace read
+        of any param in a bucket triggers one ``all_gather`` of that
+        bucket's sharded flat store and installs full-value overrides for
+        every param it covers, consumed by forward/backward and dropped
+        when the step body ends. Laziness keeps unrelated programs free:
+        a trace that never touches this model's params issues no gathers
+        and never reads the stores (they stay skipped state instead of
+        being threaded into someone else's compiled step). The gathered
+        full parameters exist only inside the step; the donated carry
+        holds 1/degree shards."""
+        from ..distributed import parallel_env
+        cfg = self._zero
+        if cfg is None or cfg["stage"] != 3:
+            return None
+        axis, degree = cfg["axis"], cfg["degree"]
+
+        def make_gather(zb, sdict):
+            def gather():
+                dp_mode = parallel_env.current_dp_axis() == axis
+                bound = dp_mode and parallel_env.axis_bound(axis)
+                shard = sdict["param"].tensor._value
+                if bound:
+                    full = jax.lax.all_gather(shard, axis, axis=0,
+                                              tiled=True)
+                elif dp_mode:
+                    # abstract analysis trace: shape-only stand-in
+                    full = jnp.concatenate([shard] * degree, axis=0)
+                else:
+                    # GSPMD/eager: the store tracer/array is global
+                    full = shard
+                for p, seg in zip(zb.params, zb.unflatten(full)):
+                    slot = p.__dict__["_zero3_slot"]
+                    if (slot.out_dtype is not None
+                            and seg.dtype != slot.out_dtype):
+                        seg = seg.astype(slot.out_dtype)
+                    p.__dict__["_zero3_ov"] = seg
+            return gather
+
+        touched = []
+        for zb, sdict in zip(cfg["buckets"], cfg["stores"]):
+            gather = make_gather(zb, sdict)
+            for p in zb.params:
+                p.__dict__["_zero3_lazy"] = gather
+                touched.append(p)
+
+        def cleanup():
+            for p in touched:
+                p.__dict__.pop("_zero3_ov", None)
+                p.__dict__.pop("_zero3_lazy", None)
+        return cleanup
+
+    def _zero_reduced_shard(self, zb, axis, degree, bound, dp_mode,
+                            constrain=None):
+        """One bucket's gradient reduction, shared by the boundary step
+        and the accumulation fold (they MUST agree on these semantics):
+        flatten the current per-param grads (f32; zeros for absent) into
+        the bucket layout and hand back this rank's mean-reduced
+        [rows/degree, 1024] shard plus the per-param presence flags."""
+        from ..core.selected_rows import SelectedRows
+        vals, present = [], []
+        for p, shape in zip(zb.params, zb.shapes):
+            g = p._grad
+            if isinstance(g, SelectedRows):
+                raise NotImplementedError(
+                    "ZeRO sharded step does not support sparse "
+                    "(SelectedRows) gradients (out of scope of ISSUE 5: "
+                    "ZeRO-3 parameter sharding)")
+            present.append(g is not None)
+            if g is None:
+                g = jnp.zeros(shape, jnp.float32)
+            elif g.dtype != jnp.float32:
+                g = g.astype(jnp.float32)
+            vals.append(g)
+        gfull = zb.flatten(vals)
+        if bound:
+            gred = jax.lax.psum_scatter(
+                gfull, axis, scatter_dimension=0, tiled=True) / degree
+        elif dp_mode:
+            # abstract analysis trace: rank-0-shaped stand-in
+            gred = zb.shard_of(gfull, axis, bound=False) / degree
+        else:
+            # GSPMD/eager world: gradients are already globally reduced;
+            # the constraint shards the update compute (and lets the
+            # partitioner fold the grad all-reduce into a reduce-scatter
+            # on backends that support it)
+            gred = constrain(gfull)
+        return gred, present
+
+    def _zero_accum_fold(self):
+        """A non-boundary micro step of a ``to_static(accumulate_steps=a)``
+        window. Stage 1 returns immediately: the full local gradients keep
+        accumulating on the params through the scan carry and the single
+        bucketed reduction fires at the window boundary (collective bytes
+        per optimizer step drop ~a×). Stages 2/3 instead reduce the micro
+        gradient now (one psum_scatter per bucket) and fold the mean shard
+        into the sharded ``gacc`` window accumulator, so no full gradient
+        outlives its micro step — the DeepSpeed-style trade of per-micro
+        reduction traffic for 1/degree accumulation memory."""
+        from .. import monitor
+        from ..distributed import parallel_env
+        cfg = self._zero
+        monitor.stat_add("zero_accum_steps")
+        if cfg["stage"] < 2:
+            return
+        axis, degree = cfg["axis"], cfg["degree"]
+        if parallel_env.current_dp_axis() != axis:
+            raise NotImplementedError(
+                "ZeRO stage>=2 gradient accumulation runs inside the "
+                "dp-sharded scan step (to_static(..., scan_steps=k, "
+                f"dp_axis={axis!r}, accumulate_steps=a))")
+        bound = parallel_env.axis_bound(axis)
+        for zb, sdict in zip(cfg["buckets"], cfg["stores"]):
+            gred, _present = self._zero_reduced_shard(
+                zb, axis, degree, bound, dp_mode=True)
+            sdict["gacc"].tensor._value = \
+                sdict["gacc"].tensor._value + gred
+            for p in zb.params:
+                p._grad = None
+
     def _zero_step(self):
         """The sharded update: per bucket, psum_scatter the flat gradient
         (each rank keeps the mean-reduced [rows/degree, 1024] shard),
-        run the optimizer's elementwise update on that shard against the
-        sharded moment/master stores, and all_gather the refreshed
-        parameters back to every rank. Elementwise math on a shard equals
-        elementwise math on the whole, so losses and params match the
-        replicated control bit-for-bit."""
+        clip/decay/scale it on the shard, run the optimizer's elementwise
+        update against the sharded moment/master stores, and publish the
+        refreshed parameters — stage 1/2 ``all_gather`` them back into
+        every rank's full params, stage 3 writes only the local rows of
+        the sharded param store (the next step's hook re-gathers).
+        Elementwise math on a shard equals elementwise math on the whole,
+        so losses and params match the replicated control bit-for-bit;
+        the global-norm clip scale is a psum of per-shard square sums
+        (summation order differs from the per-param control by design —
+        parity there is tolerance-level, not bitwise)."""
         from jax.sharding import NamedSharding, PartitionSpec
         from .. import monitor
-        from ..core.selected_rows import SelectedRows
         from ..distributed import parallel_env
+        from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
         cfg = self._zero
         axis, degree, stage = cfg["axis"], cfg["degree"], cfg["stage"]
         mesh = cfg["mesh"]
@@ -562,13 +851,12 @@ class Optimizer:
                 f"binds dp axis {cur!r}")
         dp_mode = cur == axis  # manual-axis (shard_map) trace, local shapes
         bound = dp_mode and parallel_env.axis_bound(axis)
+        acc = parallel_env.current_accum()
+        accum_a = int(acc[1]) if acc is not None else 1
+        use_gacc = stage >= 2 and acc is not None
         scaler_pending = cfg.pop("pending_scaler", False)
         pending_found = cfg.pop("pending_found", None)
-        for p in self._parameters():
-            if isinstance(p._grad, SelectedRows):
-                raise NotImplementedError(
-                    "ZeRO sharded step does not support sparse "
-                    "(SelectedRows) gradients")
+        pending_inv_scale = cfg.pop("pending_inv_scale", None)
         prev_step = self._step_count._value
         self._step_count._value = prev_step + 1
         lr = self._lr.value()
@@ -582,39 +870,47 @@ class Optimizer:
                 return jax.lax.with_sharding_constraint(v, spec)
             return jax.device_put(v, spec)
 
+        def _shard_rows(arr, zb):
+            """Localize a [rows, 1] numpy row-metadata array."""
+            v = jnp.asarray(arr)
+            return zb.shard_of(v, axis, bound) if dp_mode else v
+
+        clip = self._grad_clip
         # pass 1: reduce every bucket (the collectives issue back-to-back
         # so XLA can overlap bucket i's reduction with bucket i+1's
-        # producers), tracking grad presence and shard finiteness
-        reduced, all_ok = [], None
-        for zb in cfg["buckets"]:
-            vals, present = [], []
-            for p in zb.params:
-                g = p._grad
-                present.append(g is not None)
-                if g is None:
-                    g = jnp.zeros(tuple(p._value.shape), jnp.float32)
-                else:
-                    if g.dtype != jnp.float32:
-                        g = g.astype(jnp.float32)
-                    g = self._decayed_grad(p, g)
-                vals.append(g)
-            gfull = zb.flatten(vals)
-            if bound:
-                gred = jax.lax.psum_scatter(
-                    gfull, axis, scatter_dimension=0, tiled=True) / degree
-            elif dp_mode:
-                # abstract analysis trace: rank-0-shaped stand-in
-                gred = zb.shard_of(gfull, axis, bound=False) / degree
-            else:
-                # GSPMD/eager world: gradients are already globally
-                # reduced; the constraint shards the update compute (and
-                # lets the partitioner fold the grad all-reduce into a
-                # reduce-scatter on backends that support it)
-                gred = _constrain(gfull, shard_spec)
+        # producers), fold in the accumulation window, track grad
+        # presence, shard finiteness and the global-norm square sums
+        reduced, all_ok, sq_sum = [], None, None
+        for zb, sdict in zip(cfg["buckets"], cfg["stores"]):
+            gred, present = self._zero_reduced_shard(
+                zb, axis, degree, bound, dp_mode,
+                constrain=lambda v: _constrain(v, shard_spec))
+            if use_gacc:
+                gacc = sdict["gacc"].tensor._value
+                if not dp_mode:
+                    gacc = _constrain(gacc, shard_spec)
+                gred = gred + gacc
+            if pending_inv_scale is not None:
+                # stage-2/3 windows accumulated SCALED mean-shards; the
+                # scaler deferred the whole-window unscale to this shard
+                gred = gred * pending_inv_scale
+            if accum_a > 1:
+                gred = gred / accum_a
             if scaler_pending and pending_found is None:
                 ok = jnp.all(jnp.isfinite(gred))
                 all_ok = ok if all_ok is None else (all_ok & ok)
+            if isinstance(clip, ClipGradByGlobalNorm):
+                s = jnp.sum(jnp.square(gred))
+                sq_sum = s if sq_sum is None else sq_sum + s
             reduced.append((gred, present))
+
+        clip_scale = None
+        if sq_sum is not None:
+            if bound:  # each rank holds 1/degree of the rows: psum completes
+                sq_sum = jax.lax.psum(sq_sum, axis)
+            global_norm = jnp.sqrt(sq_sum)
+            clip_scale = clip.clip_norm / jnp.maximum(global_norm,
+                                                      clip.clip_norm)
 
         found_inf = None
         if scaler_pending:
@@ -628,11 +924,28 @@ class Optimizer:
             self._step_count._value = jnp.where(found_inf, prev_step,
                                                 self._step_count._value)
 
-        # pass 2: shard-local update + param all_gather per bucket
+        # pass 2: shard-local clip/decay + update, then publish params
         n_bytes = 0
         for zb, sdict, (gred, present) in zip(cfg["buckets"], cfg["stores"],
                                               reduced):
-            if zb.has_master:
+            if clip_scale is not None:
+                gred = gred * clip_scale
+            elif isinstance(clip, ClipGradByValue):
+                gred = jnp.clip(gred, clip.min, clip.max)
+            if stage == 3:
+                pstore = sdict["param"]
+                pshard = pstore.tensor._value
+                if not dp_mode:
+                    pshard = _constrain(pshard, shard_spec)
+                if zb.has_master:
+                    psrc = sdict["master"].tensor._value
+                    if not dp_mode:
+                        psrc = _constrain(psrc, shard_spec)
+                elif pshard.dtype != jnp.float32:
+                    psrc = pshard.astype(jnp.float32)
+                else:
+                    psrc = pshard
+            elif zb.has_master:
                 psrc = sdict["master"].tensor._value
                 if not dp_mode:
                     psrc = _constrain(psrc, shard_spec)
@@ -642,6 +955,15 @@ class Optimizer:
                                     else p._value for p in zb.params])
                 psrc = (zb.shard_of(pfull, axis, bound) if dp_mode
                         else _constrain(pfull, shard_spec))
+            # regularizer-style decay on the shard, AFTER clipping (the
+            # per-param control's order: reduce -> clip -> decay -> update)
+            if zb.l2_rows is not None:
+                gred = gred + _shard_rows(zb.l2_rows, zb) * psrc
+            if zb.l1_rows is not None:
+                gred = gred + _shard_rows(zb.l1_rows, zb) * jnp.sign(psrc)
+            lr_b = lr
+            if zb.lr_rows is not None:
+                lr_b = lr * _shard_rows(zb.lr_rows, zb)
             dmask = None
             if getattr(self, "_decay_fn", None) is not None:
                 dm = zb.row_mask([self._decay_fn(p.name)
@@ -658,7 +980,7 @@ class Optimizer:
                                               shard_spec))
                 self._accumulators[(slot, id(view))] = boxes[slot]
             try:
-                new_p = self._apply_one(view, gred, lr)
+                new_p = self._apply_one(view, gred, lr_b)
             finally:
                 for slot in cfg["slots"]:
                     del self._accumulators[(slot, id(view))]
@@ -693,24 +1015,46 @@ class Optimizer:
             if zb.has_master:
                 sdict["master"].tensor._value = (
                     new_p if dp_mode else _constrain(new_p, shard_spec))
-            if bound:
-                full_new = jax.lax.all_gather(new_p, axis, axis=0,
-                                              tiled=True)
-            elif dp_mode:  # analysis stand-in: shape only
-                full_new = jnp.concatenate([new_p] * degree, axis=0)
-            else:
-                full_new = _constrain(new_p, repl_spec)
-            for p, seg in zip(zb.params, zb.unflatten(full_new)):
-                # found_inf already gated new_p shard-side: on overflow
-                # the gathered rows reassemble the pre-step values
-                p._value = (seg.astype(p._value.dtype)
-                            if seg.dtype != p._value.dtype else seg)
-                if stage >= 2 or dp_mode:
-                    # stage 2: no full gradient outlives its bucket. Any
-                    # stage under a manual dp axis: the un-reduced LOCAL
-                    # grads must never escape the step (they are
-                    # rank-divergent and would poison a replicated carry)
+            if use_gacc:
+                # the window is consumed: next window accumulates from
+                # zeros (overflow steps too — the reference SkipUpdate
+                # drops the window's gradients with the update)
+                z = jnp.zeros_like(sdict["gacc"].tensor._value)
+                sdict["gacc"].tensor._value = (
+                    z if dp_mode else _constrain(z, shard_spec))
+            if stage == 3:
+                # no re-gather: the refreshed rows stay sharded in the
+                # param store (the next step's materialize hook gathers
+                # from the carried shard) — full params never re-enter
+                # the carry
+                new_store = (new_p if new_p.dtype == pstore.tensor.dtype
+                             else new_p.astype(pstore.tensor.dtype))
+                pstore.tensor._value = (
+                    new_store if dp_mode
+                    else _constrain(new_store, shard_spec))
+                for p in zb.params:
                     p._grad = None
+            else:
+                if bound:
+                    full_new = jax.lax.all_gather(new_p, axis, axis=0,
+                                                  tiled=True)
+                elif dp_mode:  # analysis stand-in: shape only
+                    full_new = jnp.concatenate([new_p] * degree, axis=0)
+                else:
+                    full_new = _constrain(new_p, repl_spec)
+                for p, seg in zip(zb.params, zb.unflatten(full_new)):
+                    # found_inf already gated new_p shard-side: on
+                    # overflow the gathered rows reassemble the pre-step
+                    # values
+                    p._value = (seg.astype(p._value.dtype)
+                                if seg.dtype != p._value.dtype else seg)
+                    if stage >= 2 or dp_mode:
+                        # stage 2: no full gradient outlives its bucket.
+                        # Any stage under a manual dp axis: the un-reduced
+                        # LOCAL grads must never escape the step (they are
+                        # rank-divergent and would poison a replicated
+                        # carry)
+                        p._grad = None
             n_bytes += zb.rows * _FLAT_LANES * 4
         monitor.stat_add("zero_steps")
         monitor.stat_add("zero_reduced_bytes", n_bytes)
@@ -719,14 +1063,31 @@ class Optimizer:
 
     def step(self):
         from ..distributed import parallel_env
+        acc = parallel_env.current_accum()
         if self._zero is not None:
+            if acc is not None and acc[0] == "accum":
+                return self._zero_accum_fold()
             return self._zero_step()
+        if acc is not None and acc[0] == "accum":
+            # non-boundary micro step of an accumulation window: backward
+            # keeps summing into p._grad through the scan carry; the
+            # update fires once at the window boundary
+            return
         dp_axis = parallel_env.current_dp_axis()
         if dp_axis is not None:
             self._reduce_dp_grads(dp_axis)
         from ..core.selected_rows import SelectedRows
         params_grads = [(p, p._grad) for p in self._parameters()
                         if not p.stop_gradient and p._grad is not None]
+        if acc is not None and acc[1] > 1:
+            # window boundary: the carried gradients are sums of a
+            # micro-batch means — scale to the big-batch mean BEFORE
+            # clipping (same order as the sharded path)
+            a = acc[1]
+            params_grads = [
+                (p, SelectedRows(g.rows, g.values / a, g.height)
+                 if isinstance(g, SelectedRows) else g / a)
+                for p, g in params_grads]
         if self._grad_clip is not None:
             # sparse grads participate: they contribute their row values to
             # the global norm and get scaled as SelectedRows
