@@ -371,6 +371,83 @@ def bench_hbm_cache():
         srv.stop()
 
 
+def bench_serving():
+    """Serving-engine smoke: concurrent ragged-batch traffic through the
+    bucketed-AOT engine (paddle_tpu/serving/) over a saved StableHLO
+    artifact. Reports served qps/chip plus the p50/p95/p99 request-latency
+    summary the SLO telemetry exports — the serve-heavy-traffic half of
+    the north star, gated like the training rows (presence-only on CPU)."""
+    import tempfile
+    import threading
+
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.serving as serving
+    from paddle_tpu.jit.io import save as jit_save
+    from paddle_tpu.jit.to_static import InputSpec
+    from paddle_tpu.observability import export as obs_export
+
+    backend = jax.default_backend()
+    on_tpu = backend != "cpu"
+    if on_tpu:
+        feat, hidden, ladder = 256, 1024, (1, 8, 32, 128)
+        clients, reqs_per_client = 16, 40
+    else:
+        feat, hidden, ladder = 16, 32, (1, 4, 16)
+        clients, reqs_per_client = 8, 15
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(feat, hidden), nn.ReLU(),
+                          nn.Linear(hidden, hidden), nn.ReLU(),
+                          nn.Linear(hidden, 8))
+    model.eval()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "m")
+        jit_save(model, prefix,
+                 input_spec=[InputSpec([None, feat], "float32")])
+        engine = serving.Engine(prefix, bucket_ladder=ladder,
+                                batch_timeout_ms=1.0)
+    try:
+        rng = np.random.RandomState(0)
+        sizes = [1, 2, 3, 5, 8]
+        batches = [rng.rand(s, feat).astype(np.float32) for s in sizes]
+        for b in batches:  # warmup: request path must be compile-free
+            engine.predict(b)
+        obs_export.clear_summaries()  # in-place reset: warmup excluded,
+        # the engine's cached board handles stay registered
+
+        def client(seed):
+            r = np.random.RandomState(seed)
+            for _ in range(reqs_per_client):
+                engine.predict(batches[r.randint(len(batches))])
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        stats = engine.stats()
+    finally:
+        engine.close()
+    n_req = clients * reqs_per_client
+    lat = obs_export.summaries().get("serving_latency_ms", {})
+    return {"metric": "serving_mlp_qps_per_chip",
+            "value": round(n_req / dt, 1), "unit": "req/s",
+            "backend": backend,
+            "p50_ms": round(lat.get("p50", float("nan")), 3),
+            "p95_ms": round(lat.get("p95", float("nan")), 3),
+            "p99_ms": round(lat.get("p99", float("nan")), 3),
+            "bucket_ladder": list(ladder),
+            "aot_compiles": stats["aot_compiles"],
+            "batches": stats["batches"],
+            "multi_request_batches": stats["multi_request_batches"],
+            "clients": clients}
+
+
 def bench_bert():
     """Config 3: the flagship BERT pretraining step — bench.py run as a
     subprocess (it owns program structure, OOM fallback and timing) with
@@ -384,7 +461,8 @@ def bench_bert():
 
 BENCHES = {"resnet": bench_resnet50, "gpt": bench_gpt_sharding_pp,
            "allreduce": bench_allreduce, "detection": bench_detection,
-           "hbm_cache": bench_hbm_cache, "bert": bench_bert}
+           "hbm_cache": bench_hbm_cache, "serving": bench_serving,
+           "bert": bench_bert}
 
 
 def run_benches(configs):
@@ -412,8 +490,8 @@ DEFAULT_BASELINE = os.path.join(
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs",
-                    default="resnet,gpt,allreduce,detection,hbm_cache,bert")
+    ap.add_argument("--configs", default="resnet,gpt,allreduce,detection,"
+                    "hbm_cache,serving,bert")
     ap.add_argument("--out", help="write the run's records as a JSON file")
     ap.add_argument("--results", help="gate a previously recorded results "
                     "JSON instead of running the ladder")

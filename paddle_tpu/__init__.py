@@ -58,6 +58,7 @@ from . import static  # noqa: F401
 from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import incubate  # noqa: F401
 
 from . import profiler  # noqa: F401

@@ -218,12 +218,38 @@ def _zero3_ranks():
     return pairs
 
 
+def _serving_like():
+    """The serving engine's load-time pipeline over a dynamic-batch
+    forward program: eval clone → prune-to-fetch → bf16 weight/compute
+    cast (explicit leading ``cast`` ops, bf16 params). The optimized
+    program must verify as clean as its input — the engine refuses to
+    come up otherwise, so a dirty twin here means the serving pass
+    pipeline itself regressed."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+    from paddle_tpu.serving.passes import build_serving_program
+
+    prog = static.Program()
+    prog.random_seed = 0  # dropout records an RNG op: keep replays pinned
+    with static.program_guard(prog):
+        x = static.data("feat", [-1, 8], "float32")
+        w1 = static.create_parameter([8, 16], "float32")
+        w2 = static.create_parameter([16, 4], "float32")
+        h = nn.functional.relu(paddle.matmul(x, w1))
+        h = nn.functional.dropout(h, p=0.1, training=True)
+        logits = paddle.matmul(h, w2)
+        aux = paddle.mean(logits)  # unfetched: prune must slice it away
+    optimized = build_serving_program(prog, [logits], passes=("bf16",))
+    return [(prog, [logits, aux]), (optimized, [logits])]
+
+
 LADDER_BUILDERS = {
     "resnet": _resnet_like,
     "gpt": _gpt_like,
     "bert": _bert_like,
     "detection": _detection_like,
     "hbm_cache": _hbm_cache_like,
+    "serving": _serving_like,
     "allreduce": _allreduce_ranks,
     "zero1": _zero1_ranks,
     "zero3": _zero3_ranks,

@@ -9,6 +9,8 @@ needs only the `.pdmodel`/`.pdiparams` artifact pair written by
 — never the model's Python class (parity with `analysis_predictor.cc:389` Run,
 which serves from the serialized `__model__` alone).
 """
+import re
+
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -25,6 +27,24 @@ class Config:
         self._ir_optim = True
         self._memory_optim = False
         self._cpu_math_threads = 1
+        self._serving_cfg = None  # enable_serving_engine kwargs
+
+    def enable_serving_engine(self, **engine_kwargs):
+        """Route run() through a ``serving.Engine``: bucketed AOT
+        compilation at load + concurrent dynamic batching + SLO
+        telemetry. kwargs are forwarded to ``serving.Engine``
+        (``bucket_ladder``, ``batch_timeout_ms``, ``passes``, ...).
+
+        Each Predictor built from this config owns ONE engine (released
+        by ``Predictor.close()``). Engines are thread-safe: share a
+        single predictor across caller threads so their requests coalesce
+        into shared device steps — do NOT build a predictor per thread
+        (the reference's Clone-per-thread pattern is exactly what the
+        batching engine replaces). The reference analog is
+        `analysis_predictor.cc`'s prepare/optimize phase — load-time
+        compilation instead of per-shape re-trace."""
+        self._serving_cfg = dict(engine_kwargs)
+        return self
 
     # prog_file/params_file accessors (reference AnalysisConfig API)
     def prog_file(self):
@@ -81,6 +101,7 @@ class Predictor:
             if path and path.endswith(suffix):
                 path = path[: -len(suffix)]
         from ..jit.export import has_artifact, ServedProgram
+        self._layer = None
         if has_artifact(path, params_path=config.params_path):
             self._served = ServedProgram(path,
                                          params_path=config.params_path)
@@ -91,17 +112,50 @@ class Predictor:
             from ..jit.io import load as jit_load
             layer = jit_load(path)
             self._served = None
+            self._layer = layer
             self._input_names = getattr(layer, "input_names", None) or []
             self._output_names = getattr(layer, "output_names", None) or []
             self._runner = lambda *xs: _as_list(layer(*xs))
         self._inputs = {}
+        self._declared_shapes = {}  # name -> reshape()-declared shape
         self._outputs = None
+        self._engine = None
+        if getattr(config, "_serving_cfg", None) is not None:
+            self._engine = self.as_engine(**config._serving_cfg)
+            # the engine is authoritative for the served surface: an
+            # outputs= subset (prune-to-fetch) must be reflected here or
+            # get_output_handle would map names to wrong result indices
+            self._input_names = self._engine.input_names
+            self._output_names = self._engine.output_names
+
+    def as_engine(self, **engine_kwargs):
+        """Build a ``serving.Engine`` over this predictor's loaded model
+        (bucketed AOT compilation + concurrent batching + SLO telemetry).
+        Legacy pickled artifacts have no recorded input specs — pass
+        ``input_specs=[InputSpec(...)]`` for those."""
+        from ..serving import Engine
+        specs = engine_kwargs.pop("input_specs", None)
+        if self._served is not None:
+            if specs is not None:
+                import warnings
+                warnings.warn(
+                    "as_engine(input_specs=...) ignored: this StableHLO "
+                    "artifact records its own input specs", stacklevel=2)
+            return Engine(self._served, **engine_kwargs)
+        if specs is None:
+            raise ValueError(
+                "legacy artifacts carry no input specs; pass "
+                "as_engine(input_specs=[InputSpec([None, ...], dtype)]) "
+                "(StableHLO artifacts record them — re-save with "
+                "jit.save(..., input_spec=...))")
+        layer = getattr(self._layer, "_layer", self._layer)
+        return Engine.from_layer(layer, specs, **engine_kwargs)
 
     def get_input_names(self):
         return list(self._input_names)
 
     def get_input_handle(self, name):
-        return _IOHandle(self._inputs, name)
+        return _IOHandle(self._inputs, name, self._declared_shapes)
 
     def get_output_names(self):
         if self._output_names:
@@ -111,9 +165,29 @@ class Predictor:
             f"output_{i}" for i in range(len(self._outputs))]
 
     def get_output_handle(self, name):
-        if self._output_names and name in self._output_names:
-            return _OutHandle(self, self._output_names.index(name))
-        return _OutHandle(self, int(name.split("_")[-1]))
+        valid = self.get_output_names()
+        if self._output_names:
+            if name in self._output_names:
+                return _OutHandle(self, self._output_names.index(name))
+            # positional "output_<i>" stays accepted against artifacts
+            # with custom names (pre-existing caller convention) — but
+            # only when no real name uses that pattern, where positional
+            # aliasing would silently shadow a different output
+            m = re.fullmatch(r"output_(\d+)", name)
+            if m and int(m.group(1)) < len(self._output_names) and \
+                    not any(re.fullmatch(r"output_\d+", n)
+                            for n in self._output_names):
+                return _OutHandle(self, int(m.group(1)))
+            raise ValueError(
+                f"unknown output {name!r}; valid output names: {valid}")
+        # legacy positional naming: only well-formed "output_<i>" resolves
+        # (a typo used to die with a bare int() ValueError)
+        m = re.fullmatch(r"output_(\d+)", name)
+        if m is None or (self._outputs is not None
+                         and int(m.group(1)) >= len(self._outputs)):
+            raise ValueError(
+                f"unknown output {name!r}; valid output names: {valid}")
+        return _OutHandle(self, int(m.group(1)))
 
     def run(self, inputs=None):
         if inputs is None:
@@ -123,10 +197,32 @@ class Predictor:
                 raise ValueError(
                     f"missing inputs {missing}; expected {order}")
             inputs = [self._inputs[k] for k in order]
+        if self._engine is not None:
+            # serving-engine delegation: pad-to-bucket AOT executables +
+            # the concurrent batcher (other callers may share the step)
+            self._outputs = self._engine.predict(*inputs)
+            return self._outputs
         outs = self._runner(*[Tensor(np.asarray(x)) for x in inputs])
         self._outputs = [np.asarray(o._value if isinstance(o, Tensor) else o)
                          for o in _as_list(outs)]
         return self._outputs
+
+    def close(self):
+        """Release the delegated serving engine (batcher thread + compiled
+        executables), if one is attached. Long-lived processes that churn
+        Predictors must call this (or use the Predictor as a context
+        manager) — a discarded engine's worker thread never exits on its
+        own."""
+        if self._engine is not None:
+            self._engine.close()
+            self._engine = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 def _as_list(x):
@@ -136,15 +232,37 @@ def _as_list(x):
 
 
 class _IOHandle:
-    def __init__(self, store, name):
+    def __init__(self, store, name, declared=None):
         self.store = store
         self.name = name
+        # shared with the predictor so a later get_input_handle() call
+        # sees shapes declared through an earlier handle
+        self.declared = declared if declared is not None else {}
 
     def copy_from_cpu(self, arr):
-        self.store[self.name] = np.asarray(arr)
+        a = np.asarray(arr)
+        want = self.declared.get(self.name)
+        if want is not None and not _shape_matches(want, a.shape):
+            raise ValueError(
+                f"input {self.name!r}: fed array shape {tuple(a.shape)} "
+                f"does not match the shape {tuple(want)} declared via "
+                "reshape(); re-declare or feed a matching array")
+        self.store[self.name] = a
 
     def reshape(self, shape):
-        pass  # shapes come from the fed array
+        """Declare the input shape the next copy_from_cpu must match
+        (reference ZeroCopyTensor::Reshape semantics — it sizes the feed
+        buffer; here the array carries storage, so the declaration is
+        enforced instead of silently ignored). -1/None dims are
+        wildcards."""
+        self.declared[self.name] = tuple(shape)
+
+
+def _shape_matches(declared, got):
+    if len(declared) != len(got):
+        return False
+    return all(d in (None, -1) or int(d) == g
+               for d, g in zip(declared, got))
 
 
 class _OutHandle:

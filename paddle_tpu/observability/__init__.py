@@ -46,7 +46,9 @@ def export_chrome_trace(path):
 
 
 def reset():
-    """Clear recorded events and counters-board gauges (monitor counters
-    are shared state and are left alone; reset them individually)."""
+    """Clear recorded events, counters-board gauges, and summary windows
+    (monitor counters are shared state and are left alone; reset them
+    individually)."""
     _profiler.reset()
     export.clear_gauges()
+    export.clear_summaries()

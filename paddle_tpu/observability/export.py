@@ -1,11 +1,15 @@
 """Metric exporters: Prometheus text format + JSON.
 
-Two metric sources feed the exporters:
+Three metric sources feed the exporters:
 - the shared monitor registry (monitor.py) — monotonic counters from the
   instrumented runtime (collective bytes, dataloader wait ns, jit cache
   hits, PS RPC round-trips, ...);
 - a process-local gauge board (``publish``) — last-value telemetry such
-  as the StepTimer window rates (tokens/s, MFU, data-wait fraction).
+  as the StepTimer window rates (tokens/s, MFU, data-wait fraction);
+- a summary board (``summary``/``observe``) — windowed observation
+  streams rendered as Prometheus summaries (p50/p95/p99 quantile series
+  + ``_count``/``_sum``), the latency-SLO metric kind the serving engine
+  reports per-request latencies through.
 
 ``prometheus_text()`` renders both in the text exposition format, so
 ``start_http_server(port)`` (or writing the text to a node-exporter
@@ -22,12 +26,117 @@ from .. import monitor
 
 __all__ = ["publish", "gauges", "prometheus_text", "telemetry_dict",
            "write_json", "start_http_server", "register_collector",
-           "unregister_collector", "PROM_PREFIX"]
+           "unregister_collector", "summary", "summaries", "Summary",
+           "PROM_PREFIX", "SUMMARY_QUANTILES"]
 
 PROM_PREFIX = "paddle_tpu"
 
 _gauges = {}
 _gauges_lock = threading.Lock()
+
+# the quantile ladder every summary exports (Prometheus summary-type
+# convention: one labeled series per quantile + _count/_sum)
+SUMMARY_QUANTILES = (0.5, 0.95, 0.99)
+
+
+class Summary:
+    """Windowed observation stream with quantile export — the metric kind
+    for request latencies, where a counter/gauge can't answer "what is
+    p99". Keeps the last ``window`` observations in a ring (O(1) observe,
+    no allocation after warmup); quantiles are computed at scrape time
+    over a snapshot, so the observe path stays cheap enough for
+    per-request use. ``_count``/``_sum`` are lifetime monotonic."""
+
+    __slots__ = ("name", "window", "_ring", "_n", "_count", "_sum", "_lock")
+
+    def __init__(self, name, window=4096):
+        self.name = name
+        self.window = int(window)
+        self._ring = [0.0] * self.window
+        self._n = 0          # lifetime observations (ring fills to window)
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        v = float(value)
+        with self._lock:
+            self._ring[self._n % self.window] = v
+            self._n += 1
+            self._count += 1
+            self._sum += v
+
+    def reset(self):
+        """Empty the quantile window. ``_count``/``_sum`` stay lifetime-
+        monotonic — Prometheus counter semantics: a mid-process scrape
+        must never see them go backwards (rate()/increase() would read
+        that as a process restart)."""
+        with self._lock:
+            self._n = 0
+
+    def quantiles(self, qs=SUMMARY_QUANTILES):
+        import numpy as _np
+        with self._lock:
+            n = min(self._n, self.window)
+            data = list(self._ring[:n])
+        if not data:
+            return {q: float("nan") for q in qs}
+        vals = _np.percentile(_np.asarray(data), [q * 100 for q in qs])
+        return {q: float(v) for q, v in zip(qs, vals)}
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def snapshot(self):
+        """JSON-ready view: quantiles keyed "p50"/"p95"/"p99" + lifetime
+        count/sum. No-observation quantiles become None (json.dumps would
+        otherwise emit the invalid-JSON literal ``NaN`` and break strict
+        scrape consumers)."""
+        out = {f"p{q * 100:g}": (None if v != v else v)
+               for q, v in self.quantiles().items()}
+        with self._lock:
+            out["count"] = self._count
+            out["sum"] = self._sum
+        return out
+
+
+_summaries = {}
+_summaries_lock = threading.Lock()
+
+
+def summary(name, window=4096):
+    """Get-or-create the named :class:`Summary` (shared board, like the
+    monitor counter registry)."""
+    with _summaries_lock:
+        s = _summaries.get(name)
+        if s is None:
+            s = _summaries[name] = Summary(name, window=window)
+        return s
+
+
+def summaries():
+    """name -> snapshot dict for every registered summary."""
+    with _summaries_lock:
+        items = list(_summaries.items())
+    return {n: s.snapshot() for n, s in items}
+
+
+def clear_summaries():
+    """Reset every summary's quantile window IN PLACE — entries stay
+    registered, so live handles (a serving engine caches its boards at
+    init) keep exporting after a reset instead of observing into
+    orphaned objects, and the monotonic ``_count``/``_sum`` series are
+    preserved for scrape-side rate() math."""
+    with _summaries_lock:
+        for s in _summaries.values():
+            s.reset()
 
 # scrape-time collectors: name -> zero-arg fn returning {metric: value}.
 # For subsystems whose counters live OUTSIDE the python monitor registry
@@ -126,13 +235,25 @@ def prometheus_text(prefix=PROM_PREFIX):
         mname = f"{prefix}_{_prom_name(name)}"
         lines.append(f"# TYPE {mname} gauge")
         lines.append(f"{mname} {value:.6g}")
+    with _summaries_lock:
+        summs = sorted(_summaries.items())
+    for name, s in summs:
+        mname = f"{prefix}_{_prom_name(name)}"
+        lines.append(f"# TYPE {mname} summary")
+        for q, v in s.quantiles().items():
+            if v == v:  # skip NaN (no observations yet)
+                lines.append(f'{mname}{{quantile="{q:g}"}} {v:.6g}')
+        lines.append(f"{mname}_sum {s.sum:.6g}")
+        lines.append(f"{mname}_count {s.count}")
     return "\n".join(lines) + "\n"
 
 
 def telemetry_dict():
-    """Counters + gauges + collector pulls as one JSON-ready dict."""
+    """Counters + gauges + summaries + collector pulls as one JSON-ready
+    dict."""
     return {"time": time.time(), "counters": monitor.stats(),
-            "gauges": gauges(), "collected": collected()}
+            "gauges": gauges(), "summaries": summaries(),
+            "collected": collected()}
 
 
 def write_json(path):

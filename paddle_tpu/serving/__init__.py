@@ -1,0 +1,30 @@
+"""paddle_tpu.serving — production serving engine over the inference
+Predictor (reference: the L8 `analysis_predictor.cc` prepare/optimize/run
+stack, re-designed for XLA).
+
+Quick start::
+
+    import paddle_tpu.serving as serving
+
+    engine = serving.Engine("model_prefix", bucket_ladder=(1, 4, 16, 64),
+                            batch_timeout_ms=2.0)
+    fut = engine.submit(x)          # concurrent callers coalesce
+    outs = fut.result()             # [output arrays], rows match request
+    engine.close()
+
+All compiles happen at load (one per bucket, warmed through the
+persistent XLA compile cache); the request path only calls pre-compiled
+executables. Scrape `serving_*` counters + p50/p95/p99 latency summaries
+from ``observability.export.start_http_server(port)``'s ``/metrics``.
+"""
+from . import batching, passes  # noqa: F401
+from .batching import DynamicBatcher, Request  # noqa: F401
+from .engine import (DEFAULT_BUCKET_LADDER, Engine,  # noqa: F401
+                     create_engine)
+from .passes import build_serving_program, serving_bf16_cast_pass  # noqa: F401
+
+__all__ = [
+    "Engine", "create_engine", "DEFAULT_BUCKET_LADDER",
+    "DynamicBatcher", "Request",
+    "build_serving_program", "serving_bf16_cast_pass",
+]

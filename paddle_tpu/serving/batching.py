@@ -1,0 +1,121 @@
+"""Concurrent dynamic request batcher.
+
+Reference: the reference serves concurrency by cloning predictors per
+thread (`analysis_predictor.cc` Clone + thread-local scopes) — every
+caller pays a full device step. The TPU-native design inverts that: ONE
+device stream, and a coalescing queue in front of it. Callers enqueue
+(inputs, future) pairs; a worker drains the queue into per-step batches
+bounded by ``max_batch_size`` and flushed after ``batch_timeout_ms`` —
+so throughput scales with offered concurrency (fill the bucket) while a
+lone request still sees at most one timeout of added latency.
+
+The batcher is engine-agnostic: it owns ONLY queueing/coalescing and
+future resolution; the engine supplies ``run_batch(requests)`` which must
+resolve every request's future (the batcher resolves them exceptionally
+if ``run_batch`` itself raises, so a caller can never hang on a crashed
+device step).
+"""
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+__all__ = ["Request", "DynamicBatcher"]
+
+
+class Request:
+    """One enqueued inference request: per-input arrays (batch-major),
+    row count, and the caller's future."""
+
+    __slots__ = ("inputs", "rows", "future", "t_enqueue")
+
+    def __init__(self, inputs, rows):
+        self.inputs = inputs
+        self.rows = rows
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+class DynamicBatcher:
+    def __init__(self, run_batch, max_batch_size, batch_timeout_ms,
+                 name="paddle-tpu-serving"):
+        self._run_batch = run_batch
+        self.max_batch_size = int(max_batch_size)
+        self.batch_timeout_s = float(batch_timeout_ms) / 1e3
+        self._q = deque()
+        self._cond = threading.Condition()
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def submit(self, request):
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("batcher is closed")
+            self._q.append(request)
+            self._cond.notify()
+        return request.future
+
+    def pending(self):
+        with self._cond:
+            return len(self._q)
+
+    def close(self, timeout=30):
+        """Stop accepting requests; the worker drains what is already
+        queued (every accepted future resolves) and exits. Raises if the
+        drain does not finish within `timeout` — a silent return here
+        would leave callers blocked on futures a dying daemon thread
+        will never resolve."""
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                f"batcher drain did not finish within {timeout}s "
+                f"({self.pending()} request(s) still queued); a device "
+                "step may be stuck — outstanding futures are unresolved")
+
+    # -- worker ------------------------------------------------------------
+    def _take_compatible(self, batch, rows):
+        """Move queue-head requests into `batch` while they fit. Caller
+        holds the lock. Returns the new row total."""
+        while self._q and rows + self._q[0].rows <= self.max_batch_size:
+            r = self._q.popleft()
+            batch.append(r)
+            rows += r.rows
+        return rows
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._q and self._running:
+                    self._cond.wait()
+                if not self._q:  # closed and drained
+                    return
+                first = self._q.popleft()
+                batch = [first]
+                rows = self._take_compatible(batch, first.rows)
+                deadline = time.perf_counter() + self.batch_timeout_s
+                # coalescing window: wait for more traffic until the batch
+                # is full, the timeout lapses, or close() drains us
+                while rows < self.max_batch_size and self._running:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    if not self._q:
+                        self._cond.wait(remaining)
+                    rows = self._take_compatible(batch, rows)
+                    if self._q and rows + self._q[0].rows \
+                            > self.max_batch_size:
+                        break  # head doesn't fit: serve now, head waits
+            try:
+                self._run_batch(batch)
+            except BaseException as e:  # noqa: BLE001 — futures must resolve
+                from concurrent.futures import InvalidStateError
+                for r in batch:
+                    try:
+                        r.future.set_exception(e)
+                    except InvalidStateError:
+                        pass  # already resolved or caller cancelled

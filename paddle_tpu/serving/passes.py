@@ -1,0 +1,139 @@
+"""Load-time optimization passes for the serving engine.
+
+Reference: `analysis_predictor.cc` PrepareProgram/OptimizeInferenceProgram —
+the per-target pass pipelines (`paddle_infer::Config::pass_builder`) run
+ONCE at load, never on the request path. Here the pipeline rides the
+existing program-rewrite machinery (`static.apply_pass` registry +
+`static.prune`) and every stage's output goes through the static analyzer,
+so a broken rewrite surfaces as a `VerifyError` at load instead of wrong
+numbers under traffic.
+
+Pipeline stages (`build_serving_program`):
+1. ``clone(for_test=True)`` — dropout → identity, BN → running stats,
+   stat-update side ops dropped (the reference's is_test flip);
+2. ``prune(fetches)`` — backward slice to the served fetch set
+   (reference: `framework/prune.cc` via save_inference_model);
+3. optional ``serving_bf16_cast_pass`` — bf16 weight/compute cast (below);
+4. ``analysis.verify(targets=fetches)`` — structural verification, errors
+   raise.
+
+The bf16 pass is the reference's mixed-precision inference pass family
+(`convert_to_mixed_precision.cc`) restated for the slot IR: parameters are
+re-materialized as bf16 copies (weight cast — halves parameter HBM
+residency), and every float32 feed gets an explicit leading ``cast`` op
+with downstream slot references rewritten to the cast output (compute
+cast — all downstream math runs in bf16 by dtype propagation, on the MXU
+at full rate). The cast ops are visible IR, so the analyzer's dtype
+checker sees an honest bf16 program instead of hidden wrapper casts.
+"""
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..static.passes import _shallow_clone, apply_pass, prune, register_pass
+from ..static.program import _OpRecord, _Slot
+
+__all__ = ["serving_bf16_cast_pass", "build_serving_program",
+           "validate_passes", "SERVING_PASSES"]
+
+# engine-recognized pass names -> apply_pass registry names (None = handled
+# structurally by the engine, not a program rewrite)
+SERVING_PASSES = {"bf16": "serving_bf16_cast_pass", "donate": None}
+
+
+def validate_passes(passes):
+    """Single validation point for engine-level pass names (used by both
+    the Engine constructor and build_serving_program — the two entry
+    points must accept the same names)."""
+    unknown = [n for n in passes if n not in SERVING_PASSES]
+    if unknown:
+        raise ValueError(
+            f"unknown serving pass(es) {unknown}; known: "
+            f"{sorted(SERVING_PASSES)}")
+
+
+def _cast_bf16(v):
+    import jax.numpy as jnp
+    return v.astype(jnp.bfloat16)
+
+
+@register_pass("serving_bf16_cast_pass")
+def serving_bf16_cast_pass(prog):
+    """bf16 weight/compute cast for a forward (serving) program.
+
+    Returns a NEW Program: float32 parameters/buffers become bf16 copies
+    (the originals are untouched — the pass must not corrupt a live
+    model), and each float32 feed is routed through a prepended ``cast``
+    op whose output slot replaces the feed slot in every downstream op.
+    Non-float inputs (token ids, masks) pass through unchanged. Outputs
+    are left bf16; the engine casts fetches back to the declared dtype at
+    the program boundary.
+    """
+    import jax.numpy as jnp
+
+    f32 = np.dtype("float32")
+    p = _shallow_clone(prog, [])
+
+    # weight cast: fresh bf16 Tensors, original param objects untouched
+    new_params = {}
+    for s, t in prog.params.items():
+        v = t._value
+        if np.dtype(getattr(v, "dtype", np.float64)) == f32:
+            nt = Tensor(jnp.asarray(v).astype(jnp.bfloat16))
+            nt.name = t.name
+            nt.persistable = getattr(t, "persistable", False)
+            new_params[s] = nt
+        else:
+            new_params[s] = t
+    p.params = new_params
+
+    # compute cast: explicit cast op per f32 feed, downstream refs remapped
+    remap = {}
+    nslots = prog._slot_count
+    cast_ops = []
+    for _name, (slot, _shape, dtype_str) in prog.feed_vars.items():
+        if convert_dtype(dtype_str) != f32:
+            continue
+        cast_ops.append(_OpRecord(_cast_bf16, [_Slot(slot)], {}, [nslots],
+                                  "cast"))
+        remap[slot] = nslots
+        nslots += 1
+
+    def _remap(x):
+        if isinstance(x, _Slot) and x.idx in remap:
+            return _Slot(remap[x.idx])
+        return x
+
+    ops = []
+    for op in prog.ops:
+        ops.append(_OpRecord(
+            op.fn, [_remap(a) for a in op.arg_slots],
+            {k: _remap(v) for k, v in op.kwarg_slots.items()},
+            op.out_slots, op.name, eval_fn=op.eval_fn))
+    p.ops = cast_ops + ops
+    p._slot_count = nslots
+    p._produced = set(prog._produced) | set(remap.values())
+    return p
+
+
+def build_serving_program(prog, fetches, passes=()):
+    """Run the load-time pipeline over a recorded Program; returns the
+    optimized Program (fetch tensors stay valid — slots are shared).
+    ``passes`` is the engine-level pass list; only program-rewrite passes
+    ("bf16") act here. Raises ``analysis.VerifyError`` if the optimized
+    program fails structural verification — a serving engine must never
+    come up on a broken program."""
+    from .. import analysis
+
+    validate_passes(passes)
+    p = prog.clone(for_test=True)
+    p = prune(p, fetches)
+    for name in passes:
+        reg = SERVING_PASSES[name]
+        if reg is not None:
+            p = apply_pass(p, reg)
+    findings = analysis.verify(p, targets=fetches)
+    bad = analysis.errors(findings)
+    if bad:
+        raise analysis.VerifyError(bad, context="build_serving_program")
+    return p

@@ -37,6 +37,7 @@ MODULES = [
     "paddle_tpu.quantization",
     "paddle_tpu.sparsity",
     "paddle_tpu.inference",
+    "paddle_tpu.serving",
     "paddle_tpu.onnx",
     "paddle_tpu.incubate",
     "paddle_tpu.text",
