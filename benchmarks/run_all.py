@@ -448,6 +448,57 @@ def bench_serving():
             "clients": clients}
 
 
+def bench_checkpoint():
+    """Checkpoint save+restore throughput through the crash-consistent
+    core (paddle_tpu/checkpoint/): full training state (params + Adam
+    moments + RNG) captured, hashed, fsynced and atomically published,
+    then restored with content-hash validation. The number that bounds
+    how often a preemptible-pool job can afford to checkpoint."""
+    import shutil
+    import tempfile
+
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import checkpoint
+
+    backend = jax.default_backend()
+    on_tpu = backend != "cpu"
+    hidden, saves = (2048, 4) if on_tpu else (512, 3)
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(hidden, hidden), nn.ReLU(),
+                          nn.Linear(hidden, hidden), nn.ReLU(),
+                          nn.Linear(hidden, hidden))
+    opt = paddle.optimizer.Adam(parameters=model.parameters())
+    root = tempfile.mkdtemp(prefix="pt_ckpt_bench_")
+    try:
+        mgr = checkpoint.CheckpointManager(root, keep_last_n=2)
+        mgr.add_model(model).add_optimizer(opt)
+        p0 = mgr.save(0)  # warm (dir creation, first pickle)
+        n_bytes = sum(
+            os.path.getsize(os.path.join(p0, f)) for f in os.listdir(p0))
+        t0 = time.perf_counter()
+        for i in range(1, saves + 1):
+            mgr.save(i)
+        save_s = (time.perf_counter() - t0) / saves
+        t0 = time.perf_counter()
+        meta = mgr.restore()
+        restore_s = time.perf_counter() - t0
+        assert meta is not None and meta["step"] == saves
+        rt_mbps = 2 * n_bytes / (save_s + restore_s) / 1e6
+        return {"metric": "checkpoint_save_restore_MBps",
+                "value": round(rt_mbps, 1), "unit": "MB/s",
+                "backend": backend,
+                "state_mb": round(n_bytes / 1e6, 2),
+                "save_ms": round(save_s * 1e3, 2),
+                "restore_ms": round(restore_s * 1e3, 2),
+                "keep_last_n": 2, "note": "atomic publish (fsync + "
+                "manifest + rename) incl. hash validation on restore"}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_bert():
     """Config 3: the flagship BERT pretraining step — bench.py run as a
     subprocess (it owns program structure, OOM fallback and timing) with
@@ -462,7 +513,7 @@ def bench_bert():
 BENCHES = {"resnet": bench_resnet50, "gpt": bench_gpt_sharding_pp,
            "allreduce": bench_allreduce, "detection": bench_detection,
            "hbm_cache": bench_hbm_cache, "serving": bench_serving,
-           "bert": bench_bert}
+           "checkpoint": bench_checkpoint, "bert": bench_bert}
 
 
 def run_benches(configs):
@@ -491,7 +542,7 @@ DEFAULT_BASELINE = os.path.join(
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="resnet,gpt,allreduce,detection,"
-                    "hbm_cache,serving,bert")
+                    "hbm_cache,serving,checkpoint,bert")
     ap.add_argument("--out", help="write the run's records as a JSON file")
     ap.add_argument("--results", help="gate a previously recorded results "
                     "JSON instead of running the ladder")

@@ -59,6 +59,8 @@ from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import inference  # noqa: F401
 from . import serving  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import testing  # noqa: F401
 from . import incubate  # noqa: F401
 
 from . import profiler  # noqa: F401

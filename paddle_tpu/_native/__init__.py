@@ -114,6 +114,7 @@ def _bind(lib):
         "pt_ps_stop": (None, []),
         "pt_ps_port": (I, []),
         "pt_ps_running": (I, []),
+        "pt_ps_dup_requests": (LL, []),
         "pt_ps_stats_json": (I, [c.c_char_p, I]),
     }
     for name, (res, args) in sigs.items():

@@ -35,11 +35,13 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include <arpa/inet.h>
@@ -65,6 +67,15 @@ enum Op : uint8_t {
   kStop = 10,
   kSparseSize = 11,
   kPullDenseInit = 12,  // pull, initializing from payload if first touch
+  // request-id'd pushes: payload = u64 request_id | legacy payload. The
+  // server remembers recently seen ids and replies ok without applying a
+  // duplicate — a client may re-send a push whose response was lost
+  // (retry with backoff) without the grad being applied twice. This is
+  // what makes the push path idempotent, hence safely retriable.
+  kPushDenseGradId = 13,
+  kPushDenseDeltaId = 14,
+  kPushSparseGradId = 15,
+  kPushSparseDeltaId = 16,
   // graph service (reference: common_graph_table.cc + graph_brpc_server.cc)
   kGraphAddNodes = 20,        // n ids | n*feat_dim f32 features
   kGraphAddEdges = 21,        // n src | n dst | n f32 weights
@@ -399,7 +410,80 @@ struct PsServer {
   };
   std::map<uint64_t, OpStat> op_stats;  // key = table << 8 | op
   std::mutex stats_mu;
+  // push request-id dedup: a bounded FIFO window of recently seen ids
+  // (64K ids ~= far more in-flight pushes than any worker fleet holds;
+  // an id evicted from the window can only be re-applied if a client
+  // retries a push 64K pushes later, which the per-call deadline makes
+  // impossible in practice). Value = has the apply FINISHED (vs merely
+  // started) — a duplicate is only acked once its original completed.
+  std::unordered_map<uint64_t, bool> seen_reqs;
+  std::deque<uint64_t> seen_order;
+  std::mutex seen_mu;
+  std::condition_variable seen_cv;
+  uint64_t dup_requests = 0;  // observability: how often dedup saved us
 };
+
+constexpr size_t kSeenReqWindow = 1u << 16;
+
+enum ReqCheck : int {
+  kReqNew = 0,       // marked in-progress; caller must apply + finish
+  kReqDupDone = 1,   // duplicate of a completed apply: ack without apply
+  kReqDupFailed = 2, // original was rejected (or dup wait timed out):
+                     // reply ok=0 so the client surfaces the failure
+};
+
+// Dedup marks the id before the apply runs (check-and-insert), so a
+// retry racing a still-running original (client socket timeout while
+// the apply stalls behind a table mutex/OP_SAVE) can never apply twice.
+// The duplicate then WAITS for the original to finish before acking —
+// an ok=1 must imply the push is visible to a subsequent pull
+// (read-your-writes), not merely scheduled. A rejected original
+// (deterministic ok=0: table missing / size mismatch) erases its id, so
+// its duplicate reports the same failure instead of a fake ok.
+int check_request(PsServer* ps, uint64_t id) {
+  std::unique_lock<std::mutex> lk(ps->seen_mu);
+  auto it = ps->seen_reqs.find(id);
+  if (it == ps->seen_reqs.end()) {
+    ps->seen_reqs.emplace(id, false);
+    ps->seen_order.push_back(id);
+    if (ps->seen_order.size() > kSeenReqWindow) {
+      ps->seen_reqs.erase(ps->seen_order.front());
+      ps->seen_order.pop_front();
+    }
+    return kReqNew;
+  }
+  ++ps->dup_requests;
+  bool signalled = ps->seen_cv.wait_for(
+      lk, std::chrono::seconds(120), [&] {
+        auto it2 = ps->seen_reqs.find(id);
+        return it2 == ps->seen_reqs.end() || it2->second ||
+               !ps->running.load();
+      });
+  auto it2 = ps->seen_reqs.find(id);
+  if (signalled && it2 != ps->seen_reqs.end() && it2->second)
+    return kReqDupDone;
+  return kReqDupFailed;
+}
+
+void finish_request(PsServer* ps, uint64_t id, bool applied) {
+  std::lock_guard<std::mutex> lk(ps->seen_mu);
+  auto it = ps->seen_reqs.find(id);
+  if (it != ps->seen_reqs.end()) {
+    if (applied) {
+      it->second = true;
+    } else {
+      ps->seen_reqs.erase(it);
+      for (auto oit = ps->seen_order.rbegin();
+           oit != ps->seen_order.rend(); ++oit) {
+        if (*oit == id) {  // newest occurrence: just-inserted id
+          ps->seen_order.erase(std::next(oit).base());
+          break;
+        }
+      }
+    }
+  }
+  ps->seen_cv.notify_all();
+}
 
 PsServer* g_ps = nullptr;
 std::mutex g_ps_mu;
@@ -447,7 +531,11 @@ bool send_resp(int fd, const void* payload, uint32_t n) {
 }
 
 bool save_tables(PsServer* ps, const std::string& path) {
-  FILE* f = fopen(path.c_str(), "wb");
+  // write to a sidecar and publish via rename: a failed/interrupted
+  // save (disk full, client timeout killing the conn mid-write) must
+  // never destroy an existing good snapshot at `path`
+  const std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
   if (!f) return false;
   uint32_t nd = ps->dense.size(), nsp = ps->sparse.size();
   fwrite(&nd, 4, 1, f);
@@ -492,6 +580,7 @@ bool save_tables(PsServer* ps, const std::string& path) {
       int64_t st;
       if (!t.read_spilled(so.second, &key, &st, vals.data())) {
         fclose(f);
+        remove(tmp.c_str());
         return false;
       }
       fwrite(&key, 8, 1, f);
@@ -521,7 +610,10 @@ bool save_tables(PsServer* ps, const std::string& path) {
     }
   }
   bool ok = ferror(f) == 0;
+  ok = (fflush(f) == 0) && ok;
   ok = (fclose(f) == 0) && ok;
+  if (ok) ok = rename(tmp.c_str(), path.c_str()) == 0;
+  if (!ok) remove(tmp.c_str());
   return ok;
 }
 
@@ -649,6 +741,27 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
     const char* payload = body.data() + 13;
     size_t psize = blen - 17;
 
+    // Request-id'd pushes: consume the id prefix and fold onto the
+    // legacy opcode so validation/handling below is shared; the dedup
+    // decision is taken after validation (a malformed duplicate frame
+    // must still drop the connection, not pollute the seen-set).
+    bool has_req_id = false;
+    uint64_t req_id = 0;
+    if (op == kPushDenseGradId || op == kPushDenseDeltaId ||
+        op == kPushSparseGradId || op == kPushSparseDeltaId) {
+      if (psize < 8) break;  // malformed: no room for the id
+      memcpy(&req_id, payload, 8);
+      payload += 8;
+      psize -= 8;
+      has_req_id = true;
+      switch (op) {
+        case kPushDenseGradId: op = kPushDenseGrad; break;
+        case kPushDenseDeltaId: op = kPushDenseDelta; break;
+        case kPushSparseGradId: op = kPushSparseGrad; break;
+        default: op = kPushSparseDelta; break;
+      }
+    }
+
     // Validate sparse payload sizes against the header count before any
     // table access: a truncated/corrupt frame must not cause out-of-bounds
     // reads (keys are n*8 bytes; pushes carry n*dim*4 grad bytes after).
@@ -663,6 +776,20 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
     }
 
     auto op_t0 = std::chrono::steady_clock::now();
+    if (has_req_id) {
+      int st_req = check_request(ps, req_id);
+      if (st_req != kReqNew) {
+        // duplicate: ack ok only for a COMPLETED apply (the wait inside
+        // check_request makes ok imply visibility); a rejected original
+        // or a wait timeout reports failure instead
+        uint32_t ok = st_req == kReqDupDone ? 1 : 0;
+        send_resp(fd, &ok, 4);
+        std::lock_guard<std::mutex> slk(ps->stats_mu);
+        auto& st = ps->op_stats[((uint64_t)table << 8) | op];
+        st.calls += 1;
+        continue;
+      }
+    }
     if (op == kStop) {
       uint32_t ok = 1;
       send_resp(fd, &ok, 4);
@@ -697,7 +824,12 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
       case kPushDenseGrad:
       case kPushDenseDelta: {
         DenseTable* tp = find_dense(ps, table);
-        if (!tp) { uint32_t ok = 0; send_resp(fd, &ok, 4); break; }
+        if (!tp) {
+          if (has_req_id) finish_request(ps, req_id, false);
+          uint32_t ok = 0;
+          send_resp(fd, &ok, 4);
+          break;
+        }
         DenseTable& t = *tp;
         std::lock_guard<std::mutex> lk(t.mu);
         size_t cnt = psize / 4;
@@ -712,6 +844,7 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
         } else if (!t.apply_grad((const float*)payload, cnt)) {
           ok = 0;
         }
+        if (has_req_id) finish_request(ps, req_id, ok != 0);
         send_resp(fd, &ok, 4);
         break;
       }
@@ -731,7 +864,12 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
       }
       case kPushSparseGrad: {
         SparseTable* tp = find_sparse(ps, table);
-        if (!tp) { uint32_t ok = 0; send_resp(fd, &ok, 4); break; }
+        if (!tp) {
+          if (has_req_id) finish_request(ps, req_id, false);
+          uint32_t ok = 0;
+          send_resp(fd, &ok, 4);
+          break;
+        }
         SparseTable& t = *tp;
         std::lock_guard<std::mutex> lk(t.mu);
         const uint64_t* keys = (const uint64_t*)payload;
@@ -739,12 +877,18 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
         for (uint64_t i = 0; i < n; ++i)
           t.apply_grad(keys[i], g + i * t.dim);
         uint32_t ok = 1;
+        if (has_req_id) finish_request(ps, req_id, true);
         send_resp(fd, &ok, 4);
         break;
       }
       case kPushSparseDelta: {
         SparseTable* tp = find_sparse(ps, table);
-        if (!tp) { uint32_t ok = 0; send_resp(fd, &ok, 4); break; }
+        if (!tp) {
+          if (has_req_id) finish_request(ps, req_id, false);
+          uint32_t ok = 0;
+          send_resp(fd, &ok, 4);
+          break;
+        }
         SparseTable& t = *tp;
         std::lock_guard<std::mutex> lk(t.mu);
         const uint64_t* keys = (const uint64_t*)payload;
@@ -754,6 +898,7 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
           for (int j = 0; j < t.dim; ++j) r[j] += d[i * t.dim + j];
         }
         uint32_t ok = 1;
+        if (has_req_id) finish_request(ps, req_id, true);
         send_resp(fd, &ok, 4);
         break;
       }
@@ -1137,6 +1282,16 @@ PT_API void pt_ps_stop() {
 PT_API int32_t pt_ps_port() {
   std::lock_guard<std::mutex> lk(g_ps_mu);
   return g_ps ? g_ps->port : -1;
+}
+
+// how many duplicate (request-id-deduped) pushes the server acked
+// without re-applying — a rising value means clients are riding their
+// retry budget over lost responses
+PT_API int64_t pt_ps_dup_requests() {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  if (!g_ps) return 0;
+  std::lock_guard<std::mutex> slk(g_ps->seen_mu);
+  return (int64_t)g_ps->dup_requests;
 }
 
 PT_API int32_t pt_ps_running() {

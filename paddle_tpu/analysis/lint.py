@@ -10,7 +10,7 @@ Two surfaces:
   (``dispatch.op_display_name``) so a hot op flagged here is the same
   string a profile shows.
 
-- ``lint_source(paths)``: AST lint over repo python — the two rule families
+- ``lint_source(paths)``: AST lint over repo python — the rule families
   the CI gate runs on every PR:
   * ``nondeterminism-in-traced``: wall-clock / RNG host calls inside a
     ``@to_static``-decorated function. The trace bakes the value at compile
@@ -20,13 +20,20 @@ Two surfaces:
     dispatch/observability hot paths outside an ``enabled()``-style guard —
     one stray ``jnp.zeros`` in ``call_op`` is a device allocation per op
     dispatch.
+  * ``retry-without-backoff``: a retry loop (``while True`` — error — or a
+    bounded ``for`` — warning) wrapping an RPC/socket call in try/except
+    with no backoff sleep and no deadline check. Tight retry loops turn a
+    restarting server into a thundering-herd DoS and hide outages from
+    latency metrics; route retries through
+    ``distributed.ps.retry.RetryPolicy`` instead. Scanned by default over
+    the RPC client paths (``RPC_PATHS``).
 """
 import ast
 import os
 
 from .findings import ERROR, WARNING, Finding
 
-__all__ = ["lint_program", "lint_source", "HOT_PATHS"]
+__all__ = ["lint_program", "lint_source", "HOT_PATHS", "RPC_PATHS"]
 
 # host-callback op names: each is a device->host round-trip inside the
 # compiled program (stalls the TPU pipeline every step)
@@ -47,6 +54,26 @@ HOT_PATHS = {
 # jnp attributes that are metadata-only (no device work) and allowed in
 # hot paths
 _JNP_META_OK = frozenset({"shape", "ndim", "dtype", "result_type", "size"})
+
+# files holding RPC client code: scanned by default for the
+# retry-without-backoff rule (add new RPC surfaces here)
+RPC_PATHS = (
+    os.path.join("paddle_tpu", "distributed", "ps", "client.py"),
+    os.path.join("paddle_tpu", "distributed", "ps", "retry.py"),
+    os.path.join("paddle_tpu", "distributed", "ps", "communicator.py"),
+    os.path.join("paddle_tpu", "distributed", "ps", "graph.py"),
+    os.path.join("paddle_tpu", "distributed", "fleet", "elastic.py"),
+)
+
+# call names that mark a statement as an RPC/socket round-trip
+_RPC_CALL_HINTS = frozenset({
+    "sendall", "send", "recv", "connect", "create_connection",
+    "_call", "_call_impl", "urlopen", "request", "getresponse",
+})
+
+# evidence that a retry loop paces itself / bounds its total latency
+_BACKOFF_CALL_HINTS = frozenset({"sleep", "wait", "backoff_s", "run"})
+_BACKOFF_NAME_HINTS = ("backoff", "deadline", "retry_policy", "delay")
 
 # nondeterministic host calls that a trace would freeze into the program
 _NONDET_CALLS = {
@@ -191,10 +218,82 @@ class _HotPathChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RetryLoopChecker(ast.NodeVisitor):
+    """Flags retry loops around RPC calls that neither back off nor
+    check a deadline (the PS client's original sin: `for _ in
+    range(attempts)` re-sending as fast as the kernel fails it)."""
+
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+
+    @staticmethod
+    def _loop_facts(body_nodes, loop_vars):
+        """(has_retried_rpc, has_try, has_backoff). An RPC call that
+        consumes the loop variable is a per-target FAN-OUT (one call per
+        server), not a retry of the same request — those don't count."""
+        has_rpc = has_try = has_backoff = False
+        for node in body_nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Try):
+                    has_try = True
+                elif isinstance(sub, ast.Call):
+                    chain = _attr_chain(sub.func) or ""
+                    leaf = chain.split(".")[-1]
+                    if leaf in _RPC_CALL_HINTS:
+                        arg_names = {
+                            n.id for a in list(sub.args)
+                            + [kw.value for kw in sub.keywords]
+                            for n in ast.walk(a)
+                            if isinstance(n, ast.Name)}
+                        if not (loop_vars & arg_names):
+                            has_rpc = True
+                    if leaf in _BACKOFF_CALL_HINTS:
+                        has_backoff = True
+                elif isinstance(sub, (ast.Name, ast.Attribute)):
+                    ident = (sub.id if isinstance(sub, ast.Name)
+                             else sub.attr).lower()
+                    if any(h in ident for h in _BACKOFF_NAME_HINTS):
+                        has_backoff = True
+        return has_rpc, has_try, has_backoff
+
+    def _check(self, node, unbounded):
+        loop_vars = set()
+        target = getattr(node, "target", None)
+        if target is not None:
+            loop_vars = {n.id for n in ast.walk(target)
+                         if isinstance(n, ast.Name)}
+        has_rpc, has_try, has_backoff = self._loop_facts(node.body,
+                                                         loop_vars)
+        if has_rpc and has_try and not has_backoff:
+            kind = "while True" if unbounded else "bounded for"
+            self.findings.append(Finding(
+                "retry-without-backoff", ERROR if unbounded else WARNING,
+                f"{kind} retry loop around an RPC call with no backoff "
+                "sleep or deadline check — a restarting server gets "
+                "hammered as fast as the kernel can fail the socket; "
+                "route it through distributed.ps.retry.RetryPolicy",
+                loc=f"{self.path}:{node.lineno}"))
+
+    def visit_While(self, node):
+        test = node.test
+        unbounded = (isinstance(test, ast.Constant) and bool(test.value))
+        if unbounded:
+            self._check(node, unbounded=True)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        chain = _attr_chain(node.iter.func) if isinstance(node.iter,
+                                                         ast.Call) else None
+        if chain and chain.split(".")[-1] == "range":
+            self._check(node, unbounded=False)
+        self.generic_visit(node)
+
+
 def lint_source(paths=None, repo_root=None):
-    """AST-lint python sources. Default: the registered hot-path files plus
-    every file in ``paths``. Returns findings; files that fail to parse are
-    reported, not raised."""
+    """AST-lint python sources. Default: the registered hot-path files
+    plus the RPC client paths; or every file in ``paths``. Returns
+    findings; files that fail to parse are reported, not raised."""
     if repo_root is None:
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
@@ -204,6 +303,7 @@ def lint_source(paths=None, repo_root=None):
         targets.extend(paths)
     else:
         targets.extend(os.path.join(repo_root, p) for p in HOT_PATHS)
+        targets.extend(os.path.join(repo_root, p) for p in RPC_PATHS)
     seen = set()
     for path in targets:
         path = os.path.abspath(path)
@@ -219,6 +319,7 @@ def lint_source(paths=None, repo_root=None):
                 "syntax-error", ERROR, str(e), loc=f"{rel}:{e.lineno}"))
             continue
         _TracedFnChecker(rel, findings).visit(tree)
+        _RetryLoopChecker(rel, findings).visit(tree)
         hot_fns = HOT_PATHS.get(rel)
         if hot_fns:
             _HotPathChecker(rel, hot_fns, findings).visit(tree)

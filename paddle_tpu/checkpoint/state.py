@@ -1,0 +1,345 @@
+"""Shard-aware capture/restore of the full training state.
+
+What a step checkpoint holds (vs the epoch-granularity pickle stub this
+replaces): model parameters and persistable buffers, optimizer moments
+and fp32 masters — including the ZeRO flat stores, saved as PER-RANK
+SHARDS without materializing full tensors (``Optimizer.state_dict()``
+copies every ``_FlatSlot`` view into a full per-param tensor; the
+capture here walks ``addressable_shards`` of each sharded store
+instead, so peak host memory is one 1/degree shard at a time) — the
+ZeRO-3 flat PARAM stores (live ``Parameter`` objects are store views
+and are skipped by the model section), GradScaler dynamic-scaling
+state, the global RNG key, the lr scheduler + lr tensor, the optimizer
+step count, and the gradient-accumulation-window phase (surviving
+per-param ``@GRAD`` accumulations plus the sharded ``gacc`` window
+stores for ZeRO-2/3).
+
+Restore re-registers everything into the live objects so the next
+compiled step continues **bitwise-equal** to an uninterrupted run, and
+supports **elastic resume**: a checkpoint taken at dp degree d_old
+restores into an optimizer sharded at d_new by re-flattening — shard
+rows are concatenated, the old degree's padding is trimmed, and the
+rows are re-padded and re-placed 1/d_new (the bucket layout below the
+padding is degree-invariant, so no per-param tensor is ever rebuilt).
+
+Keying is structural, not name-based: model entries use the Layer
+state_dict's attribute-path names and optimizer entries use
+``(param_group, param_index, slot)``, both stable across process
+restarts AND across in-process rebuilds (auto-generated tensor names
+are not — a fresh model in the same process draws new name counters).
+"""
+import io
+import pickle
+
+import numpy as np
+
+__all__ = ["capture_model", "restore_model", "capture_optimizer",
+           "restore_optimizer", "capture_scaler", "restore_scaler",
+           "capture_rng", "restore_rng", "dumps", "loads",
+           "StateMismatchError"]
+
+
+class StateMismatchError(RuntimeError):
+    """The live objects don't structurally match the checkpoint."""
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def dumps(obj):
+    return pickle.dumps(obj, protocol=4)
+
+
+def loads(data):
+    return pickle.load(io.BytesIO(data))
+
+
+def _is_zero3_param(t):
+    return "_zero3_slot" in getattr(t, "__dict__", {})
+
+
+# -- model -----------------------------------------------------------------
+
+def capture_model(model):
+    """Host copy of a Layer's state_dict, keyed by structural name.
+    ZeRO-3 params are store views — their bytes live in the optimizer's
+    sharded param stores, so they are recorded by name only (a restore
+    cross-checks the optimizer section covers them)."""
+    state, zero3 = {}, []
+    for name, t in model.state_dict().items():
+        if _is_zero3_param(t):
+            zero3.append(name)
+            continue
+        state[name] = _np(t._value)
+    return {"state": state, "zero3_params": zero3}
+
+
+def restore_model(model, data, strict=True):
+    own = model.state_dict()
+    saved = data["state"]
+    missing = []
+    for name, t in own.items():
+        if name in saved:
+            arr = saved[name]
+            if tuple(arr.shape) != tuple(t._value.shape):
+                raise StateMismatchError(
+                    f"model entry {name!r}: checkpoint shape "
+                    f"{tuple(arr.shape)} vs live {tuple(t._value.shape)}")
+            t.set_value(arr)
+        elif _is_zero3_param(t):
+            pass  # restored via the optimizer's sharded param store
+        else:
+            missing.append(name)
+    if strict and missing:
+        raise StateMismatchError(
+            f"checkpoint is missing model entries {missing}")
+    return missing
+
+
+# -- optimizer -------------------------------------------------------------
+
+def _indexed_params(opt):
+    """[(key, param)] with structural '<group>.<index>' keys."""
+    out = []
+    for gi, group in enumerate(opt._param_groups):
+        for pi, p in enumerate(group["params"]):
+            out.append((f"{gi}.{pi}", p))
+    return out
+
+
+def _store_shards(store):
+    """Per-rank host shards of a flat store, in row order, without ever
+    holding the full buffer on host: ``([shard, ...], sharded_flag)``.
+    Shards are DEDUPED by row offset: on a multi-axis mesh (dp x mp with
+    the store sharded only over dp) every row block has a replicated
+    copy per other-axis index — saving them all would concatenate
+    duplicates and restore wrong rows. A replicated/eager store yields
+    one "shard" covering all rows."""
+    import jax
+    arr = store.tensor._value
+    if isinstance(arr, jax.Array) and store.tensor.pspec is not None:
+        try:
+            multi = len(arr.sharding.device_set) > 1
+        except Exception:
+            multi = False
+        if multi:
+            by_off = {}
+            for s in arr.addressable_shards:
+                start = s.index[0].start or 0
+                if start not in by_off:
+                    by_off[start] = np.asarray(s.data)
+            if len(by_off) > 1:
+                return [by_off[k] for k in sorted(by_off)], True
+            # one distinct block: fully replicated across the mesh
+            return [next(iter(by_off.values()))], False
+    return [np.asarray(arr)], False
+
+
+def capture_optimizer(opt):
+    from ..optimizer.optimizer import _FlatSlot
+    out = {"step_count": _np(opt._step_count._value),
+           "lr": _np(opt._lr.tensor._value)}
+    if opt._lr.scheduler is not None:
+        out["lr_scheduler"] = opt._lr.scheduler.state_dict()
+    params = _indexed_params(opt)
+    key_of = {id(p): k for k, p in params}
+
+    # accumulation-window phase, part 1: gradients that survived the last
+    # step (eager accumulation / backward-only steps) — restore must hand
+    # them back or the window resumes short one micro step
+    grads = {}
+    for key, p in params:
+        g = p._grad
+        if g is not None and not hasattr(g, "rows"):  # dense only
+            grads[key] = _np(g)
+    out["grads"] = grads
+
+    zero = opt._zero
+    if zero is None:
+        accs = {}
+        for (slot, pid), t in opt._accumulators.items():
+            if isinstance(t, _FlatSlot):
+                continue  # lives in the fused flat store, saved below
+            key = key_of.get(pid)
+            if key is not None:
+                accs[f"{key}.{slot}"] = _np(t._value)
+        out["accumulators"] = accs
+        out["flat_stores"] = {slot: _np(store.tensor._value)
+                              for slot, store in opt._flat_stores.items()}
+        return out
+
+    buckets = []
+    for zb, sdict in zip(zero["buckets"], zero["stores"]):
+        brec = {"index": zb.index,
+                "param_keys": [key_of.get(id(p)) for p in zb.params],
+                "sizes": list(zb.sizes), "n_rows": list(zb.n_rows),
+                "rows": zb.rows, "pad_rows": zb.pad_rows,
+                "slots": {}}
+        for slot, store in sdict.items():
+            shards, sharded = _store_shards(store)
+            brec["slots"][slot] = {
+                "shards": shards, "sharded": sharded,
+                "dtype": np.dtype(store.tensor._value.dtype).str}
+        buckets.append(brec)
+    out["zero"] = {"axis": zero["axis"], "stage": zero["stage"],
+                   "degree": zero["degree"],
+                   "comm_buffer_mb": zero["comm_buffer_mb"],
+                   "buckets": buckets}
+    return out
+
+
+def _restore_store(store, brec, srec, mesh):
+    """Write saved shard rows back into a live flat store, re-flattening
+    for the live shard degree (elastic resume): concatenate the saved
+    shards, trim the OLD degree's padding rows, re-pad to the live row
+    count, and place 1/degree on the live mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    rows_logical = brec["rows"] - brec["pad_rows"]
+    shards = srec["shards"]
+    full = shards[0] if len(shards) == 1 else np.concatenate(shards, axis=0)
+    if full.shape[0] < rows_logical:
+        raise StateMismatchError(
+            f"store {store.tensor.name}: checkpoint holds {full.shape[0]} "
+            f"rows, layout needs {rows_logical} (missing per-rank shards "
+            "from another host?)")
+    full = full[:rows_logical]
+    live_rows = store.tensor._value.shape[0]
+    if live_rows < rows_logical:
+        raise StateMismatchError(
+            f"store {store.tensor.name}: live layout has {live_rows} rows "
+            f"< checkpoint's {rows_logical} logical rows")
+    if live_rows > rows_logical:  # the live degree's padding
+        full = np.concatenate(
+            [full, np.zeros((live_rows - rows_logical, full.shape[1]),
+                            full.dtype)], axis=0)
+    val = jnp.asarray(full, dtype=store.tensor._value.dtype)
+    if mesh is not None and store.tensor.pspec is not None:
+        val = jax.device_put(val, NamedSharding(mesh, store.tensor.pspec))
+    store.pending = []
+    store.tensor._value = val
+
+
+def restore_optimizer(opt, data, strict=True):
+    import jax.numpy as jnp
+    # scheduler first: its set_state_dict pushes last_lr into the lr
+    # tensor; the saved lr tensor value then wins (they normally agree)
+    if "lr_scheduler" in data and opt._lr.scheduler is not None:
+        opt._lr.scheduler.set_state_dict(data["lr_scheduler"])
+    opt._lr.tensor.set_value(data["lr"])
+    opt._step_count.set_value(data["step_count"])
+
+    params = dict(_indexed_params(opt))
+    for key, arr in data.get("grads", {}).items():
+        p = params.get(key)
+        if p is None:
+            if strict:
+                raise StateMismatchError(
+                    f"checkpoint grad for unknown param slot {key!r}")
+            continue
+        p._grad = jnp.asarray(arr)
+
+    zero = opt._zero
+    saved_zero = data.get("zero")
+    if (zero is None) != (saved_zero is None):
+        raise StateMismatchError(
+            "checkpoint and live optimizer disagree on ZeRO sharding: "
+            f"checkpoint {'has' if saved_zero else 'lacks'} sharded "
+            "stores — enable the same _zero_enable(stage=...) before "
+            "restore")
+    if saved_zero is None:
+        accs = {}
+        from ..optimizer.optimizer import _FlatSlot
+        for (slot, pid), t in opt._accumulators.items():
+            if isinstance(t, _FlatSlot):
+                continue
+            for key, p in params.items():
+                if id(p) == pid:
+                    accs[f"{key}.{slot}"] = t
+                    break
+        for key, arr in data.get("accumulators", {}).items():
+            t = accs.get(key)
+            if t is None:
+                if strict:
+                    raise StateMismatchError(
+                        f"checkpoint accumulator {key!r} has no live slot "
+                        "(different optimizer class or param set?)")
+                continue
+            t.set_value(arr)
+        for slot, arr in data.get("flat_stores", {}).items():
+            store = opt._flat_stores.get(slot)
+            if store is None:
+                raise StateMismatchError(
+                    f"checkpoint fused store {slot!r} has no live "
+                    "counterpart (fuse_accumulators mismatch)")
+            if tuple(arr.shape) != tuple(store.tensor._value.shape):
+                raise StateMismatchError(
+                    f"fused store {slot!r}: shape {tuple(arr.shape)} vs "
+                    f"live {tuple(store.tensor._value.shape)}")
+            store.pending = []
+            store.tensor.set_value(arr)
+        return
+
+    if saved_zero["stage"] != zero["stage"] \
+            or saved_zero["axis"] != zero["axis"]:
+        raise StateMismatchError(
+            f"ZeRO config mismatch: checkpoint stage="
+            f"{saved_zero['stage']} axis={saved_zero['axis']!r}, live "
+            f"stage={zero['stage']} axis={zero['axis']!r}")
+    if len(saved_zero["buckets"]) != len(zero["buckets"]):
+        raise StateMismatchError(
+            f"bucket layout mismatch: checkpoint has "
+            f"{len(saved_zero['buckets'])} buckets, live optimizer "
+            f"{len(zero['buckets'])} (comm_buffer_mb must match: "
+            f"checkpoint {saved_zero['comm_buffer_mb']}, live "
+            f"{zero['comm_buffer_mb']})")
+    mesh = zero["mesh"]
+    for zb, sdict, brec in zip(zero["buckets"], zero["stores"],
+                               saved_zero["buckets"]):
+        if list(zb.sizes) != list(brec["sizes"]) \
+                or list(zb.n_rows) != list(brec["n_rows"]):
+            raise StateMismatchError(
+                f"bucket {zb.index}: per-param row layout differs from "
+                "the checkpoint (param set or ordering changed)")
+        for slot, srec in brec["slots"].items():
+            store = sdict.get(slot)
+            if store is None:
+                raise StateMismatchError(
+                    f"bucket {zb.index}: checkpoint slot {slot!r} has no "
+                    "live store (stage/master config mismatch)")
+            _restore_store(store, brec, srec, mesh)
+        if strict:
+            extra = set(sdict) - set(brec["slots"])
+            if extra:
+                raise StateMismatchError(
+                    f"bucket {zb.index}: live slots {sorted(extra)} are "
+                    "absent from the checkpoint")
+
+
+# -- scaler / rng ----------------------------------------------------------
+
+def capture_scaler(scaler):
+    return {"scale": _np(scaler._scale._value),
+            "good_steps": _np(scaler._good_steps._value),
+            "bad_steps": _np(scaler._bad_steps._value),
+            "enable": scaler._enable}
+
+
+def restore_scaler(scaler, data):
+    scaler._scale.set_value(data["scale"])
+    scaler._good_steps.set_value(data["good_steps"])
+    scaler._bad_steps.set_value(data["bad_steps"])
+    scaler._found_inf = False
+
+
+def capture_rng():
+    from ..core import random as core_random
+    return {"key": _np(core_random.get_rng_state()._value)}
+
+
+def restore_rng(data):
+    from ..core import random as core_random
+    core_random.set_rng_state(data["key"])

@@ -64,6 +64,26 @@ class LocalFS:
             raise FSFileExistsError(path)
         open(path, "a").close()
 
+    def rename(self, src, dst):
+        """Atomic same-filesystem rename, overwriting ``dst`` — the
+        checkpoint publish primitive (one rename(2): a crash leaves
+        either the old entry or the new one, never a mix)."""
+        os.replace(src, dst)
+
+    def fsync(self, path):
+        """Flush a file (or a directory's entries) to stable storage.
+        Best-effort on filesystems that reject directory fsync."""
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+
     def upload(self, local_path, fs_path):
         if os.path.isdir(local_path):
             shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
@@ -132,6 +152,16 @@ class HDFSClient:
         if overwrite:
             self.delete(dst)
         self._run("-mv", src, dst)
+
+    def rename(self, src, dst):
+        """HDFS rename is atomic when dst does not exist; with an
+        existing dst this degrades to delete+mv (NOT crash-atomic). The
+        checkpoint core refuses HDFSClient outright — point a checkpoint
+        root at a fuse mount instead."""
+        self.mv(src, dst, overwrite=True)
+
+    def fsync(self, path):
+        pass  # HDFS persistence is the namenode's problem, not ours
 
     def upload(self, local_path, fs_path):
         self._run("-put", "-f", local_path, fs_path)

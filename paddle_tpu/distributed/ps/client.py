@@ -6,6 +6,7 @@ key hash, `common_sparse_table.cc` block partition); dense tables live on
 `table_id % nservers`. The wire protocol is the length-prefixed binary
 format of `_native/src/ps_service.cc`.
 """
+import random
 import socket
 import struct
 import threading
@@ -14,6 +15,8 @@ import time
 import numpy as np
 
 from ...observability import tracing as _obs
+from ...testing import faults as _faults
+from .retry import RetryPolicy
 
 MAGIC = 0x31535450  # b"PTS1": protocol magic/version (ps_service.cc kMagic)
 
@@ -29,6 +32,13 @@ OP_LOAD = 9
 OP_STOP = 10
 OP_SPARSE_SIZE = 11
 OP_PULL_DENSE_INIT = 12
+# request-id'd push family: payload is `u64 request_id | legacy payload`.
+# The server dedups on the id, so a retried push is applied exactly once
+# — what makes the push path idempotent and therefore retriable.
+OP_PUSH_DENSE_GRAD_ID = 13
+OP_PUSH_DENSE_DELTA_ID = 14
+OP_PUSH_SPARSE_GRAD_ID = 15
+OP_PUSH_SPARSE_DELTA_ID = 16
 OP_SPARSE_SPILL_INFO = 27
 
 # the one wire-op -> name map (client spans AND the server's per-table
@@ -40,6 +50,10 @@ _OP_NAMES = {
     OP_PUSH_DENSE_DELTA: "push_dense_delta", OP_BARRIER: "barrier",
     OP_SAVE: "save", OP_LOAD: "load", OP_STOP: "stop",
     OP_SPARSE_SIZE: "sparse_size", OP_PULL_DENSE_INIT: "pull_dense_init",
+    OP_PUSH_DENSE_GRAD_ID: "push_dense_grad",
+    OP_PUSH_DENSE_DELTA_ID: "push_dense_delta",
+    OP_PUSH_SPARSE_GRAD_ID: "push_sparse_grad",
+    OP_PUSH_SPARSE_DELTA_ID: "push_sparse_delta",
     OP_SPARSE_SPILL_INFO: "sparse_spill_info",
     20: "graph_add_nodes", 21: "graph_add_edges",
     22: "graph_sample_neighbors", 23: "graph_pull_list",
@@ -50,24 +64,43 @@ _OP_NAMES = {
 class PsClient:
     """One client per worker process; thread-safe per-server sockets.
 
-    Failure handling (reference: `brpc_ps_client.cc` retries connects under
-    FLAGS_pserver_connect_timeout_ms): connects retry with backoff so a
-    worker survives a server restart; *pull*-family calls are idempotent
-    and are re-sent over a fresh connection; *push*-family calls are NOT
-    (a re-sent grad could be applied twice) and abort loudly instead —
-    recovery for those is snapshot restore, as in the reference.
+    Failure handling (reference: `brpc_ps_client.cc` retries connects
+    under FLAGS_pserver_connect_timeout_ms — and ONLY connects): every
+    idempotent call rides ``retry_policy`` — bounded attempts,
+    exponential backoff with jitter, and a per-call deadline
+    (:class:`~.retry.RetryPolicy`), so a worker survives a server
+    restart on any of pull/push/save/load, not just at connect time.
+    The push family is idempotent by construction: each push carries a
+    u64 request id the server dedups, so a re-sent grad is applied
+    exactly once. Only the barrier stays single-shot (re-sending a
+    barrier arrival would double-count the worker). Retries are counted
+    in ``ps_retry_total``; each attempt passes the ``ps/call``
+    kill-point for deterministic fault injection.
     """
 
     CONNECT_RETRIES = 60
     CONNECT_BACKOFF = 0.25  # seconds between connect attempts (~15s window)
-    CALL_RETRIES = 5        # re-sends for idempotent calls
 
-    def __init__(self, endpoints):
+    def __init__(self, endpoints, retry_policy=None, request_id_base=None):
         self.endpoints = list(endpoints)
         self._socks = [None] * len(self.endpoints)
         self._locks = [threading.Lock() for _ in self.endpoints]
         self._sparse_dim = {}
         self._dense_dim = {}
+        self.retry_policy = retry_policy or RetryPolicy()
+        # request ids: a random 32-bit session tag + a monotonic counter.
+        # Unique across client restarts (a restarted worker must not be
+        # deduped against its predecessor's ids); request_id_base pins
+        # them for deterministic tests.
+        if request_id_base is None:
+            request_id_base = random.SystemRandom().getrandbits(32) << 31
+        self._req_counter = [int(request_id_base)]
+        self._req_lock = threading.Lock()
+
+    def _next_request_id(self):
+        with self._req_lock:
+            self._req_counter[0] += 1
+            return self._req_counter[0]
 
     # -- table metadata (client-side reshape info) ------------------------
     def register_sparse(self, table, dim):
@@ -85,13 +118,23 @@ class PsClient:
         if self._socks[i] is None:
             host, port = self.endpoints[i].rsplit(":", 1)
             last = None
+            # the whole connect window is bounded by the call deadline: a
+            # blackholed host (SYN drop, no RST) must not hold one _sock
+            # call for CONNECT_RETRIES x full TCP timeouts
+            budget = max(self.retry_policy.deadline_s, 0.1)
+            t0 = time.monotonic()
             for _ in range(self.CONNECT_RETRIES):
                 try:
-                    s = socket.create_connection((host, int(port)),
-                                                 timeout=120)
+                    s = socket.create_connection(
+                        (host, int(port)), timeout=min(120.0, budget))
                     break
                 except OSError as e:
                     last = e
+                    if time.monotonic() - t0 >= budget:
+                        raise ConnectionError(
+                            f"ps server {self.endpoints[i]} unreachable "
+                            f"within the {budget:.1f}s call deadline"
+                        ) from last
                     time.sleep(self.CONNECT_BACKOFF)
             else:
                 raise ConnectionError(
@@ -131,37 +174,61 @@ class PsClient:
                    idempotent=False):
         body = struct.pack("<IBIQ", MAGIC, op, table, n) + payload
         msg = struct.pack("<I", len(body)) + body
-        with self._locks[server]:
-            attempts = self.CALL_RETRIES if idempotent else 1
-            last = None
-            for a in range(attempts):
+        op_name = _OP_NAMES.get(op, str(op))
+
+        # idempotent calls clamp socket I/O to the call deadline (a
+        # connected-but-stalled server must not hold the caller past the
+        # policy's fail-fast promise); single-shot calls keep the long
+        # transport timeout — a barrier legitimately blocks until the
+        # slowest worker arrives (first-step compile, data skew) and
+        # timing it out at the retry deadline would strand its
+        # already-counted arrival
+        io_timeout = (min(120.0, max(self.retry_policy.deadline_s, 0.1))
+                      if idempotent else 120.0)
+
+        def attempt():
+            # the per-server lock is held per ATTEMPT, not across the
+            # whole retry window: backoff sleeps must not serialize other
+            # threads' calls behind a failing one (worst case would be
+            # N_threads x deadline instead of one deadline each)
+            with self._locks[server]:
+                _faults.kill_point("ps/call")  # chaos: error/latency
+                s = self._sock(server)
                 try:
-                    s = self._sock(server)
-                except (ConnectionError, OSError) as e:
-                    # connect failed after its own retry window: nothing was
-                    # ever transmitted, so this is safe to retry verbatim —
-                    # say so instead of prescribing a snapshot rollback
-                    raise ConnectionError(
-                        f"ps server {self.endpoints[server]} unreachable; "
-                        f"request (op={op}) was never sent and is safe to "
-                        f"retry once the server is back") from e
-                try:
+                    s.settimeout(io_timeout)
                     s.sendall(msg)
                     hdr = self._recv_exact(s, 4)
                     (rlen,) = struct.unpack("<I", hdr)
                     return self._recv_exact(s, rlen) if rlen else b""
-                except (ConnectionError, OSError) as e:
-                    last = e
+                except (ConnectionError, OSError):
                     self._drop_sock(server)
-            if idempotent:
+                    raise
+
+        if not idempotent:
+            # single-shot ops: a re-sent barrier arrival would count the
+            # worker twice; a re-sent save could interleave two writers
+            # on one snapshot file — failure surfaces raw
+            try:
+                return attempt()
+            except (ConnectionError, OSError) as e:
                 raise ConnectionError(
-                    f"ps server {self.endpoints[server]} lost after "
-                    f"{attempts} attempts: {last}") from last
-            raise ConnectionError(
-                f"connection to ps server {self.endpoints[server]} dropped "
-                f"mid-push (op={op}): refusing to re-send a non-idempotent "
-                f"update (it may already have been applied); restore from "
-                f"the last snapshot") from last
+                    f"ps server {self.endpoints[server]} lost during "
+                    f"non-retriable {op_name!r} (op={op}); the request "
+                    "may or may not have taken effect — verify "
+                    "server-side state before re-issuing it") from e
+
+        def on_retry(k, delay, exc):
+            _obs.count(f"ps_retry_{op_name}", cat="ps")
+            if _obs.enabled("ps"):
+                # the backoff gap becomes a visible span in the trace
+                now = _obs.now_ns()
+                _obs.profiler.record_span(
+                    f"ps/retry_backoff/{op_name}", "ps", now,
+                    now + int(delay * 1e9))
+
+        return self.retry_policy.run(
+            attempt, on_retry=on_retry,
+            what=f"ps {op_name!r} to {self.endpoints[server]}")
 
     @staticmethod
     def _recv_exact(s, n):
@@ -191,15 +258,19 @@ class PsClient:
         return np.frombuffer(raw, np.float32).copy()
 
     def push_dense_grad(self, table, grad):
-        payload = np.ascontiguousarray(grad, np.float32).tobytes()
+        payload = struct.pack("<Q", self._next_request_id()) + \
+            np.ascontiguousarray(grad, np.float32).tobytes()
         self._check_ok(self._call(self._dense_server(table),
-                                  OP_PUSH_DENSE_GRAD, table, 0, payload),
+                                  OP_PUSH_DENSE_GRAD_ID, table, 0, payload,
+                                  idempotent=True),
                        table)
 
     def push_dense_delta(self, table, delta):
-        payload = np.ascontiguousarray(delta, np.float32).tobytes()
+        payload = struct.pack("<Q", self._next_request_id()) + \
+            np.ascontiguousarray(delta, np.float32).tobytes()
         self._check_ok(self._call(self._dense_server(table),
-                                  OP_PUSH_DENSE_DELTA, table, 0, payload),
+                                  OP_PUSH_DENSE_DELTA_ID, table, 0, payload,
+                                  idempotent=True),
                        table)
 
     @staticmethod
@@ -227,10 +298,10 @@ class PsClient:
         return out
 
     def push_sparse_grad(self, table, keys, grads):
-        self._push_sparse(OP_PUSH_SPARSE_GRAD, table, keys, grads)
+        self._push_sparse(OP_PUSH_SPARSE_GRAD_ID, table, keys, grads)
 
     def push_sparse_delta(self, table, keys, deltas):
-        self._push_sparse(OP_PUSH_SPARSE_DELTA, table, keys, deltas)
+        self._push_sparse(OP_PUSH_SPARSE_DELTA_ID, table, keys, deltas)
 
     def _push_sparse(self, op, table, keys, vals):
         dim = self._sparse_dim[table]
@@ -242,8 +313,12 @@ class PsClient:
         merged = np.zeros((uniq.size, dim), np.float32)
         np.add.at(merged, inv, vals)
         for srv, idx in self._shard(uniq):
-            payload = uniq[idx].tobytes() + merged[idx].tobytes()
-            self._check_ok(self._call(srv, op, table, idx.size, payload),
+            # one request id per server shard: each shard's push dedups
+            # independently (only the lost one is re-applied on retry)
+            payload = struct.pack("<Q", self._next_request_id()) + \
+                uniq[idx].tobytes() + merged[idx].tobytes()
+            self._check_ok(self._call(srv, op, table, idx.size, payload,
+                                      idempotent=True),
                            table)
 
     def _shard(self, keys):
@@ -262,9 +337,13 @@ class PsClient:
         self._call(0, OP_BARRIER, 0, n_workers)
 
     def save(self, path_prefix):
+        # single-shot: a timed-out save retried while the original is
+        # still writing would put two writers on one snapshot file. The
+        # server writes tmp+rename, so a failed/interrupted save never
+        # destroys an existing good snapshot — re-issue explicitly.
         for i in range(self.n_servers):
             raw = self._call(i, OP_SAVE, 0, 0,
-                             f"{path_prefix}.{i}".encode(), idempotent=True)
+                             f"{path_prefix}.{i}".encode())
             if struct.unpack("<I", raw)[0] != 1:
                 raise RuntimeError(
                     f"ps server {i} failed to write snapshot "

@@ -44,12 +44,17 @@ def server_op_stats():
 
 def _stats_collector():
     """Scrape-time collector: per-table per-op latency counters with
-    Prometheus labels (ps_server_op_{calls,ns}{table=...,op=...})."""
+    Prometheus labels (ps_server_op_{calls,ns}{table=...,op=...}) plus
+    the push request-id dedup counter (retries acked without
+    re-applying — the server-side twin of the client's ps_retry_total)."""
     out = {}
     for r in server_op_stats():
         key = f'{{table="{r["table"]}",op="{r["op"]}"}}'
         out[f"ps_server_op_calls{key}"] = r["calls"]
         out[f"ps_server_op_ns{key}"] = r["ns"]
+    lib = _native.lib()
+    if lib is not None:
+        out["ps_server_dup_requests"] = int(lib.pt_ps_dup_requests())
     return out
 
 

@@ -2,17 +2,21 @@
 auto_checkpoint.py` — ExeTrainStatus, TrainEpochRange:265, train_epoch_range
 loops with epoch-granularity save/restore keyed by job id).
 
-TPU re-design: checkpoints are paddle_tpu.save state-dicts in a
-job-id-keyed directory (local or fuse-mounted cloud path, via
-fleet.utils.fs.LocalFS); restore resumes the epoch loop past completed
-epochs. Hooks register models/optimizers, matching the reference's
-_auto_checkpoint decorator flow.
+Rebased onto :mod:`paddle_tpu.checkpoint` (the crash-consistent step
+checkpoint core): the old implementation wrote ``meta.json`` + one
+pickle per component NON-atomically — a crash mid-save left a torn
+checkpoint that poisoned restore — and its ``checkpoint_inter`` gate
+stamped ``_last_save`` *before* the save succeeded, so a failing save
+silently suppressed every retry inside the interval. Now each epoch
+save is one atomically published ``step_<epoch>/`` directory (manifest
++ content hashes + fsync + rename; see ``checkpoint.core``), restore
+only ever accepts a checkpoint that validates, and ``_last_save``
+advances only after a save actually lands.
 """
-import json
 import os
 import time
 
-from .. import serialization
+from .. import checkpoint as _ckpt
 from ..distributed.fleet.utils.fs import LocalFS
 
 __all__ = ["TrainEpochRange", "train_epoch_range", "get_checkpoint_dir"]
@@ -25,10 +29,12 @@ def get_checkpoint_dir():
 
 class TrainEpochRange:
     """Iterate epochs with automatic save at epoch end + resume at start
-    (reference: auto_checkpoint.py TrainEpochRange:265)."""
+    (reference: auto_checkpoint.py TrainEpochRange:265). Saves ride the
+    checkpoint core: models, optimizers and the RNG key are captured
+    into one atomic checkpoint per epoch, keep-last-2 garbage-collected."""
 
     def __init__(self, max_epoch_num, name, checkpoint_inter=None,
-                 save_checkpoint=True, fs=None):
+                 save_checkpoint=True, fs=None, keep_last_n=2):
         self.max_epoch_num = max_epoch_num
         self.name = name
         self.save_checkpoint = save_checkpoint
@@ -37,42 +43,51 @@ class TrainEpochRange:
         self._fs = fs or LocalFS()
         job_id = os.environ.get("PADDLE_JOB_ID", "job_default")
         self._dir = os.path.join(get_checkpoint_dir(), job_id, name)
-        self._models = {}
-        self._optimizers = {}
+        self._mgr = _ckpt.CheckpointManager(self._dir, fs=self._fs,
+                                            keep_last_n=keep_last_n)
         self.restored_from = None
         self._start_epoch = 0
         self._load_meta()
 
     # -- registration -------------------------------------------------------
     def add_model(self, model, name="model"):
-        self._models[name] = model
+        self._mgr.add_model(model, name)
         return self
 
     def add_optimizer(self, optimizer, name="opt"):
-        self._optimizers[name] = optimizer
+        self._mgr.add_optimizer(optimizer, name)
+        return self
+
+    def add_scaler(self, scaler, name="scaler"):
+        self._mgr.add_scaler(scaler, name)
         return self
 
     # -- persistence --------------------------------------------------------
-    def _meta_path(self):
-        return os.path.join(self._dir, "meta.json")
-
     def _load_meta(self):
-        if not self._fs.is_file(self._meta_path()):
+        """Cheap manifest-only peek (no payload reads/hashing — a
+        multi-GB checkpoint must not be read twice at job startup). The
+        authoritative epoch comes from the meta the actual restore
+        returns in get(); this just primes the loop bounds."""
+        found = _ckpt.core.peek_meta(self._dir, fs=self._fs)
+        if found is None:
             return
-        with open(self._meta_path()) as f:
-            meta = json.load(f)
+        _step, meta = found
         self._start_epoch = int(meta.get("next_epoch", 0))
         self.restored_from = meta.get("saved_at_epoch")
 
     def _restore_states(self):
-        for name, m in self._models.items():
-            p = os.path.join(self._dir, f"{name}.pdparams")
-            if self._fs.is_file(p):
-                m.set_state_dict(serialization.load(p))
-        for name, o in self._optimizers.items():
-            p = os.path.join(self._dir, f"{name}.pdopt")
-            if self._fs.is_file(p):
-                o.set_state_dict(serialization.load(p))
+        """One full validated restore; re-anchor the resume epoch on the
+        checkpoint that actually restored (the peeked newest one may
+        have failed payload validation and been skipped)."""
+        meta = self._mgr.restore(strict=False)
+        if meta is None:
+            self._start_epoch = 0
+            self.restored_from = None
+        else:
+            self._start_epoch = int(meta.get("next_epoch",
+                                             self._start_epoch))
+            self.restored_from = meta.get("saved_at_epoch",
+                                          self.restored_from)
 
     def _save(self, epoch):
         if not self.save_checkpoint:
@@ -81,19 +96,10 @@ class TrainEpochRange:
                 and time.time() - self._last_save < self.checkpoint_inter
                 and epoch + 1 < self.max_epoch_num):
             return
-        self._fs.mkdirs(self._dir)
-        for name, m in self._models.items():
-            serialization.save(m.state_dict(),
-                               os.path.join(self._dir, f"{name}.pdparams"))
-        for name, o in self._optimizers.items():
-            if hasattr(o, "state_dict"):
-                serialization.save(o.state_dict(),
-                                   os.path.join(self._dir, f"{name}.pdopt"))
-        tmp = self._meta_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"next_epoch": epoch + 1, "saved_at_epoch": epoch,
-                       "time": time.time()}, f)
-        os.replace(tmp, self._meta_path())
+        self._mgr.save(epoch, extra_meta={"next_epoch": epoch + 1,
+                                          "saved_at_epoch": epoch})
+        # stamped only AFTER the atomic publish: a failed/interrupted
+        # save must not eat the next interval's retry
         self._last_save = time.time()
 
     # -- iteration ----------------------------------------------------------

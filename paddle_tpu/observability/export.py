@@ -27,6 +27,7 @@ from .. import monitor
 __all__ = ["publish", "gauges", "prometheus_text", "telemetry_dict",
            "write_json", "start_http_server", "register_collector",
            "unregister_collector", "summary", "summaries", "Summary",
+           "register_health", "unregister_health", "health_dict",
            "PROM_PREFIX", "SUMMARY_QUANTILES"]
 
 PROM_PREFIX = "paddle_tpu"
@@ -180,6 +181,46 @@ def collected():
     return out
 
 
+# readiness/health providers: name -> zero-arg fn returning a component
+# snapshot dict with a "status" key ("ok" = serviceable; anything else
+# degrades the process). Long-lived subsystems (a serving Engine)
+# register for their lifetime; the shared HTTP server exposes the
+# aggregate on /healthz (200 while every component is "ok", 503
+# otherwise — the readiness-probe contract).
+_health = {}
+_health_lock = threading.Lock()
+
+
+def register_health(name, fn):
+    with _health_lock:
+        _health[name] = fn
+
+
+def unregister_health(name):
+    with _health_lock:
+        _health.pop(name, None)
+
+
+def health_dict():
+    """Aggregate readiness snapshot: overall status + per-component
+    snapshots. A provider that raises is reported as status "error"
+    (and degrades the aggregate) instead of killing the probe."""
+    with _health_lock:
+        items = list(_health.items())
+    comps = {}
+    ok = True
+    for name, fn in items:
+        try:
+            d = dict(fn() or {})
+        except Exception as e:
+            d = {"status": "error", "error": str(e)[:300]}
+        comps[name] = d
+        if d.get("status", "ok") != "ok":
+            ok = False
+    return {"status": "ok" if ok else "degraded", "time": time.time(),
+            "components": comps}
+
+
 def publish(prefix, values):
     """Publish last-value gauges (e.g. a StepTimer telemetry dict) under
     ``<prefix>_<key>``. Non-numeric / None values are skipped."""
@@ -295,6 +336,19 @@ def start_http_server(port=0, addr="127.0.0.1"):
             elif self.path.startswith("/telemetry"):
                 body = json.dumps(telemetry_dict()).encode()
                 ctype = "application/json"
+            elif self.path.startswith("/healthz"):
+                # readiness probe: 200 only while every registered
+                # component reports "ok" — a load balancer drains this
+                # replica the moment an engine closes or a worker dies
+                h = health_dict()
+                body = json.dumps(h).encode()
+                code = 200 if h["status"] == "ok" else 503
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             else:
                 self.send_response(404)
                 self.end_headers()

@@ -29,7 +29,7 @@ __all__ = ["enable", "disable", "enabled", "trace_span", "current_span",
 
 # every instrumented subsystem; "dispatch" is opt-in (sampled per-op spans)
 CATEGORIES = ("executor", "jit", "dataloader", "collective", "ps",
-              "dispatch", "step", "serving", "user")
+              "dispatch", "step", "serving", "checkpoint", "user")
 DEFAULT_CATEGORIES = frozenset(c for c in CATEGORIES if c != "dispatch")
 
 _enabled_cats = [None]  # None = disabled; frozenset of categories otherwise

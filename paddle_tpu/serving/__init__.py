@@ -18,13 +18,14 @@ executables. Scrape `serving_*` counters + p50/p95/p99 latency summaries
 from ``observability.export.start_http_server(port)``'s ``/metrics``.
 """
 from . import batching, passes  # noqa: F401
-from .batching import DynamicBatcher, Request  # noqa: F401
+from .batching import (DeadlineExceeded, DynamicBatcher,  # noqa: F401
+                       OverloadedError, Request)
 from .engine import (DEFAULT_BUCKET_LADDER, Engine,  # noqa: F401
                      create_engine)
 from .passes import build_serving_program, serving_bf16_cast_pass  # noqa: F401
 
 __all__ = [
     "Engine", "create_engine", "DEFAULT_BUCKET_LADDER",
-    "DynamicBatcher", "Request",
+    "DynamicBatcher", "Request", "OverloadedError", "DeadlineExceeded",
     "build_serving_program", "serving_bf16_cast_pass",
 ]

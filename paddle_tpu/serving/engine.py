@@ -35,11 +35,19 @@ from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
 from ..observability import export as _export
 from ..observability import tracing as _obs
-from .batching import DynamicBatcher, Request
+from ..testing import faults as _faults
+from .batching import (DeadlineExceeded, DynamicBatcher, OverloadedError,
+                       Request)
 
-__all__ = ["Engine", "create_engine", "DEFAULT_BUCKET_LADDER"]
+__all__ = ["Engine", "create_engine", "DEFAULT_BUCKET_LADDER",
+           "OverloadedError", "DeadlineExceeded"]
 
 DEFAULT_BUCKET_LADDER = (1, 4, 16, 64)
+
+# health-component naming for concurrent engines (itertools.count:
+# atomic __next__, so racing constructors can't share a name and later
+# unregister each other's /healthz component)
+_ENGINE_SEQ = __import__("itertools").count(1)
 
 
 class _Prepared:
@@ -180,11 +188,22 @@ class Engine:
     loaded ``ServedProgram``, or come via :meth:`from_program` /
     :meth:`from_layer`. ``passes``: subset of ``{"bf16", "donate"}``.
     ``outputs``: optional subset of output names to serve (prune-to-fetch).
+
+    Graceful degradation: ``max_pending`` caps the request queue — the
+    excess fast-fails with :class:`OverloadedError` (load shedding,
+    counted in ``serving_shed_total``) instead of stretching every
+    caller's latency; ``request_deadline_ms`` gives each request a
+    deadline — one that expires while queued resolves exceptionally with
+    :class:`DeadlineExceeded` (``serving_deadline_expired_total``)
+    rather than burning a device step. :meth:`health` is the readiness
+    snapshot, registered on the shared ``/metrics`` HTTP server's
+    ``/healthz`` endpoint for the engine's lifetime.
     """
 
     def __init__(self, model, bucket_ladder=DEFAULT_BUCKET_LADDER,
                  max_batch_size=None, batch_timeout_ms=2.0, passes=(),
-                 outputs=None, _source=None):
+                 outputs=None, max_pending=None, request_deadline_ms=None,
+                 _source=None):
         import jax
 
         from ..jit import compile_cache
@@ -253,14 +272,27 @@ class Engine:
         self._lock = threading.Lock()
         self._stats = {"requests": 0, "batches": 0,
                        "multi_request_batches": 0, "padded_rows": 0,
-                       "errors": 0, "chunked_requests": 0}
+                       "errors": 0, "chunked_requests": 0, "shed": 0,
+                       "deadline_expired": 0}
+        if request_deadline_ms is not None \
+                and float(request_deadline_ms) <= 0:
+            raise ValueError(f"request_deadline_ms must be > 0, got "
+                             f"{request_deadline_ms!r}")
+        self.request_deadline_ms = (None if request_deadline_ms is None
+                                    else float(request_deadline_ms))
+        self.max_pending = max_pending
         # resolve the summary boards once: the request path must not take
         # the global summary-registry lock per request
         self._lat_summary = _export.summary("serving_latency_ms")
         self._wait_summary = _export.summary("serving_queue_wait_ms")
         self._dev_summary = _export.summary("serving_device_ms")
+        self._closed = False
         self._batcher = DynamicBatcher(self._run_batch, self.max_batch_size,
-                                       batch_timeout_ms)
+                                       batch_timeout_ms,
+                                       max_pending=max_pending,
+                                       on_expired=self._on_expired)
+        self._health_name = f"serving_engine_{next(_ENGINE_SEQ)}"
+        _export.register_health(self._health_name, self.health)
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -352,28 +384,61 @@ class Engine:
         raise ValueError(f"{rows} rows exceed the largest bucket "
                          f"{self.bucket_ladder[-1]}")
 
-    def submit(self, *inputs):
+    def submit(self, *inputs, deadline_ms=None):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         resolving to ``[output arrays]`` (batch rows match the request).
-        Requests larger than the top bucket are chunked transparently."""
+        Requests larger than the top bucket are chunked transparently.
+        ``deadline_ms`` overrides the engine's ``request_deadline_ms``
+        for this request; raises :class:`OverloadedError` synchronously
+        when admission control sheds it."""
         arrays = self._validate(inputs)
+        if deadline_ms is None:
+            deadline_ms = self.request_deadline_ms
+        deadline = (None if deadline_ms is None
+                    else _time.perf_counter() + float(deadline_ms) / 1e3)
         rows = arrays[0].shape[0]
         if rows <= self.max_batch_size:
-            return self._batcher.submit(Request(arrays, rows))
+            return self._submit_one(Request(arrays, rows,
+                                            deadline=deadline))
         with self._lock:
             self._stats["chunked_requests"] += 1
         chunk = self.max_batch_size
         futures = []
         for off in range(0, rows, chunk):
             part = tuple(a[off:off + chunk] for a in arrays)
-            futures.append(self._batcher.submit(
-                Request(part, part[0].shape[0])))
+            try:
+                futures.append(self._submit_one(
+                    Request(part, part[0].shape[0], deadline=deadline)))
+            except OverloadedError:
+                # all-or-nothing admission: roll back the chunks already
+                # queued (cancelled requests drop at the worker without a
+                # device step) so a shed oversized request neither holds
+                # scarce max_pending slots nor burns compute on rows its
+                # caller will retry elsewhere
+                for f in futures:
+                    f.cancel()
+                raise
         return _concat_future(futures)
 
-    def predict(self, *inputs):
+    def _submit_one(self, request):
+        try:
+            return self._batcher.submit(request)
+        except OverloadedError:
+            with self._lock:
+                self._stats["shed"] += 1
+            _monitor.stat_add("serving_shed_total", 1)
+            raise
+
+    def _on_expired(self, request):
+        """Batcher callback: a queued request's deadline lapsed."""
+        with self._lock:
+            self._stats["deadline_expired"] += 1
+        _monitor.stat_add("serving_deadline_expired_total", 1)
+
+    def predict(self, *inputs, deadline_ms=None):
         """Synchronous request: submit + wait. Thread-safe — N caller
         threads coalesce into shared device steps."""
-        return self.submit(*inputs).result()
+        return self.submit(*inputs, deadline_ms=deadline_ms).result()
 
     run = predict  # Predictor-style alias
 
@@ -384,11 +449,43 @@ class Engine:
         s["executables"] = len(self._execs)
         s["bucket_ladder"] = self.bucket_ladder
         s["pending"] = self._batcher.pending()
+        s["max_pending"] = self.max_pending
         return s
 
+    def health(self):
+        """Readiness/health snapshot — registered on the shared metrics
+        server's ``/healthz`` for the engine's lifetime. ``status`` is
+        "ok" while the worker is serviceable, "closed" after close(),
+        "dead" if the worker thread crashed."""
+        if self._closed:
+            status = "closed"
+        elif not self._batcher.alive():
+            status = "dead"
+        else:
+            status = "ok"
+        with self._lock:
+            shed = self._stats["shed"]
+            expired = self._stats["deadline_expired"]
+            errors = self._stats["errors"]
+            served = self._stats["requests"]
+        return {"status": status, "ready": status == "ok",
+                "executables": len(self._execs),
+                "bucket_ladder": list(self.bucket_ladder),
+                "pending": self._batcher.pending(),
+                "max_pending": self.max_pending,
+                "requests_total": served, "errors_total": errors,
+                "shed_total": shed, "deadline_expired_total": expired}
+
     def close(self, timeout=30):
-        """Drain queued requests and stop the batcher thread."""
+        """Drain queued requests, stop the batcher thread, and drop the
+        engine's health component. A FAILED drain (wedged device step)
+        keeps the component registered — status "closed"/"dead" makes
+        /healthz return 503, which is exactly when the load balancer
+        must stop routing here; unregistering would revert the replica
+        to a lying 200."""
+        self._closed = True
         self._batcher.close(timeout=timeout)
+        _export.unregister_health(self._health_name)
 
     def __enter__(self):
         return self
@@ -457,6 +554,10 @@ class Engine:
         try:
             with _obs.trace_span("serving/device_step", cat="serving",
                                  bucket=bucket, requests=len(batch)):
+                # chaos seam: an injected device-step failure takes the
+                # same path as a real one (all futures resolve with the
+                # exception; the worker stays serviceable)
+                _faults.kill_point("serving/device_step")
                 t_dev = _time.perf_counter()
                 outs = self._execs[bucket](self._params, *cols)
                 outs = [np.asarray(o) for o in outs]  # true sync
@@ -526,9 +627,19 @@ def _concat_future(parts):
             last = remaining[0] == 0
         if agg.done():
             return
-        exc = _f.exception()
-        if exc is not None:
-            _resolve(agg, exception=exc)
+        exc = _f.exception() if not _f.cancelled() else None
+        if exc is not None or _f.cancelled():
+            # first failed chunk decides the aggregate. Resolve BEFORE
+            # cancelling siblings: cancel() fires their done-callbacks
+            # synchronously, and a nested _on_done must find agg already
+            # resolved with the REAL error (not race it with
+            # CancelledError). Then drop the still-queued siblings so
+            # they don't burn device steps on rows the caller lost.
+            _resolve(agg, exception=exc if exc is not None
+                     else futures.CancelledError())
+            for p in parts:
+                if p is not _f:
+                    p.cancel()
             return
         if last:
             results = [p.result() for p in parts]

@@ -1,0 +1,12 @@
+"""Test harnesses shipped with the framework.
+
+``paddle_tpu.testing.faults`` is the deterministic fault-injection
+harness the chaos tests drive: named kill-points instrumented into the
+checkpoint writer, the PS RPC client, and the serving batcher fire
+injected exceptions/latency on demand (reference analog: the fault
+tables the reference's fleet elastic tests script against etcd — here
+the faults are in-process and fully deterministic).
+"""
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

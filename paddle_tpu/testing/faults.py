@@ -1,0 +1,148 @@
+"""Deterministic fault injection: named kill-points.
+
+Production code calls :func:`kill_point` at failure-prone stages (each
+checkpoint write stage, every PS RPC attempt, the serving device step).
+Unarmed, a kill-point only bumps a hit counter. A test arms one with
+:func:`inject` — the next ``times`` hits (after ``skip`` free passes)
+raise the injected exception and/or sleep an injected latency, with no
+randomness anywhere: which hit fires is a pure function of the counters,
+so a chaos test replays bit-identically.
+
+Instrumented points (grep for ``kill_point(`` to enumerate):
+
+- ``checkpoint/*``   — every stage of the crash-consistent checkpoint
+  write (see ``paddle_tpu.checkpoint.core.KILL_POINTS``)
+- ``ps/call``        — each PS RPC attempt, before anything hits the
+  socket (inject ``ConnectionError`` to exercise retry/backoff, or
+  ``latency_s`` to exercise deadlines)
+- ``serving/device_step`` — the serving engine's batched device step
+"""
+import threading
+import time
+
+__all__ = ["FaultInjected", "inject", "clear", "kill_point", "hits",
+           "fired", "armed", "reset", "scoped"]
+
+
+class FaultInjected(Exception):
+    """Default exception raised by an armed kill-point."""
+
+    def __init__(self, point):
+        self.point = point
+        super().__init__(f"injected fault at kill-point {point!r}")
+
+
+class _Fault:
+    __slots__ = ("exc", "times", "skip", "latency_s")
+
+    def __init__(self, exc, times, skip, latency_s):
+        self.exc = exc
+        self.times = times
+        self.skip = skip
+        self.latency_s = latency_s
+
+
+_lock = threading.RLock()
+_armed = {}   # point -> _Fault
+_hits = {}    # point -> kill_point passes (armed or not)
+_fired = {}   # point -> injections actually raised/slept
+
+
+def inject(point, exc=FaultInjected, times=1, skip=0, latency_s=0.0):
+    """Arm ``point``: after ``skip`` free passes, the next ``times`` hits
+    sleep ``latency_s`` (if non-zero) and raise ``exc`` (an exception
+    class — instantiated with the point name when it accepts one arg —
+    or a ready instance; ``exc=None`` injects latency only)."""
+    with _lock:
+        _armed[point] = _Fault(exc, int(times), int(skip), float(latency_s))
+    return point
+
+
+def clear(point=None):
+    """Disarm one kill-point, or all of them (``point=None``)."""
+    with _lock:
+        if point is None:
+            _armed.clear()
+        else:
+            _armed.pop(point, None)
+
+
+def reset():
+    """Disarm everything and zero the hit/fired counters."""
+    with _lock:
+        _armed.clear()
+        _hits.clear()
+        _fired.clear()
+
+
+def hits(point):
+    with _lock:
+        return _hits.get(point, 0)
+
+
+def fired(point):
+    with _lock:
+        return _fired.get(point, 0)
+
+
+def armed(point):
+    with _lock:
+        return point in _armed
+
+
+def _make_exc(exc, point):
+    if exc is None:
+        return None
+    if isinstance(exc, BaseException):
+        return exc
+    try:
+        return exc(point)
+    except TypeError:
+        return exc()
+
+
+def kill_point(point):
+    """Mark a failure-prone stage. No-op (one dict increment) unless a
+    test armed this point with :func:`inject`."""
+    with _lock:
+        _hits[point] = _hits.get(point, 0) + 1
+        f = _armed.get(point)
+        if f is None:
+            return
+        if f.skip > 0:
+            f.skip -= 1
+            return
+        if f.times <= 0:
+            return
+        f.times -= 1
+        if f.times <= 0:
+            del _armed[point]
+        _fired[point] = _fired.get(point, 0) + 1
+        latency = f.latency_s
+        exc = _make_exc(f.exc, point)
+    # sleep OUTSIDE the lock: a latency injection must not serialize
+    # every other kill-point in the process behind it
+    if latency:
+        time.sleep(latency)
+    if exc is not None:
+        raise exc
+
+
+class scoped:
+    """Context manager: arm on enter, disarm on exit (exception-safe).
+
+    >>> with faults.scoped("ps/call", exc=ConnectionError, times=2):
+    ...     client.pull_dense(0)   # first two attempts fail, third wins
+    """
+
+    def __init__(self, point, **kwargs):
+        self.point = point
+        self.kwargs = kwargs
+
+    def __enter__(self):
+        inject(self.point, **self.kwargs)
+        return self
+
+    def __exit__(self, *exc):
+        clear(self.point)
+        return False

@@ -16,6 +16,10 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running; excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests "
+        "(testing.faults kill-points); the fast subset runs in tier-1, "
+        "run `pytest -m chaos` to select the whole family")
 
 
 @pytest.fixture(autouse=True)

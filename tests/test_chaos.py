@@ -1,0 +1,448 @@
+"""Deterministic fault injection: the chaos-test tier.
+
+Drives the named kill-points (`paddle_tpu.testing.faults`) instrumented
+into the PS RPC client, the serving engine's device step, and the
+checkpoint writer (the checkpoint sweep lives in test_checkpoint.py):
+injected connection errors must ride the bounded-backoff retry path,
+injected latency must trip deadlines, overload must shed FAST, and a
+failing device step must resolve every in-flight future without killing
+the worker. Everything here is deterministic — counters, seeded jitter,
+no real network flakes.
+"""
+import json
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, nn, serving
+from paddle_tpu.distributed.ps import client as ps_client_mod
+from paddle_tpu.distributed.ps.retry import (DeadlineExceeded, RetryPolicy,
+                                             RetriesExhausted)
+from paddle_tpu.observability import export as obs_export
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- the harness itself -----------------------------------------------------
+
+class TestFaultHarness:
+    def test_unarmed_kill_point_only_counts(self):
+        n0 = faults.hits("x/y")
+        faults.kill_point("x/y")
+        assert faults.hits("x/y") == n0 + 1
+        assert faults.fired("x/y") == 0
+
+    def test_times_skip_and_clear(self):
+        faults.inject("p", times=2, skip=1)
+        faults.kill_point("p")  # skipped
+        with pytest.raises(faults.FaultInjected):
+            faults.kill_point("p")
+        with pytest.raises(faults.FaultInjected):
+            faults.kill_point("p")
+        faults.kill_point("p")  # exhausted: disarmed
+        assert faults.fired("p") == 2 and not faults.armed("p")
+
+    def test_exception_instance_and_latency(self):
+        faults.inject("q", exc=ValueError("boom"), latency_s=0.05)
+        t0 = time.perf_counter()
+        with pytest.raises(ValueError, match="boom"):
+            faults.kill_point("q")
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_scoped(self):
+        with faults.scoped("s", times=5):
+            assert faults.armed("s")
+        assert not faults.armed("s")
+
+
+# -- retry policy -----------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_backoff_grows_exponentially_with_bounded_jitter(self):
+        pol = RetryPolicy(max_attempts=6, base_delay_s=0.1, max_delay_s=1.0,
+                          multiplier=2.0, jitter=0.5, seed=7,
+                          sleep=lambda s: None)
+        delays = [pol.backoff_s(k) for k in range(2, 7)]
+        for i, d in enumerate(delays):
+            nominal = min(0.1 * 2.0 ** i, 1.0)
+            assert 0.5 * nominal <= d <= 1.5 * nominal, (i, d)
+        # seeded jitter replays bit-identically
+        pol2 = RetryPolicy(max_attempts=6, base_delay_s=0.1,
+                           max_delay_s=1.0, jitter=0.5, seed=7)
+        assert delays == [pol2.backoff_s(k) for k in range(2, 7)]
+
+    def test_run_retries_then_succeeds(self):
+        sleeps = []
+        pol = RetryPolicy(max_attempts=4, base_delay_s=0.01, seed=1,
+                          sleep=sleeps.append)
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise ConnectionError("nope")
+            return "ok"
+
+        monitor.stat_reset("ps_retry_total")
+        assert pol.run(fn) == "ok"
+        assert calls[0] == 3 and len(sleeps) == 2
+        assert monitor.stat_get("ps_retry_total") == 2
+
+    def test_exhaustion_chains_last_error(self):
+        pol = RetryPolicy(max_attempts=2, base_delay_s=0.001, seed=1)
+        with pytest.raises(RetriesExhausted, match="2 attempts"):
+            pol.run(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+
+    def test_deadline_fails_fast_not_late(self):
+        # clock injectable: the 3rd attempt's backoff would cross the
+        # deadline -> DeadlineExceeded BEFORE sleeping, not after
+        now = [0.0]
+        pol = RetryPolicy(max_attempts=10, base_delay_s=0.4, jitter=0.0,
+                          deadline_s=1.0, sleep=lambda s: None,
+                          clock=lambda: now[0])
+
+        def fn():
+            now[0] += 0.3
+            raise ConnectionError("down")
+
+        with pytest.raises(DeadlineExceeded):
+            pol.run(fn)
+        assert now[0] < 1.5  # failed around the deadline, not attempts x base
+
+
+# -- PS client under injected faults ---------------------------------------
+
+def _ps_pair(tmp_scope="chaos", **cli_kw):
+    from paddle_tpu.distributed.ps import PsClient, PsServer, TableConfig
+    srv = PsServer([TableConfig(0, "dense", 0, "sgd", lr=1.0),
+                    TableConfig(1000, "sparse", 4, "sgd", lr=1.0)], port=0)
+    port = srv.start()
+    cli_kw.setdefault("retry_policy",
+                      RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                  deadline_s=5.0, seed=3))
+    cli_kw.setdefault("request_id_base", 7 << 40)
+    cli = PsClient([f"127.0.0.1:{port}"], **cli_kw)
+    cli.register_dense(0, 6)
+    cli.register_sparse(1000, 4)
+    return srv, cli
+
+
+class TestPsChaos:
+    def test_pull_retries_injected_connection_errors(self):
+        srv, cli = _ps_pair()
+        try:
+            cli.pull_dense_init(0, np.zeros(6, np.float32))
+            monitor.stat_reset("ps_retry_total")
+            faults.inject("ps/call", exc=ConnectionError, times=2)
+            v = cli.pull_dense(0)
+            assert np.allclose(v, 0.0)
+            assert monitor.stat_get("ps_retry_total") == 2
+        finally:
+            cli.stop_servers()
+            srv.stop()
+            cli.close()
+
+    def test_push_retry_applies_exactly_once(self):
+        """The headline idempotency contract: a push whose first attempt
+        dies rides the retry path and the grad lands EXACTLY once."""
+        srv, cli = _ps_pair()
+        try:
+            cli.pull_dense_init(0, np.zeros(6, np.float32))
+            faults.inject("ps/call", exc=ConnectionError, times=1)
+            cli.push_dense_grad(0, np.ones(6, np.float32))
+            assert np.allclose(cli.pull_dense(0), -1.0)  # sgd lr=1
+            # sparse too, through the sharded id'd push
+            keys = np.array([3, 9], np.uint64)
+            faults.inject("ps/call", exc=ConnectionError, times=1)
+            cli.push_sparse_grad(1000, keys, np.ones((2, 4), np.float32))
+            assert np.allclose(cli.pull_sparse(1000, keys), -1.0)
+        finally:
+            cli.stop_servers()
+            srv.stop()
+            cli.close()
+
+    def test_duplicate_request_id_deduped_server_side(self):
+        """Raw re-send of the SAME request id (a retry whose original
+        DID land but whose response was lost) is acknowledged without
+        being applied twice."""
+        srv, cli = _ps_pair()
+        try:
+            cli.pull_dense_init(0, np.zeros(6, np.float32))
+            payload = struct.pack("<Q", 424242) + \
+                np.ones(6, np.float32).tobytes()
+            for _ in range(3):
+                cli._check_ok(cli._call(
+                    0, ps_client_mod.OP_PUSH_DENSE_GRAD_ID, 0, 0, payload,
+                    idempotent=True), 0)
+            assert np.allclose(cli.pull_dense(0), -1.0)  # once, not thrice
+        finally:
+            cli.stop_servers()
+            srv.stop()
+            cli.close()
+
+    def test_injected_latency_trips_call_deadline(self):
+        srv, cli = _ps_pair(
+            retry_policy=RetryPolicy(max_attempts=10, base_delay_s=0.01,
+                                     deadline_s=0.15, seed=3))
+        try:
+            cli.pull_dense_init(0, np.zeros(6, np.float32))
+            faults.inject("ps/call", exc=ConnectionError, latency_s=0.1,
+                          times=5)
+            t0 = time.monotonic()
+            with pytest.raises(DeadlineExceeded):
+                cli.pull_dense(0)
+            assert time.monotonic() - t0 < 2.0  # fast-fail, not 10 retries
+        finally:
+            faults.clear()
+            cli.stop_servers()
+            srv.stop()
+            cli.close()
+
+    def test_barrier_stays_single_shot(self):
+        """A barrier arrival must never be silently re-sent (it would
+        double-count the worker): an injected failure surfaces raw."""
+        srv, cli = _ps_pair()
+        try:
+            faults.inject("ps/call", exc=ConnectionError, times=1)
+            with pytest.raises(ConnectionError, match="non-retriable"):
+                cli.barrier(2)
+            assert faults.fired("ps/call") == 1  # exactly one attempt
+        finally:
+            faults.clear()
+            cli.stop_servers()
+            srv.stop()
+            cli.close()
+
+
+# -- serving engine under injected faults -----------------------------------
+
+def _engine(**kw):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    m.eval()
+    kw.setdefault("bucket_ladder", (1, 4))
+    kw.setdefault("batch_timeout_ms", 1.0)
+    return serving.Engine.from_layer(m, [([None, 8], "float32")], **kw)
+
+
+_X2 = np.random.RandomState(0).rand(2, 8).astype(np.float32)
+_X4 = np.random.RandomState(1).rand(4, 8).astype(np.float32)
+
+
+class TestServingChaos:
+    def test_device_step_failure_resolves_all_futures_no_hang(self):
+        """Acceptance (satellite): injected device-step failures reach
+        every in-flight future, no caller hangs, and the worker stays
+        serviceable for subsequent requests."""
+        eng = _engine(max_batch_size=4)
+        try:
+            eng.predict(_X2)  # warm
+            monitor.stat_reset("serving_request_errors_total")
+            faults.inject("serving/device_step",
+                          exc=RuntimeError("chaos step"), times=1)
+            futs = [eng.submit(_X2), eng.submit(_X2)]  # coalesce into one
+            errs = 0
+            for f in futs:
+                with pytest.raises(RuntimeError, match="chaos step"):
+                    f.result(timeout=30)
+                errs += 1
+            assert errs == 2
+            assert monitor.stat_get("serving_request_errors_total") == 2
+            # worker alive and serving
+            out = eng.predict(_X2)
+            assert out[0].shape == (2, 4)
+            assert eng.health()["status"] == "ok"
+        finally:
+            eng.close()
+
+    def test_close_during_in_flight_error_still_drains(self):
+        """close() racing an erroring device step: the drain completes,
+        every accepted future resolves (exceptionally or normally), and
+        close returns instead of leaving callers blocked."""
+        eng = _engine()
+        try:
+            eng.predict(_X2)
+            faults.inject("serving/device_step", latency_s=0.1,
+                          exc=RuntimeError("dying step"), times=1)
+            futs = [eng.submit(_X4)]
+            time.sleep(0.02)  # worker picks up the failing batch
+            futs.append(eng.submit(_X2))  # queued behind the failure
+        finally:
+            eng.close(timeout=30)
+        resolved = 0
+        for f in futs:
+            try:
+                f.result(timeout=5)
+                resolved += 1
+            except RuntimeError:
+                resolved += 1
+        assert resolved == 2
+
+    def test_overload_sheds_fast_and_counts(self):
+        eng = _engine(max_pending=2)
+        try:
+            eng.predict(_X2)
+            monitor.stat_reset("serving_shed_total")
+            faults.inject("serving/device_step", latency_s=0.3, exc=None,
+                          times=1)
+            futs = [eng.submit(_X4)]  # occupies the worker
+            time.sleep(0.05)
+            shed = 0
+            t0 = time.perf_counter()
+            for _ in range(8):
+                try:
+                    futs.append(eng.submit(_X2))
+                except serving.OverloadedError:
+                    shed += 1
+            assert time.perf_counter() - t0 < 0.2  # fast-fail, no queueing
+            assert shed >= 6
+            assert monitor.stat_get("serving_shed_total") == shed
+            assert eng.stats()["shed"] == shed
+            for f in futs:
+                f.result(timeout=30)
+        finally:
+            eng.close()
+
+    def test_queued_request_deadline_expires(self):
+        eng = _engine(request_deadline_ms=5000)
+        try:
+            eng.predict(_X2)
+            monitor.stat_reset("serving_deadline_expired_total")
+            faults.inject("serving/device_step", latency_s=0.25, exc=None,
+                          times=1)
+            f_slow = eng.submit(_X4)  # full bucket: runs alone
+            time.sleep(0.02)
+            f_late = eng.submit(_X2, deadline_ms=50)
+            with pytest.raises(serving.DeadlineExceeded):
+                f_late.result(timeout=30)
+            f_slow.result(timeout=30)
+            assert monitor.stat_get("serving_deadline_expired_total") == 1
+            assert eng.stats()["deadline_expired"] == 1
+        finally:
+            eng.close()
+
+    def test_healthz_endpoint_reflects_engine_state(self):
+        eng = _engine()
+        srv = obs_export.start_http_server(0)
+        try:
+            url = f"http://127.0.0.1:{srv.port}/healthz"
+            h = json.load(urllib.request.urlopen(url))
+            assert h["status"] == "ok"
+            comp = [c for c in h["components"].values()
+                    if c.get("bucket_ladder")]
+            assert comp and comp[0]["ready"] and comp[0]["pending"] == 0
+            # a closed engine unregisters; a FAILING provider degrades
+            eng.close()
+            obs_export.register_health("probe_dead",
+                                       lambda: {"status": "dead"})
+            try:
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(url)
+                assert exc.value.code == 503
+                body = json.load(exc.value)
+                assert body["status"] == "degraded"
+            finally:
+                obs_export.unregister_health("probe_dead")
+        finally:
+            try:
+                eng.close()
+            except Exception:
+                pass
+            srv.stop()
+
+    def test_concurrent_clients_with_fault_burst(self):
+        """Mixed traffic while a fault burst hits: every request either
+        succeeds or fails with the injected error — none hang, and the
+        engine serves cleanly afterwards."""
+        eng = _engine(max_batch_size=4)
+        try:
+            eng.predict(_X2)
+            faults.inject("serving/device_step",
+                          exc=RuntimeError("burst"), times=3, skip=1)
+            ok, failed = [], []
+            lock = threading.Lock()
+
+            def client(i):
+                r = np.random.RandomState(i)
+                for _ in range(6):
+                    try:
+                        eng.predict(r.rand(1 + r.randint(3), 8)
+                                    .astype(np.float32))
+                        with lock:
+                            ok.append(i)
+                    except RuntimeError:
+                        with lock:
+                            failed.append(i)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads)
+            assert len(ok) + len(failed) == 24
+            assert failed  # the burst hit someone
+            assert eng.predict(_X2)[0].shape == (2, 4)
+        finally:
+            eng.close()
+
+
+# -- lint rule (satellite) --------------------------------------------------
+
+def test_retry_without_backoff_lint_rule(tmp_path):
+    """The CI lint flags retry loops with no backoff/deadline; fan-outs
+    (loop var feeds the call) and paced loops stay clean. The default
+    --source scan covers the RPC client paths."""
+    from paddle_tpu.analysis import lint_source
+    from paddle_tpu.analysis.lint import RPC_PATHS
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def hammer(sock, msg):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            sock.sendall(msg)\n"
+        "            return sock.recv(4)\n"
+        "        except OSError:\n"
+        "            continue\n"
+        "def bounded(sock, msg):\n"
+        "    for _ in range(5):\n"
+        "        try:\n"
+        "            return sock.sendall(msg)\n"
+        "        except OSError:\n"
+        "            pass\n"
+        "def paced(sock, msg, policy):\n"
+        "    for a in range(5):\n"
+        "        try:\n"
+        "            return sock.sendall(msg)\n"
+        "        except OSError:\n"
+        "            policy.sleep(a)\n"
+        "def fanout(clients, msg):\n"
+        "    for i in range(len(clients)):\n"
+        "        try:\n"
+        "            clients[i].sendall(msg)\n"
+        "        except OSError:\n"
+        "            pass\n")
+    found = [f for f in lint_source(paths=[str(bad)])
+             if f.rule == "retry-without-backoff"]
+    assert [(f.severity, f.loc.rsplit(":", 1)[1]) for f in found] == \
+        [("error", "2"), ("warning", "9")]
+    # the shipped RPC paths are clean under the default scan
+    repo_findings = [f for f in lint_source()
+                     if f.rule == "retry-without-backoff"]
+    assert repo_findings == [], repo_findings
+    assert any(p.endswith("client.py") for p in RPC_PATHS)

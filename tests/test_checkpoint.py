@@ -1,0 +1,383 @@
+"""Elastic fault tolerance: shard-aware step checkpointing.
+
+The contract under test (ISSUE 7): checkpoint -> kill -> restore resumes
+BITWISE-equal (fp32) to an uninterrupted run for replicated, ZeRO-1,
+ZeRO-2 and ZeRO-3 under gradient-accumulation windows on the 8-device
+CPU mesh; a mid-window (accumulated-but-unconsumed grads) restore holds;
+restore at a DIFFERENT dp degree re-flattens the shards (elastic
+resume); and a kill injected at every checkpoint write stage never
+leaves a manifest restore accepts (crash-consistency sweep).
+"""
+import gc
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import checkpoint, monitor, nn
+from paddle_tpu.checkpoint import core as ckpt_core
+from paddle_tpu.distributed import parallel_env
+from paddle_tpu.testing import faults
+
+DP = 8
+K, ACC = 2, 2
+
+rng = np.random.RandomState(7)
+X1 = rng.rand(K, 16, 16).astype("float32")
+Y1 = rng.randint(0, 8, (K, 16)).astype("int64")
+X2 = rng.rand(K, 16, 16).astype("float32")
+Y2 = rng.randint(0, 8, (K, 16)).astype("int64")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.reset()
+    yield
+    faults.reset()
+    parallel_env.set_mesh(None)
+    gc.collect()  # drop sharded stores before the next test's mesh
+
+
+def _build(stage, dp=DP, seed=11, acc=ACC, scaler=False):
+    import jax
+    mesh = parallel_env.make_mesh({"dp": dp}, devices=jax.devices()[:dp])
+    parallel_env.set_mesh(mesh)
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                 learning_rate=0.05)
+    if stage:
+        opt._zero_enable(axis="dp", stage=stage)
+    sc = paddle.amp.GradScaler(init_loss_scaling=128.0) if scaler else None
+
+    def one(xb, yb):
+        loss = nn.functional.cross_entropy(m(xb), yb)
+        if sc is None:
+            loss.backward()
+            opt.step()
+        else:
+            sc.scale(loss).backward()
+            sc.step(opt)
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(one, scan_steps=K, dp_axis="dp",
+                                accumulate_steps=acc)
+    return step, m, opt, sc
+
+
+_CTRL = {}
+
+
+def _control(stage=0, scaler=False):
+    """Uninterrupted 2-call control of the SAME configuration (stages
+    2/3 under accumulation reorder the gradient sum vs the replicated
+    program — tolerance-level there by design — so "bitwise-equal to an
+    uninterrupted run" is judged against the same stage)."""
+    key = (stage, bool(scaler))
+    if key not in _CTRL:
+        s, m, _o, _sc = _build(stage, scaler=scaler)
+        s(paddle.to_tensor(X1), paddle.to_tensor(Y1))
+        l2 = s(paddle.to_tensor(X2), paddle.to_tensor(Y2)).numpy()
+        params = [np.asarray(p._value).tobytes() for p in m.parameters()]
+        _CTRL[key] = (l2.tobytes(), params)
+        del s, m, _o, _sc
+        gc.collect()
+    return _CTRL[key]
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_bitwise_resume_matrix(stage, tmp_path):
+    """Acceptance: checkpoint after call 1, rebuild FRESH objects (a
+    different init seed proves the state really comes from the
+    checkpoint), restore, run call 2 — losses and final params BITWISE
+    equal the uninterrupted control, for every ZeRO stage under an
+    accumulation window (params + moments + step count + RNG + lr all
+    round-trip through the sharded stores)."""
+    ctrl_l2, ctrl_params = _control(stage)
+    sA, mA, oA, _ = _build(stage)
+    sA(paddle.to_tensor(X1), paddle.to_tensor(Y1))
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        mA).add_optimizer(oA).save(1)
+    del sA, mA, oA
+    gc.collect()  # the "kill": nothing survives but the checkpoint
+
+    sB, mB, oB, _ = _build(stage, seed=99)
+    meta = checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        mB).add_optimizer(oB).restore()
+    assert meta is not None and meta["step"] == 1
+    l2 = sB(paddle.to_tensor(X2), paddle.to_tensor(Y2)).numpy()
+    assert l2.tobytes() == ctrl_l2
+    for p, ref in zip(mB.parameters(), ctrl_params):
+        assert np.asarray(p._value).tobytes() == ref, (stage, p.name)
+    del sB, mB, oB
+
+
+def test_bitwise_resume_with_scaler(tmp_path):
+    """GradScaler dynamic-scaling state (scale/good/bad counters) rides
+    the checkpoint: the restored run's scaled losses stay bitwise."""
+    ctrl_l2, ctrl_params = _control(1, scaler=True)
+    sA, mA, oA, scA = _build(1, scaler=True)
+    sA(paddle.to_tensor(X1), paddle.to_tensor(Y1))
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        mA).add_optimizer(oA).add_scaler(scA).save(1)
+    del sA, mA, oA, scA
+    gc.collect()
+    sB, mB, oB, scB = _build(1, seed=99, scaler=True)
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        mB).add_optimizer(oB).add_scaler(scB).restore()
+    assert float(scB._scale._value) == 128.0
+    l2 = sB(paddle.to_tensor(X2), paddle.to_tensor(Y2)).numpy()
+    assert l2.tobytes() == ctrl_l2
+    for p, ref in zip(mB.parameters(), ctrl_params):
+        assert np.asarray(p._value).tobytes() == ref, p.name
+    del sB, mB, oB, scB
+
+
+def test_mid_window_restore_eager(tmp_path):
+    """Mid-accumulation-window restore: a checkpoint taken with
+    accumulated-but-unconsumed gradients (backward ran, step deferred)
+    hands the surviving @GRAD state back, and finishing the window after
+    restore is bitwise-identical to the uninterrupted window."""
+    xa = rng.rand(16, 16).astype("float32")
+    ya = rng.randint(0, 8, 16).astype("int64")
+    xb = rng.rand(16, 16).astype("float32")
+    yb = rng.randint(0, 8, 16).astype("int64")
+
+    def build(seed=11):
+        paddle.seed(seed)
+        m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+        opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                     learning_rate=0.05)
+        return m, opt
+
+    def micro(m, x, y):
+        nn.functional.cross_entropy(
+            m(paddle.to_tensor(x)), paddle.to_tensor(y)).backward()
+
+    # control: both micro steps, one update — no interruption
+    m0, o0 = build()
+    micro(m0, xa, ya)
+    micro(m0, xb, yb)
+    o0.step()
+    o0.clear_grad()
+    ctrl = [np.asarray(p._value).tobytes() for p in m0.parameters()]
+
+    # interrupted: checkpoint mid-window (after micro 1, before micro 2)
+    mA, oA = build()
+    micro(mA, xa, ya)
+    assert any(p._grad is not None for p in mA.parameters())
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        mA).add_optimizer(oA).save(7)
+    del mA, oA
+    gc.collect()
+    mB, oB = build(seed=99)
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        mB).add_optimizer(oB).restore()
+    assert all(p._grad is not None for p in mB.parameters())
+    micro(mB, xb, yb)
+    oB.step()
+    oB.clear_grad()
+    for p, ref in zip(mB.parameters(), ctrl):
+        assert np.asarray(p._value).tobytes() == ref, p.name
+
+
+def test_zero_gacc_window_store_roundtrip(tmp_path):
+    """The sharded ZeRO-2/3 window accumulator (``gacc``) is part of the
+    accumulation-window phase and round-trips through per-rank shards
+    bit-for-bit."""
+    _s, m, opt, _ = _build(3)
+    seeded = []
+    for zb, sd in zip(opt._zero["buckets"], opt._zero["stores"]):
+        st = sd["gacc"].tensor
+        val = np.arange(np.prod(st._value.shape),
+                        dtype=np.float32).reshape(st._value.shape)
+        val[zb.rows - zb.pad_rows:] = 0.0  # padding rows carry no state
+        st.set_value(val)
+        seeded.append(val.tobytes())
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        m).add_optimizer(opt).save(1)
+    for sd in opt._zero["stores"]:  # clobber, then restore
+        sd["gacc"].tensor.set_value(
+            np.zeros(sd["gacc"].tensor._value.shape, np.float32))
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        m).add_optimizer(opt).restore()
+    for sd, ref in zip(opt._zero["stores"], seeded):
+        assert np.asarray(sd["gacc"].tensor._value).tobytes() == ref
+    del _s, m, opt
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_elastic_resume_different_dp_degree(stage, tmp_path):
+    """Elastic resume: a dp=8 checkpoint restores into a dp=4 optimizer
+    by re-flattening the shards — every materialized param AND moment is
+    bitwise-identical to the dp=8 state, the stores live 1/4 per rank,
+    and continued training matches the dp=8 continuation to fp32
+    tolerance (the microbatch regrouping reorders the gradient mean)."""
+    s8, m8, o8, _ = _build(stage, dp=8, acc=None)
+    s8(paddle.to_tensor(X1), paddle.to_tensor(Y1))
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        m8).add_optimizer(o8).save(1)
+    p8 = [np.asarray(p._value).copy() for p in m8.parameters()]
+    mom8 = [np.asarray(o8._accumulators[("moment1", id(p))]._value).copy()
+            for p in m8.parameters()]
+    l2_8 = s8(paddle.to_tensor(X2), paddle.to_tensor(Y2)).numpy()
+    del s8, m8, o8
+    gc.collect()
+
+    s4, m4, o4, _ = _build(stage, dp=4, seed=99, acc=None)
+    meta = checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        m4).add_optimizer(o4).restore()
+    assert meta["zero"]["opt"]["degree"] == 8 and o4._zero["degree"] == 4
+    for p, ref in zip(m4.parameters(), p8):
+        assert np.asarray(p._value).tobytes() == ref.tobytes(), p.name
+    for p, ref in zip(m4.parameters(), mom8):
+        got = np.asarray(o4._accumulators[("moment1", id(p))]._value)
+        assert got.tobytes() == ref.tobytes(), ("moment", p.name)
+    for sd in o4._zero["stores"]:
+        for slot in sd:
+            arr = sd[slot].tensor._value
+            assert len(arr.sharding.device_set) == 4
+            assert arr.addressable_shards[0].data.shape[0] == \
+                arr.shape[0] // 4
+    l2_4 = s4(paddle.to_tensor(X2), paddle.to_tensor(Y2)).numpy()
+    np.testing.assert_allclose(l2_4, l2_8, rtol=1e-6)
+    del s4, m4, o4
+
+
+def test_zero3_restore_without_optimizer_rejected(tmp_path):
+    """A ZeRO-3 checkpoint's params live in the optimizer's sharded
+    stores; restoring with only the model registered would silently keep
+    fresh-init weights — strict restore cross-checks coverage and raises."""
+    _s, m, opt, _ = _build(3, acc=None)
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        m).add_optimizer(opt).save(1)
+    del _s, opt
+    gc.collect()
+    with pytest.raises(checkpoint.StateMismatchError,
+                       match="ZeRO-3 store view"):
+        checkpoint.CheckpointManager(str(tmp_path)).add_model(m).restore()
+    del m
+
+
+def test_elastic_resume_rejects_config_mismatch(tmp_path):
+    """Same degree-elasticity must NOT paper over a real config change:
+    a different ZeRO stage or a missing _zero_enable fails loudly."""
+    _s, m, opt, _ = _build(1, acc=None)
+    checkpoint.CheckpointManager(str(tmp_path)).add_model(
+        m).add_optimizer(opt).save(1)
+    del _s, m, opt
+    gc.collect()
+    _s2, m2, o2, _ = _build(3, seed=99, acc=None)
+    with pytest.raises(checkpoint.StateMismatchError, match="stage"):
+        checkpoint.CheckpointManager(str(tmp_path)).add_model(
+            m2).add_optimizer(o2).restore()
+    del _s2, m2, o2
+    gc.collect()
+    paddle.seed(1)
+    m3 = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    o3 = paddle.optimizer.AdamW(parameters=m3.parameters())
+    with pytest.raises(checkpoint.StateMismatchError, match="ZeRO"):
+        checkpoint.CheckpointManager(str(tmp_path)).add_model(
+            m3).add_optimizer(o3).restore()
+
+
+# -- crash consistency ------------------------------------------------------
+
+@pytest.mark.chaos
+def test_kill_point_sweep_never_accepts_torn_checkpoint(tmp_path):
+    """Acceptance: a kill injected at EVERY checkpoint write stage
+    leaves restore either on the previous checkpoint (stages before the
+    atomic publish) or on the complete new one (stages after) — never on
+    a torn one."""
+    published_after = {"checkpoint/after_publish", "checkpoint/before_gc"}
+    for kp in ckpt_core.KILL_POINTS:
+        root = str(tmp_path / kp.replace("/", "_"))
+        ckpt_core.write_checkpoint(root, 1, {"a.pkl": b"A" * 64},
+                                   meta={"v": 1})
+        faults.inject(kp)
+        with pytest.raises(faults.FaultInjected):
+            ckpt_core.write_checkpoint(root, 2, {"a.pkl": b"B" * 64},
+                                       meta={"v": 2})
+        faults.clear()
+        step, payloads, meta = ckpt_core.read_checkpoint(root)
+        if kp in published_after:
+            assert step == 2 and payloads["a.pkl"] == b"B" * 64, kp
+        else:
+            assert step == 1 and payloads["a.pkl"] == b"A" * 64, kp
+        # and the writer recovers: the next save publishes cleanly
+        ckpt_core.write_checkpoint(root, 3, {"a.pkl": b"C" * 64})
+        assert ckpt_core.read_checkpoint(root)[0] == 3, kp
+
+
+@pytest.mark.chaos
+def test_corrupt_payload_falls_back_and_counts(tmp_path):
+    """A bit-flipped payload fails the manifest's content hash: auto
+    restore skips to the previous valid checkpoint (counted), explicit
+    restore of the corrupt step raises."""
+    root = str(tmp_path)
+    ckpt_core.write_checkpoint(root, 1, {"a.pkl": b"AAAA"})
+    ckpt_core.write_checkpoint(root, 2, {"a.pkl": b"BBBB"})
+    with open(os.path.join(root, ckpt_core.step_dirname(2), "a.pkl"),
+              "r+b") as f:
+        f.write(b"Z")
+    monitor.stat_reset("checkpoint_corrupt_skipped_total")
+    step, payloads, _meta = ckpt_core.read_checkpoint(root)
+    assert step == 1 and payloads["a.pkl"] == b"AAAA"
+    assert monitor.stat_get("checkpoint_corrupt_skipped_total") == 1
+    with pytest.raises(checkpoint.CheckpointCorruptError):
+        ckpt_core.read_checkpoint(root, step=2)
+
+
+def test_gc_keeps_last_n_and_sweeps_staging(tmp_path):
+    root = str(tmp_path)
+    for i in range(5):
+        ckpt_core.write_checkpoint(root, i, {"a.pkl": bytes([i])},
+                                   keep_last_n=2)
+    assert ckpt_core.valid_steps(root) == [3, 4]
+    # our own abandoned staging dir (crashed earlier attempt) is swept;
+    # a LIVE concurrent writer's staging dir survives (its publish
+    # rename must not be yanked out from under it)
+    mine = os.path.join(root, f".staging.step_0000000009.{os.getpid()}")
+    os.makedirs(mine)
+    import subprocess
+    import sys
+    peer = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(30)"])
+    try:
+        theirs = os.path.join(root, f".staging.step_0000000008.{peer.pid}")
+        os.makedirs(theirs)
+        ckpt_core.gc_checkpoints(root, 2)
+        assert not os.path.exists(mine)
+        assert os.path.exists(theirs)
+    finally:
+        peer.kill()
+        peer.wait()
+    ckpt_core.gc_checkpoints(root, 2)  # writer died: now it sweeps
+    assert not os.path.exists(theirs)
+
+
+def test_manager_restore_missing_returns_none(tmp_path):
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    mgr = checkpoint.CheckpointManager(str(tmp_path)).add_model(m)
+    assert mgr.restore() is None
+    assert mgr.latest_step() is None
+
+
+def test_checkpoint_counters_and_manifest_meta(tmp_path):
+    monitor.stat_reset("checkpoint_saves_total")
+    monitor.stat_reset("checkpoint_restores_total")
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    opt = paddle.optimizer.Adam(parameters=m.parameters())
+    mgr = checkpoint.CheckpointManager(str(tmp_path), keep_last_n=3)
+    mgr.add_model(m).add_optimizer(opt)
+    mgr.save(5, extra_meta={"epoch": 2})
+    meta = mgr.restore()
+    assert meta["step"] == 5 and meta["epoch"] == 2
+    assert "model_model.pkl" in meta["components"]
+    assert monitor.stat_get("checkpoint_saves_total") == 1
+    assert monitor.stat_get("checkpoint_restores_total") == 1
+    assert monitor.stat_get("checkpoint_bytes_written_total") > 0

@@ -38,6 +38,9 @@ MODULES = [
     "paddle_tpu.sparsity",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.checkpoint",
+    "paddle_tpu.testing",
+    "paddle_tpu.testing.faults",
     "paddle_tpu.onnx",
     "paddle_tpu.incubate",
     "paddle_tpu.text",
