@@ -499,6 +499,46 @@ def bench_checkpoint():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_tracing_overhead():
+    """Span-tracing overhead: spans/s through the full emission path
+    (context ids + profiler buffer + flight ring) with tracing ON, vs
+    the guarded no-op path with tracing OFF. The row that keeps the
+    observability tax visible — a regression here is every instrumented
+    hot path getting slower at once."""
+    import paddle_tpu.observability as obs
+    from paddle_tpu import profiler
+
+    N = 20000
+
+    def spin():
+        t0 = time.perf_counter()
+        for _ in range(N):
+            with obs.trace_span("bench/span", cat="user"):
+                pass
+        return time.perf_counter() - t0
+
+    import jax
+    obs.disable()
+    spin()  # warm
+    off_s = spin()
+    profiler.reset()
+    obs.enable(categories=["user"])
+    try:
+        spin()  # warm (allocators, ring)
+        profiler.reset()
+        on_s = spin()
+    finally:
+        obs.disable()
+        profiler.reset()
+    return {"metric": "tracing_overhead_spans_per_s",
+            "value": round(N / on_s, 1), "unit": "spans/s",
+            "backend": jax.default_backend(),
+            "span_ns_enabled": round(on_s / N * 1e9, 1),
+            "span_ns_disabled": round(off_s / N * 1e9, 1),
+            "note": "enabled = ids + profiler buffer + flight ring; "
+            "disabled = shared null span (guard-only)"}
+
+
 def bench_bert():
     """Config 3: the flagship BERT pretraining step — bench.py run as a
     subprocess (it owns program structure, OOM fallback and timing) with
@@ -513,7 +553,8 @@ def bench_bert():
 BENCHES = {"resnet": bench_resnet50, "gpt": bench_gpt_sharding_pp,
            "allreduce": bench_allreduce, "detection": bench_detection,
            "hbm_cache": bench_hbm_cache, "serving": bench_serving,
-           "checkpoint": bench_checkpoint, "bert": bench_bert}
+           "checkpoint": bench_checkpoint,
+           "tracing_overhead": bench_tracing_overhead, "bert": bench_bert}
 
 
 def run_benches(configs):
@@ -542,7 +583,7 @@ DEFAULT_BASELINE = os.path.join(
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="resnet,gpt,allreduce,detection,"
-                    "hbm_cache,serving,checkpoint,bert")
+                    "hbm_cache,serving,checkpoint,tracing_overhead,bert")
     ap.add_argument("--out", help="write the run's records as a JSON file")
     ap.add_argument("--results", help="gate a previously recorded results "
                     "JSON instead of running the ladder")

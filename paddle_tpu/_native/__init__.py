@@ -116,6 +116,7 @@ def _bind(lib):
         "pt_ps_running": (I, []),
         "pt_ps_dup_requests": (LL, []),
         "pt_ps_stats_json": (I, [c.c_char_p, I]),
+        "pt_ps_trace_json": (I, [c.c_char_p, I, I]),
     }
     for name, (res, args) in sigs.items():
         fn = getattr(lib, name)

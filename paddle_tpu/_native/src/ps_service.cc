@@ -20,6 +20,15 @@
 //   request : u32 body_len | u32 magic("PTS1") | u8 op | u32 table | u64 n
 //             | payload                         (body_len counts from magic)
 //   response: u32 body_len | payload
+// Trace context (Dapper-style propagation): an op byte with the high bit
+// set (op | 0x80) prefixes its payload with `u64 trace_id | u64 span_id`
+// — the caller's trace context. The flag is stripped before dispatch, so
+// a traced call behaves (and is attributed in op_stats) exactly like its
+// legacy twin; additionally the server records a service-side span
+// (trace_id, parent = caller's span_id, own minted span_id, table, op,
+// start/end ns on the shared CLOCK_MONOTONIC base) into a bounded ring
+// exported by pt_ps_trace_json — the host-side half of a cross-process
+// trace a client's run-log joins on the ids.
 // The magic word doubles as a protocol version; it is read and checked
 // BEFORE the body is allocated, so a stray peer (port collision, HTTP
 // probe, garbage) cannot drive an attacker-controlled resize — the
@@ -91,6 +100,8 @@ enum OptKind : int32_t { kOptSum = 0, kOptSgd = 1, kOptAdam = 2 };
 
 constexpr uint32_t kMagic = 0x31535450u;  // "PTS1"
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB frame cap (sanity bound)
+constexpr uint8_t kTraceFlag = 0x80;      // op | 0x80 = traced request
+constexpr size_t kTraceRingCap = 8192;    // bounded server-side span ring
 
 struct OptConf {
   int32_t kind = kOptSgd;
@@ -388,6 +399,22 @@ struct Barrier {
   int64_t generation = 0;
 };
 
+// monotonic clock shared with the host profiler (pt_runtime.cc): server
+// spans land on the same time base as client spans, so a same-host
+// trace merge needs no alignment
+extern "C" long long pt_prof_now_ns();
+
+// one service-side span: the caller's (trace, span) context + the
+// server's own minted span id, the handled (table, op), and the
+// frame-parsed -> response-sent window
+struct TraceSpan {
+  uint64_t trace = 0, parent = 0, span = 0;
+  uint32_t table = 0;
+  uint8_t op = 0;
+  uint8_t dup = 0;  // request-id dedup answered without applying
+  int64_t t0 = 0, t1 = 0;
+};
+
 struct PsServer {
   std::unordered_map<uint32_t, SparseTable> sparse;
   std::unordered_map<uint32_t, DenseTable> dense;
@@ -421,7 +448,30 @@ struct PsServer {
   std::mutex seen_mu;
   std::condition_variable seen_cv;
   uint64_t dup_requests = 0;  // observability: how often dedup saved us
+  // bounded ring of service-side spans for traced requests (oldest
+  // dropped), drained by pt_ps_trace_json
+  std::deque<TraceSpan> trace_ring;
+  std::mutex trace_mu;
+  std::atomic<uint64_t> span_seq{0};
 };
+
+void record_trace_span(PsServer* ps, uint64_t trace, uint64_t parent,
+                       uint32_t table, uint8_t op, bool dup, int64_t t0) {
+  TraceSpan s;
+  s.trace = trace;
+  s.parent = parent;
+  s.t0 = t0;
+  s.t1 = pt_prof_now_ns();
+  // minted server span id: unique across handlers/restarts within a run
+  s.span = mix64(trace ^ mix64(ps->span_seq.fetch_add(1) + 1) ^
+                 (uint64_t)s.t1);
+  s.table = table;
+  s.op = op;
+  s.dup = dup ? 1 : 0;
+  std::lock_guard<std::mutex> lk(ps->trace_mu);
+  if (ps->trace_ring.size() >= kTraceRingCap) ps->trace_ring.pop_front();
+  ps->trace_ring.push_back(s);
+}
 
 constexpr size_t kSeenReqWindow = 1u << 16;
 
@@ -741,6 +791,22 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
     const char* payload = body.data() + 13;
     size_t psize = blen - 17;
 
+    // Traced request: strip the flag + 16-byte trace-context prefix
+    // BEFORE any other payload interpretation, so every op family
+    // (pushes with request ids included) composes with tracing. A
+    // flagged frame too short for the prefix is malformed: drop.
+    bool has_trace = false;
+    uint64_t trace_id = 0, parent_span = 0;
+    if (op & kTraceFlag) {
+      if (psize < 16) break;
+      memcpy(&trace_id, payload, 8);
+      memcpy(&parent_span, payload + 8, 8);
+      payload += 16;
+      psize -= 16;
+      op = (uint8_t)(op & ~kTraceFlag);
+      has_trace = true;
+    }
+
     // Request-id'd pushes: consume the id prefix and fold onto the
     // legacy opcode so validation/handling below is shared; the dedup
     // decision is taken after validation (a malformed duplicate frame
@@ -776,6 +842,7 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
     }
 
     auto op_t0 = std::chrono::steady_clock::now();
+    int64_t trace_t0 = has_trace ? pt_prof_now_ns() : 0;
     if (has_req_id) {
       int st_req = check_request(ps, req_id);
       if (st_req != kReqNew) {
@@ -784,6 +851,9 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
         // or a wait timeout reports failure instead
         uint32_t ok = st_req == kReqDupDone ? 1 : 0;
         send_resp(fd, &ok, 4);
+        if (has_trace)  // the dedup-acked retry is part of the trace too
+          record_trace_span(ps, trace_id, parent_span, table, op, true,
+                            trace_t0);
         std::lock_guard<std::mutex> slk(ps->stats_mu);
         auto& st = ps->op_stats[((uint64_t)table << 8) | op];
         st.calls += 1;
@@ -1109,6 +1179,9 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
     uint64_t op_ns = (uint64_t)std::chrono::duration_cast<
         std::chrono::nanoseconds>(std::chrono::steady_clock::now() - op_t0)
         .count();
+    if (has_trace)
+      record_trace_span(ps, trace_id, parent_span, table, op, false,
+                        trace_t0);
     {
       std::lock_guard<std::mutex> slk(ps->stats_mu);
       auto& st = ps->op_stats[((uint64_t)table << 8) | op];
@@ -1297,6 +1370,39 @@ PT_API int64_t pt_ps_dup_requests() {
 PT_API int32_t pt_ps_running() {
   std::lock_guard<std::mutex> lk(g_ps_mu);
   return g_ps && g_ps->running.load() ? 1 : 0;
+}
+
+// Serialize (and, with drain != 0, clear) the service-side trace-span
+// ring as a JSON array — u64 ids printed as decimal (Python ints parse
+// them losslessly). Same size-probe protocol as pt_ps_stats_json:
+// returns bytes written, or the negated required size when `cap` is too
+// small (nothing written, nothing drained — a failed probe must not
+// lose spans).
+PT_API int32_t pt_ps_trace_json(char* out, int32_t cap, int32_t drain) {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  std::string s = "[";
+  if (g_ps) {
+    std::lock_guard<std::mutex> tlk(g_ps->trace_mu);
+    bool first = true;
+    for (auto& sp : g_ps->trace_ring) {
+      char buf[256];
+      snprintf(buf, sizeof(buf),
+               "%s{\"trace\":%llu,\"parent\":%llu,\"span\":%llu,"
+               "\"table\":%u,\"op\":%u,\"dup\":%u,\"t0\":%lld,"
+               "\"t1\":%lld}",
+               first ? "" : ",", (unsigned long long)sp.trace,
+               (unsigned long long)sp.parent, (unsigned long long)sp.span,
+               sp.table, (unsigned)sp.op, (unsigned)sp.dup,
+               (long long)sp.t0, (long long)sp.t1);
+      s += buf;
+      first = false;
+    }
+    if ((int32_t)s.size() + 2 <= cap && drain) g_ps->trace_ring.clear();
+  }
+  s += "]";
+  if ((int32_t)s.size() + 1 > cap) return -(int32_t)(s.size() + 1);
+  memcpy(out, s.c_str(), s.size() + 1);
+  return (int32_t)s.size();
 }
 
 // Serialize the per-(table, op) latency stats as a JSON array. Returns

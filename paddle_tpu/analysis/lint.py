@@ -27,13 +27,20 @@ Two surfaces:
     latency metrics; route retries through
     ``distributed.ps.retry.RetryPolicy`` instead. Scanned by default over
     the RPC client paths (``RPC_PATHS``).
+  * ``span-without-context-manager``: a ``trace_span(...)`` call whose
+    result never enters a ``with`` — the span is pushed on the
+    thread-local stack only by ``__enter__``, so a span that is created
+    and dropped (or assigned and never entered) silently leaks: it never
+    records, and any context the caller expected to propagate is absent.
+    Scanned by default over the instrumented modules (``SPAN_PATHS``).
 """
 import ast
 import os
 
 from .findings import ERROR, WARNING, Finding
 
-__all__ = ["lint_program", "lint_source", "HOT_PATHS", "RPC_PATHS"]
+__all__ = ["lint_program", "lint_source", "HOT_PATHS", "RPC_PATHS",
+           "SPAN_PATHS"]
 
 # host-callback op names: each is a device->host round-trip inside the
 # compiled program (stalls the TPU pipeline every step)
@@ -63,6 +70,22 @@ RPC_PATHS = (
     os.path.join("paddle_tpu", "distributed", "ps", "communicator.py"),
     os.path.join("paddle_tpu", "distributed", "ps", "graph.py"),
     os.path.join("paddle_tpu", "distributed", "fleet", "elastic.py"),
+)
+
+# files holding span-instrumented runtime code: scanned by default for
+# the span-without-context-manager rule (observability/tracing.py itself
+# is exempt — it DEFINES the factory and the re-exports)
+SPAN_PATHS = (
+    os.path.join("paddle_tpu", "serving", "engine.py"),
+    os.path.join("paddle_tpu", "serving", "batching.py"),
+    os.path.join("paddle_tpu", "checkpoint", "core.py"),
+    os.path.join("paddle_tpu", "distributed", "ps", "client.py"),
+    os.path.join("paddle_tpu", "distributed", "ps", "server.py"),
+    os.path.join("paddle_tpu", "distributed", "collective.py"),
+    os.path.join("paddle_tpu", "jit", "to_static.py"),
+    os.path.join("paddle_tpu", "static", "program.py"),
+    os.path.join("paddle_tpu", "io", "dataloader.py"),
+    os.path.join("paddle_tpu", "hapi", "model.py"),
 )
 
 # call names that mark a statement as an RPC/socket round-trip
@@ -290,6 +313,84 @@ class _RetryLoopChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _SpanLeakChecker(ast.NodeVisitor):
+    """Flags ``trace_span(...)`` results that never enter a ``with``.
+
+    Accepted shapes: a with-item context expression (directly or via a
+    chained ``.set_attr(...)``), an assignment to a name later used as a
+    with-item in the same function, or a ``return`` (a factory handing
+    the span to its caller). A bare expression statement is an ERROR
+    (the span is constructed and immediately dropped); an assignment
+    never entered is a WARNING (it may escape through attributes — but
+    that pattern defeats the stack discipline and deserves a look).
+    """
+
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+
+    @staticmethod
+    def _is_span_call(node):
+        if not isinstance(node, ast.Call):
+            return False
+        chain = _attr_chain(node.func) or ""
+        return chain.split(".")[-1] == "trace_span"
+
+    def _span_calls_in(self, node):
+        return [n for n in ast.walk(node) if self._is_span_call(n)]
+
+    def _visit_fn(self, node):
+        ok_calls = set()      # trace_span Call nodes that enter a with
+        with_names = set()    # names used as with-item context exprs
+        assigned = {}         # name -> (call node, lineno)
+        returned = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    for c in self._span_calls_in(item.context_expr):
+                        ok_calls.add(id(c))
+                    for nm in ast.walk(item.context_expr):
+                        if isinstance(nm, ast.Name):
+                            with_names.add(nm.id)
+            elif isinstance(sub, ast.Return) and sub.value is not None:
+                for c in self._span_calls_in(sub.value):
+                    returned.add(id(c))
+            elif isinstance(sub, ast.Assign) and \
+                    self._is_span_call(sub.value):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        assigned[tgt.id] = (sub.value, sub.lineno)
+        for sub in ast.walk(node):
+            if not self._is_span_call(sub) or id(sub) in ok_calls \
+                    or id(sub) in returned:
+                continue
+            # chained trace_span(...).set_attr(...) inside a with-item is
+            # already collected by _span_calls_in walking the whole expr
+            parentless = True
+            for name, (call, lineno) in assigned.items():
+                if call is sub:
+                    parentless = False
+                    if name not in with_names:
+                        self.findings.append(Finding(
+                            "span-without-context-manager", WARNING,
+                            f"span assigned to {name!r} is never entered "
+                            "with a `with` in this function — it records "
+                            "nothing and leaks the trace context it was "
+                            "meant to carry",
+                            loc=f"{self.path}:{lineno}"))
+                    break
+            if parentless:
+                self.findings.append(Finding(
+                    "span-without-context-manager", ERROR,
+                    "trace_span(...) result discarded without entering a "
+                    "`with` — the span never records and is a pure leak; "
+                    "write `with trace_span(...):` (or bind it to a "
+                    "with-item)",
+                    loc=f"{self.path}:{sub.lineno}"))
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _visit_fn
+
+
 def lint_source(paths=None, repo_root=None):
     """AST-lint python sources. Default: the registered hot-path files
     plus the RPC client paths; or every file in ``paths``. Returns
@@ -304,6 +405,7 @@ def lint_source(paths=None, repo_root=None):
     else:
         targets.extend(os.path.join(repo_root, p) for p in HOT_PATHS)
         targets.extend(os.path.join(repo_root, p) for p in RPC_PATHS)
+        targets.extend(os.path.join(repo_root, p) for p in SPAN_PATHS)
     seen = set()
     for path in targets:
         path = os.path.abspath(path)
@@ -320,6 +422,8 @@ def lint_source(paths=None, repo_root=None):
             continue
         _TracedFnChecker(rel, findings).visit(tree)
         _RetryLoopChecker(rel, findings).visit(tree)
+        if os.path.basename(rel) != "tracing.py":  # the factory itself
+            _SpanLeakChecker(rel, findings).visit(tree)
         hot_fns = HOT_PATHS.get(rel)
         if hot_fns:
             _HotPathChecker(rel, hot_fns, findings).visit(tree)

@@ -42,6 +42,7 @@ import time
 
 from .. import monitor as _monitor
 from ..distributed.fleet.utils.fs import LocalFS
+from ..observability import runlog as _runlog
 from ..observability import tracing as _obs
 from ..testing import faults as _faults
 
@@ -124,50 +125,62 @@ def write_checkpoint(root, step, payloads, meta=None, fs=None,
         fs.mkdirs(staging)
         n_bytes = 0
         files = {}
-        for name, data in sorted(payloads.items()):
-            if not isinstance(data, (bytes, bytearray, memoryview)):
-                raise TypeError(f"payload {name!r} must be bytes, got "
-                                f"{type(data).__name__}")
-            data = bytes(data)
-            path = os.path.join(staging, name)
-            with open(path, "wb") as f:
-                half = len(data) // 2
-                f.write(data[:half])
-                f.flush()
-                # the torn-payload crash: file exists, content incomplete
-                _faults.kill_point("checkpoint/data_partial")
-                f.write(data[half:])
-                f.flush()
-                os.fsync(f.fileno())
-            files[name] = {"sha256": _sha256(data), "bytes": len(data)}
-            n_bytes += len(data)
-        _faults.kill_point("checkpoint/data_written")
+        # per-stage child spans inside the save span: a slow or crashed
+        # save decomposes into data-write vs manifest vs publish in the
+        # trace (and in a flight-recorder dump, the last stage span names
+        # how far the writer got)
+        with _obs.trace_span("checkpoint/write_data", cat="checkpoint",
+                             files=len(payloads)):
+            for name, data in sorted(payloads.items()):
+                if not isinstance(data, (bytes, bytearray, memoryview)):
+                    raise TypeError(f"payload {name!r} must be bytes, got "
+                                    f"{type(data).__name__}")
+                data = bytes(data)
+                path = os.path.join(staging, name)
+                with open(path, "wb") as f:
+                    half = len(data) // 2
+                    f.write(data[:half])
+                    f.flush()
+                    # the torn-payload crash: file exists, incomplete
+                    _faults.kill_point("checkpoint/data_partial")
+                    f.write(data[half:])
+                    f.flush()
+                    os.fsync(f.fileno())
+                files[name] = {"sha256": _sha256(data), "bytes": len(data)}
+                n_bytes += len(data)
+            _faults.kill_point("checkpoint/data_written")
 
         manifest = {"format": 1, "step": int(step), "time": time.time(),
                     "meta": meta or {}, "files": files}
         text = json.dumps(manifest, indent=1, sort_keys=True)
-        mtmp = os.path.join(staging, MANIFEST_NAME + ".tmp")
-        with open(mtmp, "w") as f:
-            f.write(text[:len(text) // 2])
-            f.flush()
-            # the torn-manifest crash: only the .tmp name ever holds a
-            # partial manifest, so restore can never parse half a file
-            _faults.kill_point("checkpoint/manifest_partial")
-            f.write(text[len(text) // 2:])
-            f.flush()
-            os.fsync(f.fileno())
-        fs.rename(mtmp, os.path.join(staging, MANIFEST_NAME))
-        fs.fsync(staging)
-        _faults.kill_point("checkpoint/manifest_written")
+        with _obs.trace_span("checkpoint/write_manifest",
+                             cat="checkpoint"):
+            mtmp = os.path.join(staging, MANIFEST_NAME + ".tmp")
+            with open(mtmp, "w") as f:
+                f.write(text[:len(text) // 2])
+                f.flush()
+                # the torn-manifest crash: only the .tmp name ever holds
+                # a partial manifest, so restore can never parse half
+                _faults.kill_point("checkpoint/manifest_partial")
+                f.write(text[len(text) // 2:])
+                f.flush()
+                os.fsync(f.fileno())
+            fs.rename(mtmp, os.path.join(staging, MANIFEST_NAME))
+            fs.fsync(staging)
+            _faults.kill_point("checkpoint/manifest_written")
 
-        _faults.kill_point("checkpoint/before_publish")
-        final = os.path.join(root, step_dirname(step))
-        fs.delete(final)  # replace a same-step checkpoint atomically-ish
-        fs.rename(staging, final)  # THE publish instant
-        fs.fsync(root)
-        _faults.kill_point("checkpoint/after_publish")
+        with _obs.trace_span("checkpoint/publish", cat="checkpoint",
+                             step=step):
+            _faults.kill_point("checkpoint/before_publish")
+            final = os.path.join(root, step_dirname(step))
+            fs.delete(final)  # replace a same-step checkpoint atomically
+            fs.rename(staging, final)  # THE publish instant
+            fs.fsync(root)
+            _faults.kill_point("checkpoint/after_publish")
 
         _write_latest(root, step, fs)
+        _runlog.event("checkpoint_publish", step=int(step),
+                      bytes=n_bytes, files=len(files), path=final)
         _faults.kill_point("checkpoint/before_gc")
         if keep_last_n is not None:
             gc_checkpoints(root, keep_last_n, fs=fs)
@@ -280,6 +293,8 @@ def read_checkpoint(root, step=None, fs=None):
                 return None
     _monitor.stat_add("checkpoint_restores_total", 1)
     _monitor.stat_add("checkpoint_restore_ns", _obs.now_ns() - t0)
+    _runlog.event("checkpoint_restore", step=chosen[0],
+                  bytes=sum(len(v) for v in chosen[1].values()))
     return chosen[0], chosen[1], chosen[2].get("meta", {})
 
 

@@ -14,11 +14,13 @@ import time
 
 import numpy as np
 
+from ...observability import runlog as _runlog
 from ...observability import tracing as _obs
 from ...testing import faults as _faults
 from .retry import RetryPolicy
 
 MAGIC = 0x31535450  # b"PTS1": protocol magic/version (ps_service.cc kMagic)
+TRACE_FLAG = 0x80  # op | 0x80: payload prefixed with u64 trace|u64 span
 
 OP_PULL_DENSE = 1
 OP_PUSH_DENSE_GRAD = 2
@@ -172,9 +174,22 @@ class PsClient:
 
     def _call_impl(self, server, op, table, n, payload=b"",
                    idempotent=False):
-        body = struct.pack("<IBIQ", MAGIC, op, table, n) + payload
-        msg = struct.pack("<I", len(body)) + body
         op_name = _OP_NAMES.get(op, str(op))
+
+        def build_msg():
+            # trace propagation: with tracing on, each ATTEMPT's span
+            # context rides the wire (op | TRACE_FLAG + 16-byte prefix),
+            # so the server-side span parents to the exact attempt that
+            # reached it — a retried push shows every client attempt and
+            # the one (or deduped) server apply under one trace
+            ctx = (_obs.trace_context() if _obs.enabled("ps") else None)
+            if ctx is not None:
+                body = struct.pack("<IBIQ", MAGIC, op | TRACE_FLAG,
+                                   table, n) + \
+                    struct.pack("<QQ", ctx[0], ctx[1]) + payload
+            else:
+                body = struct.pack("<IBIQ", MAGIC, op, table, n) + payload
+            return struct.pack("<I", len(body)) + body
 
         # idempotent calls clamp socket I/O to the call deadline (a
         # connected-but-stalled server must not hold the caller past the
@@ -187,21 +202,32 @@ class PsClient:
                       if idempotent else 120.0)
 
         def attempt():
-            # the per-server lock is held per ATTEMPT, not across the
-            # whole retry window: backoff sleeps must not serialize other
-            # threads' calls behind a failing one (worst case would be
-            # N_threads x deadline instead of one deadline each)
-            with self._locks[server]:
-                _faults.kill_point("ps/call")  # chaos: error/latency
-                s = self._sock(server)
+            # per-attempt span: the wire context minted inside it makes
+            # the server's span a child of THIS attempt, and a failed
+            # attempt still leaves its span (with the error name) in the
+            # trace — the client half of "client attempt -> server apply"
+            with _obs.trace_span(f"ps/attempt/{op_name}", cat="ps",
+                                 server=server) as span:
+                msg = build_msg()
+                # the per-server lock is held per ATTEMPT, not across the
+                # whole retry window: backoff sleeps must not serialize
+                # other threads' calls behind a failing one (worst case
+                # would be N_threads x deadline instead of one each)
                 try:
-                    s.settimeout(io_timeout)
-                    s.sendall(msg)
-                    hdr = self._recv_exact(s, 4)
-                    (rlen,) = struct.unpack("<I", hdr)
-                    return self._recv_exact(s, rlen) if rlen else b""
-                except (ConnectionError, OSError):
-                    self._drop_sock(server)
+                    with self._locks[server]:
+                        _faults.kill_point("ps/call")  # chaos: error/latency
+                        s = self._sock(server)
+                        try:
+                            s.settimeout(io_timeout)
+                            s.sendall(msg)
+                            hdr = self._recv_exact(s, 4)
+                            (rlen,) = struct.unpack("<I", hdr)
+                            return self._recv_exact(s, rlen) if rlen else b""
+                        except (ConnectionError, OSError):
+                            self._drop_sock(server)
+                            raise
+                except BaseException as e:
+                    span.set_attr(error=type(e).__name__)
                     raise
 
         if not idempotent:
@@ -219,6 +245,10 @@ class PsClient:
 
         def on_retry(k, delay, exc):
             _obs.count(f"ps_retry_{op_name}", cat="ps")
+            _runlog.event("ps_retry", op=op_name,
+                          server=self.endpoints[server], attempt=k,
+                          delay_s=round(delay, 6),
+                          error=type(exc).__name__ if exc else None)
             if _obs.enabled("ps"):
                 # the backoff gap becomes a visible span in the trace
                 now = _obs.now_ns()

@@ -42,14 +42,61 @@ def server_op_stats():
     return rows
 
 
+def server_trace_spans(drain=True):
+    """Service-side spans for traced requests (clients propagating a
+    trace context over the wire): ``[{"name", "table", "op", "trace",
+    "parent", "span", "t0", "t1", "dup"}, ...]`` with ids as ints on the
+    same monotonic ns base as client spans. ``drain=True`` empties the
+    bounded native ring (spans are reported once)."""
+    import ctypes
+    import json
+
+    from .client import _OP_NAMES
+
+    lib = _native.lib()
+    if lib is None:
+        return []
+    size = 1 << 18
+    for _ in range(4):  # ring can grow between the size probe + read
+        buf = ctypes.create_string_buffer(size)
+        n = lib.pt_ps_trace_json(buf, len(buf), 1 if drain else 0)
+        if n >= 0:
+            break
+        size = -n + 4096
+    if n <= 0:
+        return []
+    rows = json.loads(buf.value.decode())
+    for r in rows:
+        r["name"] = f"ps_server/{_OP_NAMES.get(r['op'], 'op%d' % r['op'])}"
+    return rows
+
+
+def drain_trace_to_runlog():
+    """Move the native server-span ring into the active run-log (tagged
+    ``process="ps_server"`` so the merge tool gives the service its own
+    track). Returns the number of spans moved; no-op without a run-log
+    or the native lib."""
+    from ...observability import runlog
+    if runlog.active() is None:
+        return 0
+    spans = server_trace_spans(drain=True)
+    for r in spans:
+        runlog.span(r["name"], "ps", r["t0"], r["t1"], r["trace"],
+                    r["span"], r["parent"],
+                    attrs={"table": r["table"], "dup": bool(r["dup"])},
+                    process="ps_server", tid=0)
+    return len(spans)
+
+
 def _stats_collector():
     """Scrape-time collector: per-table per-op latency counters with
     Prometheus labels (ps_server_op_{calls,ns}{table=...,op=...}) plus
     the push request-id dedup counter (retries acked without
     re-applying — the server-side twin of the client's ps_retry_total)."""
+    from ...observability.export import format_labels
     out = {}
     for r in server_op_stats():
-        key = f'{{table="{r["table"]}",op="{r["op"]}"}}'
+        key = format_labels(table=r["table"], op=r["op"])
         out[f"ps_server_op_calls{key}"] = r["calls"]
         out[f"ps_server_op_ns{key}"] = r["ns"]
     lib = _native.lib()
@@ -142,7 +189,19 @@ class PsServer:
         while lib.pt_ps_running():
             time.sleep(0.2)
 
+    def trace_spans(self, drain=True):
+        """Service-side spans recorded for traced requests (see
+        :func:`server_trace_spans`)."""
+        return server_trace_spans(drain=drain)
+
     def stop(self):
         if self._started:
+            # flush service-side spans into the run-log before the ring
+            # dies with the server (evidence must outlive the process's
+            # serving phase)
+            try:
+                drain_trace_to_runlog()
+            except Exception:
+                pass
             _native.lib().pt_ps_stop()
             self._started = False

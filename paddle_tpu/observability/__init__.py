@@ -24,18 +24,23 @@ tokens/s / MFU / data-wait gauges into it. The perf gate:
 ``python tools/perf_gate.py --baseline BASELINE.json``.
 """
 from .. import profiler as _profiler
-from . import export, gate, hlo_bytes, step, tracing  # noqa: F401
+from . import export, flight, gate, hlo_bytes, runlog, step  # noqa: F401
+from . import tracing  # noqa: F401
 from .gate import compare, load_results  # noqa: F401
 from .hlo_bytes import collective_stats, export_collective_bytes  # noqa: F401
+from .runlog import start_run, stop_run  # noqa: F401
 from .step import StepTimer  # noqa: F401
-from .tracing import (CATEGORIES, count, current_span, disable,  # noqa: F401
-                      enable, enabled, trace_span)
+from .tracing import (CATEGORIES, attach_context, count,  # noqa: F401
+                      current_span, disable, enable, enabled,
+                      mint_context, record_span, trace_context, trace_span)
 
 __all__ = [
     "enable", "disable", "enabled", "trace_span", "current_span", "count",
     "CATEGORIES", "StepTimer", "export_chrome_trace",
     "collective_stats", "export_collective_bytes",
-    "tracing", "export", "gate", "hlo_bytes", "step",
+    "trace_context", "attach_context", "mint_context", "record_span",
+    "start_run", "stop_run",
+    "tracing", "export", "gate", "hlo_bytes", "step", "runlog", "flight",
 ]
 
 

@@ -28,7 +28,8 @@ __all__ = ["publish", "gauges", "prometheus_text", "telemetry_dict",
            "write_json", "start_http_server", "register_collector",
            "unregister_collector", "summary", "summaries", "Summary",
            "register_health", "unregister_health", "health_dict",
-           "PROM_PREFIX", "SUMMARY_QUANTILES"]
+           "escape_label_value", "format_labels",
+           "PROM_PREFIX", "SUMMARY_QUANTILES", "DEFAULT_SUMMARY_WINDOW"]
 
 PROM_PREFIX = "paddle_tpu"
 
@@ -40,19 +41,60 @@ _gauges_lock = threading.Lock()
 SUMMARY_QUANTILES = (0.5, 0.95, 0.99)
 
 
+DEFAULT_SUMMARY_WINDOW = 4096  # default behind the env knob
+
+
+def _default_summary_window():
+    """Percentile ring size: ``PADDLE_TPU_SUMMARY_WINDOW`` env override,
+    else :data:`DEFAULT_SUMMARY_WINDOW`. Read per Summary construction
+    so tests (and late env tweaks before a subsystem builds its boards)
+    take effect."""
+    import os
+    try:
+        w = int(os.environ.get("PADDLE_TPU_SUMMARY_WINDOW",
+                               str(DEFAULT_SUMMARY_WINDOW)))
+    except ValueError:
+        w = DEFAULT_SUMMARY_WINDOW
+    return max(1, w)
+
+
+def escape_label_value(value):
+    """Escape a Prometheus label VALUE per the text exposition format:
+    backslash, double-quote, and newline must be escaped or the line is
+    unparseable (a table name with a quote would silently corrupt the
+    whole scrape)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_labels(**labels):
+    """Render a ``{key="value",...}`` label suffix with properly escaped
+    values — the ONE way producers attach labels to a counter/collector
+    metric name (``'ps_server_op_ns' + format_labels(table=t, op=op)``).
+    Label names are sanitized to the Prometheus name alphabet."""
+    inner = ",".join(
+        f'{_name_re.sub("_", str(k))}="{escape_label_value(v)}"'
+        for k, v in labels.items())
+    return "{" + inner + "}"
+
+
 class Summary:
     """Windowed observation stream with quantile export — the metric kind
     for request latencies, where a counter/gauge can't answer "what is
     p99". Keeps the last ``window`` observations in a ring (O(1) observe,
     no allocation after warmup); quantiles are computed at scrape time
     over a snapshot, so the observe path stays cheap enough for
-    per-request use. ``_count``/``_sum`` are lifetime monotonic."""
+    per-request use. ``_count``/``_sum`` are lifetime monotonic.
+    ``window`` defaults from the ``PADDLE_TPU_SUMMARY_WINDOW`` env var
+    (else 4096) and is exported as a ``<name>_window`` gauge so a scrape
+    knows how much history its percentiles describe."""
 
     __slots__ = ("name", "window", "_ring", "_n", "_count", "_sum", "_lock")
 
-    def __init__(self, name, window=4096):
+    def __init__(self, name, window=None):
         self.name = name
-        self.window = int(window)
+        self.window = int(window if window is not None
+                          else _default_summary_window())
         self._ring = [0.0] * self.window
         self._n = 0          # lifetime observations (ring fills to window)
         self._count = 0
@@ -105,6 +147,7 @@ class Summary:
         with self._lock:
             out["count"] = self._count
             out["sum"] = self._sum
+        out["window"] = self.window
         return out
 
 
@@ -112,9 +155,10 @@ _summaries = {}
 _summaries_lock = threading.Lock()
 
 
-def summary(name, window=4096):
+def summary(name, window=None):
     """Get-or-create the named :class:`Summary` (shared board, like the
-    monitor counter registry)."""
+    monitor counter registry). ``window`` applies only at creation;
+    default: ``PADDLE_TPU_SUMMARY_WINDOW`` env, else 4096."""
     with _summaries_lock:
         s = _summaries.get(name)
         if s is None:
@@ -246,10 +290,14 @@ def clear_gauges():
 
 def _prom_name(name):
     # labels survive sanitization: only the name part (before '{') is
-    # restricted to the Prometheus metric-name alphabet
+    # restricted to the Prometheus metric-name alphabet. Producers must
+    # escape label VALUES via format_labels(); as a last line of defense
+    # a raw newline that slipped into a label is escaped here — it is
+    # the one character that corrupts neighbouring lines, not just this
+    # sample's labels.
     if "{" in name:
         base, labels = name.split("{", 1)
-        return _name_re.sub("_", base) + "{" + labels
+        return _name_re.sub("_", base) + "{" + labels.replace("\n", "\\n")
     return _name_re.sub("_", name)
 
 
@@ -286,6 +334,10 @@ def prometheus_text(prefix=PROM_PREFIX):
                 lines.append(f'{mname}{{quantile="{q:g}"}} {v:.6g}')
         lines.append(f"{mname}_sum {s.sum:.6g}")
         lines.append(f"{mname}_count {s.count}")
+        # ring size as a gauge: a scrape can tell how much history the
+        # percentile series describes (and see config drift across ranks)
+        lines.append(f"# TYPE {mname}_window gauge")
+        lines.append(f"{mname}_window {s.window}")
     return "\n".join(lines) + "\n"
 
 

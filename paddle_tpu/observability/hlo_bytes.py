@@ -183,10 +183,17 @@ def export_collective_bytes(stats):
     """Push parsed stats into the shared monitor registry as
     ``collective_bytes{op=...,axis=...}`` / ``collective_count{...}``
     counters (labels render through the Prometheus exporter like the PS
-    per-table series). Counters accumulate across exports — export once
-    per compiled program, not per step."""
+    per-table series), and mirror them into the active run-log (one
+    ``collective_bytes`` event per export — the per-program collective
+    footprint lands next to the step stream it belongs to). Counters
+    accumulate across exports — export once per compiled program, not
+    per step."""
+    from . import runlog
+    from .export import format_labels
     for s in stats:
-        labels = 'op="%s",axis="%s"' % (s["op"], s["axis"])
-        monitor.stat_add("collective_bytes{%s}" % labels, s["bytes"])
-        monitor.stat_add("collective_count{%s}" % labels, s["count"])
+        labels = format_labels(op=s["op"], axis=s["axis"])
+        monitor.stat_add("collective_bytes" + labels, s["bytes"])
+        monitor.stat_add("collective_count" + labels, s["count"])
+    if stats and runlog.active() is not None:
+        runlog.event("collective_bytes", stats=[dict(s) for s in stats])
     return stats

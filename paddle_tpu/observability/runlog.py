@@ -1,0 +1,204 @@
+"""Structured run-log: one append-only JSONL event stream per process.
+
+The chrome-trace buffer is an in-memory, single-process artifact; a
+multi-host training job or a PS + trainer pair needs an on-disk,
+per-process stream that survives the process and merges across ranks.
+Each run-log file starts with a ``manifest`` record (run id, rank, pid,
+wall/monotonic clock anchors, git sha, user config) followed by one JSON
+object per line:
+
+- ``span``  — completed spans with their (trace, span, parent) ids,
+  mirrored from the tracing layer whenever a run-log is active;
+- ``event`` — discrete facts: step telemetry, per-execution collective
+  bytes, checkpoint publishes, PS retries, serving sheds/deadline
+  expiries, fired fault injections.
+
+``tools/trace_view.py`` merges any number of run-log files (multi-rank,
+multi-process) into one chrome-trace, aligning clocks via each
+manifest's wall/monotonic anchor pair, and reconstructs cross-process
+traces from the propagated ids.
+
+Activation: ``start_run(dir)`` explicitly, or set
+``PADDLE_TPU_RUNLOG_DIR`` and call ``observability.enable()`` — the env
+path is how multi-process launches (one env, N ranks) get per-rank logs
+without code changes. Files are named ``<run_id>.rank<r>.pid<pid>.jsonl``
+so concurrent writers never share a file (appends from one ``write()``
+per line keep each file internally consistent).
+"""
+import json
+import os
+import threading
+import time
+
+__all__ = ["RunLog", "start_run", "stop_run", "active", "event", "span",
+           "log_path"]
+
+_lock = threading.Lock()
+_active = [None]
+
+
+def _now_ns():
+    from .. import profiler
+    return profiler._now_ns()
+
+
+def _git_sha(repo_root):
+    """Best-effort HEAD sha without subprocess (no git binary needed)."""
+    try:
+        git = os.path.join(repo_root, ".git")
+        with open(os.path.join(git, "HEAD")) as f:
+            head = f.read().strip()
+        if head.startswith("ref:"):
+            ref = head.split(None, 1)[1]
+            ref_path = os.path.join(git, *ref.split("/"))
+            if os.path.exists(ref_path):
+                with open(ref_path) as f:
+                    return f.read().strip()
+            with open(os.path.join(git, "packed-refs")) as f:
+                for line in f:
+                    if line.strip().endswith(ref):
+                        return line.split()[0]
+            return None
+        return head
+    except OSError:
+        return None
+
+
+class RunLog:
+    """One process's append-only JSONL event stream.
+
+    Thread-safe: every record is serialized under a lock and written as
+    one line + flush, so a crash loses at most the line being written
+    and concurrent worker threads never interleave bytes.
+    """
+
+    def __init__(self, path, run_id=None, rank=None, meta=None,
+                 process=None):
+        self.path = path
+        self.run_id = run_id
+        self.rank = rank
+        self.process = process or "main"
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+        self.events_written = 0
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        # wall + monotonic anchors: the merge tool computes this file's
+        # monotonic->wall offset from the pair, which is what aligns
+        # logs from processes (or hosts) with different clock bases
+        self._write({
+            "kind": "manifest", "run_id": run_id, "rank": rank,
+            "pid": os.getpid(), "process": self.process,
+            "time": time.time(), "mono_ns": _now_ns(),
+            "git_sha": _git_sha(repo_root),
+            "meta": meta or {},
+        })
+
+    def _write(self, rec):
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+            self.events_written += 1
+
+    def span(self, name, cat, t0, t1, trace_id, span_id, parent_id,
+             attrs=None, process=None, tid=None):
+        rec = {"kind": "span", "name": name, "cat": cat,
+               "t0": int(t0), "dur": int(t1) - int(t0),
+               "trace": f"{trace_id:016x}", "span": f"{span_id:016x}",
+               "tid": (threading.get_ident() % (1 << 31)
+                       if tid is None else int(tid))}
+        if parent_id:
+            rec["parent"] = f"{parent_id:016x}"
+        if attrs:
+            rec["attrs"] = {k: (v if isinstance(v, (int, float, str, bool,
+                                                    list)) else str(v))
+                            for k, v in attrs.items()}
+        if process:
+            rec["process"] = process
+        self._write(rec)
+
+    def event(self, what, **fields):
+        rec = {"kind": "event", "event": what, "t": _now_ns()}
+        rec.update(fields)
+        self._write(rec)
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+                try:
+                    os.fsync(self._f.fileno())
+                except OSError:
+                    pass
+                self._f.close()
+                self._f = None
+
+
+def start_run(dir=None, path=None, run_id=None, rank=None, meta=None,
+              process=None):
+    """Open the process-wide run-log (replacing any active one). Either
+    ``dir`` (file name derived: ``<run_id>.rank<r>.pid<pid>.jsonl``) or
+    an explicit ``path``. ``rank`` defaults to ``PADDLE_TRAINER_ID``."""
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if run_id is None:
+        run_id = os.environ.get("PADDLE_TPU_RUN_ID", "run")
+    if path is None:
+        if dir is None:
+            raise ValueError("start_run needs dir= or path=")
+        os.makedirs(dir, exist_ok=True)
+        path = os.path.join(
+            dir, f"{run_id}.rank{rank}.pid{os.getpid()}.jsonl")
+    log = RunLog(path, run_id=run_id, rank=rank, meta=meta,
+                 process=process)
+    with _lock:
+        old, _active[0] = _active[0], log
+    if old is not None:
+        old.close()
+    return log
+
+
+def stop_run():
+    """Close the active run-log (no-op when none is active)."""
+    with _lock:
+        log, _active[0] = _active[0], None
+    if log is not None:
+        log.close()
+
+
+def maybe_start_from_env():
+    """Auto-start from ``PADDLE_TPU_RUNLOG_DIR`` (idempotent): the
+    multi-process activation path — the launcher exports one env var and
+    every rank logs to its own file."""
+    d = os.environ.get("PADDLE_TPU_RUNLOG_DIR")
+    if d and _active[0] is None:
+        start_run(dir=d)
+
+
+def active():
+    """The active :class:`RunLog`, or None."""
+    return _active[0]
+
+
+def log_path():
+    log = _active[0]
+    return None if log is None else log.path
+
+
+def span(*args, **kwargs):
+    """Record a span into the active run-log (tracing's emission hook);
+    no-op when inactive."""
+    log = _active[0]
+    if log is not None:
+        log.span(*args, **kwargs)
+
+
+def event(what, **fields):
+    """Record a discrete event (step stats, checkpoint publish, retry,
+    shed, fault fire) into the active run-log; no-op when inactive."""
+    log = _active[0]
+    if log is not None:
+        log.event(what, **fields)

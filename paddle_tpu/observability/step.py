@@ -99,6 +99,13 @@ class StepTimer:
         t = self.telemetry()
         if self.publish_as:
             export_mod.publish(self.publish_as, t)
+            from . import runlog
+            if runlog.active() is not None:
+                # the per-step record in the run-log stream: trace_view
+                # renders these as instants on the publishing rank's track
+                runlog.event("step", name=self.publish_as,
+                             **{k: round(v, 6) if isinstance(v, float)
+                                else v for k, v in t.items()})
         return t
 
     def telemetry(self):

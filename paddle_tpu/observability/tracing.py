@@ -9,6 +9,14 @@ This module is the unified emission API the runtime instruments against:
   it returns a shared no-op span: the hot-path cost is one list read and
   one set lookup, no allocation (the reference's analog is the
   ``RecordEvent`` guard on ``FLAGS_enable_host_event_recorder_hook``).
+- **trace context** (Dapper-style): every recorded span carries a
+  ``(trace_id, span_id, parent_id)`` triple. Nested spans inherit the
+  trace and parent from the thread-local stack; a root span mints a new
+  trace id. ``trace_context()`` reads the current (trace, span) pair for
+  wire propagation; ``attach_context(trace, parent)`` adopts a remote
+  parent on this thread (a batcher worker serving a request, a server
+  handling an RPC); ``mint_context()`` reserves ids for a span that will
+  be recorded retrospectively via ``record_span(..., span_id=...)``.
 - ``count(name, value)`` — guarded counter into the monitor registry.
 - per-category toggles: every instrumented subsystem emits under one of
   ``CATEGORIES``; ``enable(categories=[...])`` turns on a subset.
@@ -19,13 +27,23 @@ This module is the unified emission API the runtime instruments against:
   backend compile wall time) into the span stream and the
   ``jit_backend_compile_ns`` counter — the compile-cache visibility the
   CUPTI timeline gave the reference's device side.
+
+Completed spans fan out to three sinks: the profiler event buffer (the
+chrome-trace exporter), the flight-recorder ring (``flight.py`` — crash
+evidence), and, when a run-log is active, the per-run JSONL stream
+(``runlog.py`` — the multi-process merge source for
+``tools/trace_view.py``).
 """
+import random
 import threading
 
 from .. import monitor, profiler
+from . import flight, runlog
 
 __all__ = ["enable", "disable", "enabled", "trace_span", "current_span",
-           "count", "now_ns", "CATEGORIES", "DEFAULT_CATEGORIES"]
+           "count", "now_ns", "CATEGORIES", "DEFAULT_CATEGORIES",
+           "trace_context", "attach_context", "mint_context",
+           "record_span"]
 
 # every instrumented subsystem; "dispatch" is opt-in (sampled per-op spans)
 CATEGORIES = ("executor", "jit", "dataloader", "collective", "ps",
@@ -38,13 +56,73 @@ _enabled_cats = [None]  # None = disabled; frozenset of categories otherwise
 class _SpanStack(threading.local):
     def __init__(self):
         self.stack = []
+        self.remote = None  # (trace_id, parent_span_id) adopted via
+        # attach_context — the cross-process/thread parent for root spans
+        # opened on this thread
+        self.rng = None
 
 
 _tls = _SpanStack()
 
 
+def _new_id():
+    """64-bit span/trace id. Per-thread RNG (random.Random instances are
+    not thread-safe) seeded from SystemRandom so concurrent processes
+    and restarts never collide."""
+    rng = _tls.rng
+    if rng is None:
+        rng = _tls.rng = random.Random(
+            random.SystemRandom().getrandbits(64))
+    return rng.getrandbits(64) or 1  # 0 is the "no id" sentinel
+
+
 def now_ns():
     return profiler._now_ns()
+
+
+def trace_context():
+    """The current (trace_id, span_id) pair on this thread — what a
+    client piggybacks on an outgoing RPC — or None outside any span
+    (an adopted remote context counts: it returns (trace, parent))."""
+    stack = _tls.stack
+    if stack:
+        s = stack[-1]
+        return (s.trace_id, s.span_id)
+    return _tls.remote
+
+
+def mint_context():
+    """Reserve ids for a span recorded retrospectively (a serving
+    request whose duration is only known at resolve time). Returns
+    ``(trace_id, span_id, parent_id)``: a child of the current span
+    when one is active, else a new root trace."""
+    ctx = trace_context()
+    if ctx is not None:
+        return (ctx[0], _new_id(), ctx[1])
+    return (_new_id(), _new_id(), 0)
+
+
+class attach_context:
+    """Adopt a remote parent on this thread: spans opened inside become
+    children of ``(trace_id, parent_id)`` instead of starting new
+    traces — the receive side of wire propagation.
+
+    >>> with tracing.attach_context(*request_ctx[:2]):
+    ...     with trace_span("serve", cat="serving"): ...
+    """
+
+    def __init__(self, trace_id, parent_id):
+        self._ctx = (int(trace_id), int(parent_id))
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = _tls.remote
+        _tls.remote = self._ctx
+        return self
+
+    def __exit__(self, *exc):
+        _tls.remote = self._saved
+        return False
 
 
 def enabled(cat=None):
@@ -56,25 +134,81 @@ def enabled(cat=None):
     return True if cat is None else cat in cats
 
 
-class Span:
-    """Active span; records into the profiler event buffer on exit so it
-    rides the existing chrome-trace exporter. Nesting is tracked on a
-    thread-local stack (``current_span()``)."""
+def _emit(name, cat, t0, t1, trace_id, span_id, parent_id, attrs):
+    """One completed span to every sink: profiler buffer (chrome-trace
+    export), flight-recorder ring (crash evidence), active run-log
+    (multi-process merge source)."""
+    ids = {"trace_id": f"{trace_id:016x}", "span_id": f"{span_id:016x}"}
+    if parent_id:
+        ids["parent_id"] = f"{parent_id:016x}"
+    if attrs:
+        ids.update(attrs)
+    profiler.record_span(name, cat, t0, t1, ids)
+    flight.record(name, cat, t0, t1, trace_id, span_id, parent_id, attrs)
+    if runlog.active() is not None:
+        runlog.span(name, cat, t0, t1, trace_id, span_id, parent_id,
+                    attrs)
 
-    __slots__ = ("name", "cat", "attrs", "_t0")
+
+def record_span(name, cat, t0_ns, t1_ns, trace_id=None, span_id=None,
+                parent_id=None, **attrs):
+    """Record a completed span retrospectively (queue-wait measured
+    after the fact, a request span closed at resolve time). Missing ids
+    are minted from the current thread context; pass explicit ids (from
+    :func:`mint_context`) to place the span in a remote trace. Returns
+    ``(trace_id, span_id)`` — no-op (returns None) when tracing or the
+    category is off."""
+    cats = _enabled_cats[0]
+    if cats is None or cat not in cats:
+        return None
+    if trace_id is None:
+        trace_id, span_id, parent_id = mint_context()
+    elif span_id is None:
+        span_id = _new_id()
+    _emit(name, cat, int(t0_ns), int(t1_ns), int(trace_id), int(span_id),
+          int(parent_id or 0), attrs or None)
+    return (trace_id, span_id)
+
+
+class Span:
+    """Active span; records into the profiler event buffer (and the
+    flight ring + run-log) on exit. Nesting is tracked on a thread-local
+    stack (``current_span()``); the trace context (trace_id, span_id,
+    parent_id) is inherited from the enclosing span, an attached remote
+    context, or minted fresh for a root span."""
+
+    __slots__ = ("name", "cat", "attrs", "_t0",
+                 "trace_id", "span_id", "parent_id")
 
     def __init__(self, name, cat, attrs):
         self.name = name
         self.cat = cat
         self.attrs = attrs
         self._t0 = None
+        self.trace_id = 0
+        self.span_id = 0
+        self.parent_id = 0
 
     def set_attr(self, **kwargs):
         self.attrs.update(kwargs)
         return self
 
+    @property
+    def context(self):
+        """(trace_id, span_id) — piggyback this on outgoing work."""
+        return (self.trace_id, self.span_id)
+
     def __enter__(self):
-        _tls.stack.append(self)
+        stack = _tls.stack
+        if stack:
+            top = stack[-1]
+            self.trace_id, self.parent_id = top.trace_id, top.span_id
+        elif _tls.remote is not None:
+            self.trace_id, self.parent_id = _tls.remote
+        else:
+            self.trace_id, self.parent_id = _new_id(), 0
+        self.span_id = _new_id()
+        stack.append(self)
         self._t0 = profiler._now_ns()
         return self
 
@@ -83,8 +217,8 @@ class Span:
         stack = _tls.stack
         if stack and stack[-1] is self:
             stack.pop()
-        profiler.record_span(self.name, self.cat, self._t0, end,
-                             self.attrs or None)
+        _emit(self.name, self.cat, self._t0, end, self.trace_id,
+              self.span_id, self.parent_id, self.attrs or None)
         return False
 
 
@@ -204,10 +338,12 @@ class _SampledOpObserver:
         monitor.stat_add("dispatch_sampled_ops", 1)
         # per-op export (label-suffixed counters ride both exporters'
         # label-aware name path): sampled call count + sampled wall ns,
-        # keyed by the canonical dispatch op name
-        key = _op_label(name)
-        monitor.stat_add('dispatch_op_sampled{op="%s"}' % key, 1)
-        monitor.stat_add('dispatch_op_ns{op="%s"}' % key, end_ns - token)
+        # keyed by the canonical dispatch op name, label-escaped per the
+        # exposition format
+        from .export import format_labels
+        key = format_labels(op=_op_label(name))
+        monitor.stat_add("dispatch_op_sampled" + key, 1)
+        monitor.stat_add("dispatch_op_ns" + key, end_ns - token)
 
 
 def enable(categories=None, dispatch_sample_rate=0.01):
@@ -224,6 +360,8 @@ def enable(categories=None, dispatch_sample_rate=0.01):
     _enabled_cats[0] = cats
     profiler.enable_collection()
     _install_jax_hook()
+    runlog.maybe_start_from_env()   # PADDLE_TPU_RUNLOG_DIR
+    flight.maybe_install_from_env()  # PADDLE_TPU_FLIGHT_DIR
     from ..core import dispatch
     if "dispatch" in cats:
         dispatch.add_observer("observability",

@@ -53,9 +53,14 @@ class DeadlineExceeded(TimeoutError):
 class Request:
     """One enqueued inference request: per-input arrays (batch-major),
     row count, the caller's future, and an optional absolute deadline
-    (``time.perf_counter()`` seconds)."""
+    (``time.perf_counter()`` seconds). ``ctx``/``t0_ns`` are the tracing
+    layer's request-span identity — (trace_id, span_id, parent_id) ids
+    minted at submit plus the monotonic-ns enqueue time — carried so the
+    batcher worker can close the request span (and parent its queue-wait
+    span) in the submitting caller's trace, not the worker's."""
 
-    __slots__ = ("inputs", "rows", "future", "t_enqueue", "deadline")
+    __slots__ = ("inputs", "rows", "future", "t_enqueue", "deadline",
+                 "ctx", "t0_ns")
 
     def __init__(self, inputs, rows, deadline=None):
         self.inputs = inputs
@@ -63,6 +68,8 @@ class Request:
         self.future = Future()
         self.t_enqueue = time.perf_counter()
         self.deadline = deadline
+        self.ctx = None
+        self.t0_ns = 0
 
 
 class DynamicBatcher:

@@ -34,6 +34,7 @@ from .. import monitor as _monitor
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
 from ..observability import export as _export
+from ..observability import runlog as _runlog
 from ..observability import tracing as _obs
 from ..testing import faults as _faults
 from .batching import (DeadlineExceeded, DynamicBatcher, OverloadedError,
@@ -398,8 +399,8 @@ class Engine:
                     else _time.perf_counter() + float(deadline_ms) / 1e3)
         rows = arrays[0].shape[0]
         if rows <= self.max_batch_size:
-            return self._submit_one(Request(arrays, rows,
-                                            deadline=deadline))
+            return self._submit_one(self._make_request(arrays, rows,
+                                                       deadline))
         with self._lock:
             self._stats["chunked_requests"] += 1
         chunk = self.max_batch_size
@@ -408,7 +409,7 @@ class Engine:
             part = tuple(a[off:off + chunk] for a in arrays)
             try:
                 futures.append(self._submit_one(
-                    Request(part, part[0].shape[0], deadline=deadline)))
+                    self._make_request(part, part[0].shape[0], deadline)))
             except OverloadedError:
                 # all-or-nothing admission: roll back the chunks already
                 # queued (cancelled requests drop at the worker without a
@@ -420,6 +421,17 @@ class Engine:
                 raise
         return _concat_future(futures)
 
+    def _make_request(self, arrays, rows, deadline):
+        """Build a Request; with tracing on it also gets a request-span
+        identity minted in the CALLER's trace context (the submitting
+        thread may be inside a user span — the request becomes its
+        child), closed retrospectively by the batcher worker."""
+        r = Request(arrays, rows, deadline=deadline)
+        if _obs.enabled("serving"):
+            r.ctx = _obs.mint_context()
+            r.t0_ns = _obs.now_ns()
+        return r
+
     def _submit_one(self, request):
         try:
             return self._batcher.submit(request)
@@ -427,6 +439,7 @@ class Engine:
             with self._lock:
                 self._stats["shed"] += 1
             _monitor.stat_add("serving_shed_total", 1)
+            _runlog.event("serving_shed", rows=request.rows)
             raise
 
     def _on_expired(self, request):
@@ -434,6 +447,15 @@ class Engine:
         with self._lock:
             self._stats["deadline_expired"] += 1
         _monitor.stat_add("serving_deadline_expired_total", 1)
+        _runlog.event("serving_deadline_expired", rows=request.rows)
+        if request.ctx:
+            # the request span still closes — as an expiry, with no
+            # batch link (it never reached a device step)
+            _obs.record_span("serving/request", "serving", request.t0_ns,
+                             _obs.now_ns(), trace_id=request.ctx[0],
+                             span_id=request.ctx[1],
+                             parent_id=request.ctx[2], rows=request.rows,
+                             status="deadline_expired")
 
     def predict(self, *inputs, deadline_ms=None):
         """Synchronous request: submit + wait. Thread-safe — N caller
@@ -534,69 +556,113 @@ class Engine:
         now = _time.perf_counter()
         for r in batch:
             wait_ns = int((now - r.t_enqueue) * 1e9)
-            if tracing:  # retrospective queue-wait span per request
-                _obs.profiler.record_span("serving/queue_wait", "serving",
-                                          t_start - wait_ns, t_start)
+            if tracing and r.ctx:
+                # retrospective queue-wait span, INSIDE the request's own
+                # trace (child of its request span): a p99 outlier
+                # decomposes into queue vs pad vs device per request
+                _obs.record_span("serving/queue_wait", "serving",
+                                 t_start - wait_ns, t_start,
+                                 trace_id=r.ctx[0], parent_id=r.ctx[1])
             self._wait_summary.observe(wait_ns / 1e6)
 
         rows = sum(r.rows for r in batch)
         bucket = self.bucket_for(rows)
         pad = bucket - rows
-        with _obs.trace_span("serving/pad", cat="serving", rows=rows,
-                             bucket=bucket):
-            cols = []
-            for i, (shape, dtype) in enumerate(self._prep.input_specs):
-                parts = [r.inputs[i] for r in batch]
-                if pad:
-                    parts.append(np.zeros((pad,) + tuple(shape[1:]), dtype))
-                cols.append(parts[0] if len(parts) == 1
-                            else np.concatenate(parts, axis=0))
-        try:
-            with _obs.trace_span("serving/device_step", cat="serving",
-                                 bucket=bucket, requests=len(batch)):
-                # chaos seam: an injected device-step failure takes the
-                # same path as a real one (all futures resolve with the
-                # exception; the worker stays serviceable)
-                _faults.kill_point("serving/device_step")
-                t_dev = _time.perf_counter()
-                outs = self._execs[bucket](self._params, *cols)
-                outs = [np.asarray(o) for o in outs]  # true sync
-                dev_ms = (_time.perf_counter() - t_dev) * 1e3
-        except BaseException as e:  # noqa: BLE001 — resolve all futures
+        # the batch span is its own trace (it serves many requests) but
+        # LINKS to every co-batched request's span; request spans link
+        # back — either end reconstructs request -> batch -> device step
+        links = ([f"{r.ctx[0]:016x}:{r.ctx[1]:016x}"
+                  for r in batch if r.ctx] if tracing else None)
+        batch_span = _obs.trace_span(
+            "serving/batch", cat="serving", rows=rows, bucket=bucket,
+            requests=len(batch), **({"links": links} if links else {}))
+        with batch_span:
+            # re-derive liveness from the span itself: obs.disable() can
+            # race this worker between the enabled() snapshot and the
+            # trace_span call, handing back the attribute-less NULL_SPAN
+            tracing = tracing and batch_span is not _obs.NULL_SPAN
+            batch_ref = (f"{batch_span.trace_id:016x}:"
+                         f"{batch_span.span_id:016x}" if tracing else None)
+            with _obs.trace_span("serving/pad", cat="serving", rows=rows,
+                                 bucket=bucket):
+                cols = []
+                for i, (shape, dtype) in enumerate(self._prep.input_specs):
+                    parts = [r.inputs[i] for r in batch]
+                    if pad:
+                        parts.append(np.zeros((pad,) + tuple(shape[1:]),
+                                              dtype))
+                    cols.append(parts[0] if len(parts) == 1
+                                else np.concatenate(parts, axis=0))
+            try:
+                with _obs.trace_span("serving/device_step", cat="serving",
+                                     bucket=bucket, requests=len(batch)):
+                    # chaos seam: an injected device-step failure takes
+                    # the same path as a real one (all futures resolve
+                    # with the exception; the worker stays serviceable)
+                    _faults.kill_point("serving/device_step")
+                    t_dev = _time.perf_counter()
+                    outs = self._execs[bucket](self._params, *cols)
+                    outs = [np.asarray(o) for o in outs]  # true sync
+                    dev_ms = (_time.perf_counter() - t_dev) * 1e3
+            except BaseException as e:  # noqa: BLE001 — resolve futures
+                with self._lock:
+                    self._stats["errors"] += len(batch)
+                _monitor.stat_add("serving_request_errors_total",
+                                  len(batch))
+                end_ns = _obs.now_ns()
+                for r in batch:
+                    if tracing and r.ctx:
+                        _obs.record_span(
+                            "serving/request", "serving", r.t0_ns, end_ns,
+                            trace_id=r.ctx[0], span_id=r.ctx[1],
+                            parent_id=r.ctx[2], rows=r.rows,
+                            error=type(e).__name__,
+                            **({"links": [batch_ref]} if batch_ref
+                               else {}))
+                    _resolve(r.future, exception=e)
+                return
+
+            # telemetry BEFORE resolving futures: a caller woken by its
+            # future must see this batch already accounted in stats()
+            self._dev_summary.observe(dev_ms)
+            _monitor.stat_add(
+                "serving_requests_total"
+                + _export.format_labels(bucket=bucket), len(batch))
+            _monitor.stat_add(
+                "serving_batches_total"
+                + _export.format_labels(bucket=bucket), 1)
+            if pad:
+                _monitor.stat_add("serving_padded_rows_total", pad)
+            _export.publish("serving", {"batch_fill_ratio": rows / bucket})
             with self._lock:
-                self._stats["errors"] += len(batch)
-            _monitor.stat_add("serving_request_errors_total", len(batch))
+                self._stats["requests"] += len(batch)
+                self._stats["batches"] += 1
+                self._stats["padded_rows"] += pad
+                if len(batch) > 1:
+                    self._stats["multi_request_batches"] += 1
+
+            off = 0
+            done = _time.perf_counter()
+            end_ns = _obs.now_ns()
+            whole = len(batch) == 1 and not pad  # slices = the buffer
             for r in batch:
-                _resolve(r.future, exception=e)
-            return
-
-        # telemetry BEFORE resolving futures: a caller woken by its
-        # future must see this batch already accounted in stats()
-        self._dev_summary.observe(dev_ms)
-        _monitor.stat_add('serving_requests_total{bucket="%d"}' % bucket,
-                          len(batch))
-        _monitor.stat_add('serving_batches_total{bucket="%d"}' % bucket, 1)
-        if pad:
-            _monitor.stat_add("serving_padded_rows_total", pad)
-        _export.publish("serving", {"batch_fill_ratio": rows / bucket})
-        with self._lock:
-            self._stats["requests"] += len(batch)
-            self._stats["batches"] += 1
-            self._stats["padded_rows"] += pad
-            if len(batch) > 1:
-                self._stats["multi_request_batches"] += 1
-
-        off = 0
-        done = _time.perf_counter()
-        whole = len(batch) == 1 and not pad  # slices would be the buffer
-        for r in batch:
-            self._lat_summary.observe((done - r.t_enqueue) * 1e3)
-            # copy the row slices out: handing back views would pin the
-            # whole bucket-sized buffer (and expose co-batched requests'
-            # rows through .base) for as long as a caller keeps a result
-            _resolve(r.future, result=list(outs) if whole else
-                     [o[off:off + r.rows].copy() for o in outs])
-            off += r.rows
+                self._lat_summary.observe((done - r.t_enqueue) * 1e3)
+                if tracing and r.ctx:
+                    # the request span closes when its answer exists:
+                    # submit -> resolve, linked to the batch that served
+                    # it (trace_view follows links in either direction)
+                    _obs.record_span(
+                        "serving/request", "serving", r.t0_ns, end_ns,
+                        trace_id=r.ctx[0], span_id=r.ctx[1],
+                        parent_id=r.ctx[2], rows=r.rows, bucket=bucket,
+                        **({"links": [batch_ref]} if batch_ref else {}))
+                # copy the row slices out: handing back views would pin
+                # the whole bucket-sized buffer (and expose co-batched
+                # requests' rows through .base) for as long as a caller
+                # keeps a result
+                _resolve(r.future, result=list(outs) if whole else
+                         [o[off:off + r.rows].copy() for o in outs])
+                off += r.rows
 
 
 def _resolve(future, result=None, exception=None):

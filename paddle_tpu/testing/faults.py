@@ -21,7 +21,7 @@ import threading
 import time
 
 __all__ = ["FaultInjected", "inject", "clear", "kill_point", "hits",
-           "fired", "armed", "reset", "scoped"]
+           "fired", "armed", "reset", "scoped", "snapshot"]
 
 
 class FaultInjected(Exception):
@@ -90,6 +90,23 @@ def armed(point):
         return point in _armed
 
 
+def snapshot():
+    """JSON-ready view of the harness state (the flight recorder embeds
+    it in crash dumps): armed points with their remaining budget, plus
+    the lifetime hit/fired counters."""
+    with _lock:
+        return {
+            "armed": {p: {"times": f.times, "skip": f.skip,
+                          "latency_s": f.latency_s,
+                          "exc": (f.exc if f.exc is None
+                                  else getattr(f.exc, "__name__",
+                                               repr(f.exc)))}
+                      for p, f in _armed.items()},
+            "hits": dict(_hits),
+            "fired": dict(_fired),
+        }
+
+
 def _make_exc(exc, point):
     if exc is None:
         return None
@@ -124,8 +141,35 @@ def kill_point(point):
     # every other kill-point in the process behind it
     if latency:
         time.sleep(latency)
+    _on_fired(point)
     if exc is not None:
         raise exc
+
+
+def _on_fired(point):
+    """A kill-point FIRED: leave evidence before the injected exception
+    unwinds — a zero-width span at the kill site, a run-log event, and
+    (when the flight recorder is armed) an atomic crash dump whose last
+    span is this one. Never raises: injecting the *configured* fault is
+    the contract, not a recorder error."""
+    try:
+        from ..observability import flight, runlog, tracing
+        now = tracing.now_ns()
+        if tracing.enabled("user"):
+            # record_span fans out to profiler + flight ring + run-log
+            tracing.record_span(f"fault/{point}", "user", now, now,
+                                kill_point=point)
+        else:
+            # evidence even without tracing (or with the "user" category
+            # off — record_span would silently no-op): the flight ring
+            # is always on
+            flight.record(f"fault/{point}", "user", now, now, 0, 0, 0,
+                          {"kill_point": point})
+        runlog.event("fault_fired", point=point)
+        if flight.installed():
+            flight.on_kill_point(point)
+    except Exception:
+        pass
 
 
 class scoped:

@@ -446,3 +446,79 @@ def test_retry_without_backoff_lint_rule(tmp_path):
                      if f.rule == "retry-without-backoff"]
     assert repo_findings == [], repo_findings
     assert any(p.endswith("client.py") for p in RPC_PATHS)
+
+
+# -- crash flight recorder (PR 8) -------------------------------------------
+
+class TestFlightRecorder:
+    """Acceptance (ISSUE 8): a kill-point fire leaves a readable,
+    atomically-written flight-recorder dump whose last span matches the
+    kill site — the black-box evidence that survives the process."""
+
+    @pytest.fixture(autouse=True)
+    def _obs(self, tmp_path):
+        import paddle_tpu.observability as obs
+        from paddle_tpu import profiler
+        from paddle_tpu.observability import flight
+        profiler.reset()
+        flight.clear()
+        obs.enable()
+        flight.install(str(tmp_path / "flight"))
+        yield obs
+        obs.disable()
+        flight.uninstall()
+        flight.clear()
+        profiler.reset()
+
+    def test_checkpoint_kill_leaves_dump_at_kill_site(self, tmp_path):
+        from paddle_tpu import checkpoint
+        from paddle_tpu.observability import flight
+
+        root = str(tmp_path / "ckpt")
+        checkpoint.write_checkpoint(root, 1, {"w.bin": b"ok" * 64})
+        faults.inject("checkpoint/manifest_partial", times=1)
+        with pytest.raises(faults.FaultInjected):
+            checkpoint.write_checkpoint(root, 2, {"w.bin": b"xx" * 64})
+        path = flight.latest_dump()
+        assert path is not None
+        rec = json.load(open(path))
+        assert rec["reason"] == "kill_point"
+        assert rec["kill_point"] == "checkpoint/manifest_partial"
+        # the LAST span in the ring is the kill site itself, and the
+        # stage spans before it show how far the writer got
+        assert rec["spans"][-1]["name"] == \
+            "fault/checkpoint/manifest_partial"
+        earlier = {s["name"] for s in rec["spans"][:-1]}
+        assert "checkpoint/write_data" in earlier
+        # fault state + metrics snapshot are embedded
+        assert rec["faults"]["fired"]["checkpoint/manifest_partial"] == 1
+        assert rec["metrics"]["counters"].get(
+            "checkpoint_saves_total", 0) >= 1
+        # and the torn write did NOT poison restore (PR-7 contract)
+        got = checkpoint.read_checkpoint(root)
+        assert got is not None and got[0] == 1
+
+    def test_serving_device_step_kill_dump(self, tmp_path):
+        import paddle_tpu.observability as obs
+        from paddle_tpu.observability import flight
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 4))
+        model.eval()
+        eng = serving.Engine.from_layer(
+            model, [([None, 4], "float32")], bucket_ladder=(1, 2),
+            batch_timeout_ms=1.0)
+        try:
+            eng.predict(np.ones((1, 4), np.float32))  # healthy first
+            faults.inject("serving/device_step", times=1)
+            with pytest.raises(faults.FaultInjected):
+                eng.predict(np.ones((1, 4), np.float32))
+            rec = json.load(open(flight.latest_dump()))
+            assert rec["kill_point"] == "serving/device_step"
+            assert rec["spans"][-1]["name"] == \
+                "fault/serving/device_step"
+            # worker survived: the engine still serves
+            out = eng.predict(np.ones((1, 4), np.float32))
+            assert out[0].shape == (1, 4)
+        finally:
+            eng.close()

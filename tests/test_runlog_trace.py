@@ -1,0 +1,563 @@
+"""Observability v2: trace-context propagation, the structured run-log,
+the crash flight recorder, and the multi-process trace merge tool.
+
+Acceptance (ISSUE 8): a serving request under concurrent load and a PS
+push surviving a retry each yield ONE connected trace (request -> batch
+-> device step; client attempt -> server apply) reconstructible by
+tools/trace_view.py from multi-process run-logs; a fired kill-point
+leaves a readable flight-recorder dump whose last span names the kill
+site (the chaos-tier twin lives in test_chaos.py).
+"""
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.observability as obs
+from paddle_tpu import _native, profiler
+from paddle_tpu.observability import export as export_mod
+from paddle_tpu.observability import flight, runlog
+from paddle_tpu.observability import tracing as tracing_mod
+from paddle_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import trace_view  # noqa: E402  (tools/ is not a package)
+
+
+@pytest.fixture()
+def tracing(tmp_path):
+    """Tracing + run-log session writing into tmp_path; everything torn
+    down (observability state is process-global)."""
+    profiler.reset()
+    flight.clear()
+    obs.enable()
+    log = obs.start_run(dir=str(tmp_path / "logs"), run_id="t",
+                        rank=0)
+    try:
+        yield log
+    finally:
+        obs.stop_run()
+        obs.disable()
+        flight.uninstall()
+        flight.clear()
+        profiler.reset()
+        faults.reset()
+
+
+def _load(tmp_path):
+    d = str(tmp_path / "logs")
+    paths = [os.path.join(d, f) for f in sorted(os.listdir(d))]
+    events, bad = trace_view.load_events(paths)
+    assert bad == 0
+    return events
+
+
+# -- trace context ---------------------------------------------------------
+
+def test_span_ids_nest_and_propagate(tracing):
+    with obs.trace_span("outer", cat="user") as o:
+        assert o.trace_id != 0 and o.parent_id == 0
+        assert obs.trace_context() == (o.trace_id, o.span_id)
+        with obs.trace_span("inner", cat="user") as i:
+            assert i.trace_id == o.trace_id
+            assert i.parent_id == o.span_id
+            assert i.span_id not in (0, o.span_id)
+    assert obs.trace_context() is None
+    # distinct roots mint distinct traces
+    with obs.trace_span("other", cat="user") as p:
+        assert p.trace_id != o.trace_id
+
+
+def test_attach_context_adopts_remote_parent(tracing):
+    with tracing_mod.attach_context(0xabc, 0xdef):
+        assert obs.trace_context() == (0xabc, 0xdef)
+        with obs.trace_span("adopted", cat="user") as s:
+            assert s.trace_id == 0xabc and s.parent_id == 0xdef
+    assert obs.trace_context() is None
+
+
+def test_mint_and_retrospective_record(tracing, tmp_path):
+    with obs.trace_span("parent", cat="user") as p:
+        tr, sp, pa = obs.mint_context()
+        assert tr == p.trace_id and pa == p.span_id
+    got = obs.record_span("retro", "user", 100, 200, trace_id=tr,
+                          span_id=sp, parent_id=pa, foo="bar")
+    assert got == (tr, sp)
+    obs.stop_run()
+    events = _load(tmp_path)
+    rec = [e for e in events if e.get("name") == "retro"][0]
+    assert rec["trace"] == f"{tr:016x}" and rec["parent"] == f"{pa:016x}"
+    assert rec["attrs"]["foo"] == "bar"
+
+
+def test_record_span_is_noop_when_disabled():
+    obs.disable()
+    assert obs.record_span("x", "user", 0, 1) is None
+
+
+# -- run-log ----------------------------------------------------------------
+
+def test_runlog_manifest_spans_events(tracing, tmp_path):
+    with obs.trace_span("work", cat="user"):
+        pass
+    runlog.event("custom", value=7)
+    obs.stop_run()
+    events = _load(tmp_path)
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "manifest"
+    m = events[0]
+    assert m["run_id"] == "t" and m["rank"] == 0
+    assert m["pid"] == os.getpid()
+    assert m["git_sha"] is None or len(m["git_sha"]) == 40
+    assert "mono_ns" in m and "time" in m
+    span = [e for e in events if e.get("name") == "work"][0]
+    assert len(span["trace"]) == 16 and span["dur"] >= 0
+    ev = [e for e in events if e.get("event") == "custom"][0]
+    assert ev["value"] == 7
+
+
+def test_runlog_env_activation(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_RUNLOG_DIR", str(tmp_path / "envlogs"))
+    profiler.reset()
+    obs.enable()
+    try:
+        assert runlog.active() is not None
+        assert str(tmp_path / "envlogs") in runlog.log_path()
+    finally:
+        obs.stop_run()
+        obs.disable()
+        profiler.reset()
+
+
+def test_trace_view_merges_multi_rank_logs(tmp_path):
+    """Two ranks' logs merge into one chrome trace with one process
+    track each, spans aligned onto the wall clock."""
+    profiler.reset()
+    obs.enable()
+    try:
+        for rank in range(2):
+            obs.start_run(dir=str(tmp_path / "logs"), run_id="mr",
+                          rank=rank)
+            with obs.trace_span(f"rank{rank}/step", cat="user"):
+                pass
+        obs.stop_run()
+    finally:
+        obs.disable()
+        profiler.reset()
+    events = _load(tmp_path)
+    ct = trace_view.build_chrome_trace(events)
+    names = {e["args"]["name"] for e in ct["traceEvents"]
+             if e.get("ph") == "M"}
+    assert any("rank0" in n for n in names), names
+    assert any("rank1" in n for n in names), names
+    xs = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"rank0/step", "rank1/step"}
+    assert len({e["pid"] for e in xs}) == 2
+    # wall-clock alignment applied (manifest anchors land spans near now)
+    import time
+    for e in xs:
+        assert abs(e["ts"] / 1e6 - time.time()) < 3600
+
+
+def test_trace_view_cli_and_stats(tmp_path, capsys):
+    profiler.reset()
+    obs.enable()
+    obs.start_run(dir=str(tmp_path / "logs"), run_id="cli", rank=0)
+    with obs.trace_span("a", cat="user"):
+        pass
+    runlog.event("checkpoint_publish", step=1)
+    obs.stop_run()
+    obs.disable()
+    profiler.reset()
+    d = str(tmp_path / "logs")
+    logs = [os.path.join(d, f) for f in os.listdir(d)]
+    out = str(tmp_path / "trace.json")
+    assert trace_view.main(logs + ["-o", out]) == 0
+    trace = json.load(open(out))
+    assert any(e.get("name") == "a" for e in trace["traceEvents"])
+    assert any(e.get("ph") == "i" for e in trace["traceEvents"])
+    assert trace_view.main(logs + ["--stats"]) == 0
+    text = capsys.readouterr().out
+    assert "1 process log(s)" in text
+    assert "checkpoint_publish=1" in text
+
+
+# -- acceptance: serving request -> batch -> device step --------------------
+
+def test_serving_connected_trace_under_concurrent_load(tracing, tmp_path):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    model.eval()
+    import paddle_tpu.serving as serving
+    eng = serving.Engine.from_layer(model, [([None, 8], "float32")],
+                                    bucket_ladder=(1, 4, 8),
+                                    batch_timeout_ms=2.0)
+    try:
+        def client(seed):
+            r = np.random.RandomState(seed)
+            for _ in range(5):
+                eng.predict(r.rand(r.randint(1, 4), 8)
+                            .astype(np.float32))
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        eng.close()
+    obs.stop_run()
+    events = _load(tmp_path)
+    reqs = [e for e in events if e.get("name") == "serving/request"]
+    assert len(reqs) == 20
+    multi = [e for e in events if e.get("name") == "serving/batch"
+             and e["attrs"]["requests"] > 1]
+    assert multi, "no coalesced batch under 4-thread load"
+    # EVERY request's trace reaches its batch and device step
+    for r in reqs:
+        con = trace_view.connected_spans(events, r["trace"])
+        names = {s["name"] for s in con}
+        assert {"serving/request", "serving/queue_wait", "serving/batch",
+                "serving/device_step"} <= names, (r["trace"], names)
+    # a queue-wait span lives in its request's own trace, under the
+    # request span (p99 decomposition per request)
+    waits = [e for e in events if e.get("name") == "serving/queue_wait"]
+    req_by_key = {(r["trace"], r["span"]): r for r in reqs}
+    assert all((w["trace"], w.get("parent")) in req_by_key
+               for w in waits)
+    # chrome output carries flow arrows for the links
+    ct = trace_view.build_chrome_trace(events,
+                                       trace_filter=reqs[0]["trace"])
+    assert {"s", "f"} <= {e["ph"] for e in ct["traceEvents"]}
+
+
+# -- acceptance: PS push surviving a retry ---------------------------------
+
+@pytest.mark.skipif(_native.lib() is None, reason="needs native runtime")
+def test_ps_push_retry_single_connected_trace(tracing, tmp_path):
+    from paddle_tpu.distributed.ps import PsClient, PsServer, TableConfig
+    from paddle_tpu.distributed.ps.retry import RetryPolicy
+
+    srv = PsServer([TableConfig(810, "dense", 8, "sgd", lr=0.1)], port=0)
+    port = srv.start()
+    cli = PsClient([f"127.0.0.1:{port}"],
+                   retry_policy=RetryPolicy(max_attempts=4,
+                                            base_delay_s=0.01, seed=3),
+                   request_id_base=8_100_000)
+    try:
+        cli.register_dense(810, 8)
+        cli.pull_dense_init(810, np.zeros(8, np.float32))
+        srv.trace_spans()  # drain the init-call spans
+        with faults.scoped("ps/call", exc=ConnectionError, times=1,
+                           skip=0):
+            with obs.trace_span("train/push", cat="user") as root:
+                cli.push_dense_grad(810, np.ones(8, np.float32))
+                root_trace = root.trace_id
+        # peek WITHOUT draining: srv.stop() moves the ring into the
+        # run-log, which is what trace_view reconstructs from
+        server_spans = srv.trace_spans(drain=False)
+    finally:
+        cli.stop_servers()
+        srv.stop()
+    obs.stop_run()
+    events = _load(tmp_path)
+    attempts = [e for e in events
+                if e.get("name") == "ps/attempt/push_dense_grad"]
+    assert len(attempts) == 2  # injected failure + the retry that won
+    assert attempts[0]["attrs"]["error"] == "ConnectionError"
+    assert all(e["trace"] == f"{root_trace:016x}" for e in attempts)
+    retry_ev = [e for e in events if e.get("event") == "ps_retry"]
+    assert retry_ev and retry_ev[0]["op"] == "push_dense_grad"
+    # the server applied ONCE, in the same trace, parented to the
+    # attempt that reached it
+    applies = [s for s in server_spans
+               if s["name"] == "ps_server/push_dense_grad"]
+    assert len(applies) == 1 and applies[0]["dup"] == 0
+    assert applies[0]["trace"] == root_trace
+    att_ids = {int(e["span"], 16) for e in attempts}
+    assert applies[0]["parent"] in att_ids
+    # connected through trace_view from the merged logs: the drain in
+    # srv.stop() moved the server spans into the run-log already
+    con = trace_view.connected_spans(events, f"{root_trace:016x}")
+    names = {s["name"] for s in con}
+    assert {"train/push", "ps/push_dense_grad",
+            "ps/attempt/push_dense_grad",
+            "ps_server/push_dense_grad"} <= names, names
+
+
+@pytest.mark.skipif(_native.lib() is None, reason="needs native runtime")
+def test_ps_dedup_ack_recorded_in_trace(tracing):
+    """A duplicate push (response lost, client re-sends) records a
+    server span marked dup — the retry is visible, the apply is not
+    doubled."""
+    import struct
+
+    from paddle_tpu.distributed.ps import PsClient, PsServer, TableConfig
+    from paddle_tpu.distributed.ps.client import (MAGIC, TRACE_FLAG,
+                                                  OP_PUSH_DENSE_GRAD_ID)
+
+    srv = PsServer([TableConfig(811, "dense", 4, "sum")], port=0)
+    port = srv.start()
+    cli = PsClient([f"127.0.0.1:{port}"], request_id_base=9_000_000)
+    try:
+        cli.register_dense(811, 4)
+        cli.pull_dense_init(811, np.zeros(4, np.float32))
+        srv.trace_spans()
+        grad = np.ones(4, np.float32)
+        # hand-built traced frame: trace ctx prefix + request id + grad,
+        # sent twice with the SAME request id = a re-sent push whose
+        # first response was lost. Tracing is disabled around the sends
+        # so the client does not stack a SECOND auto-context prefix on
+        # the hand-built one.
+        payload = struct.pack("<QQ", 0x77, 0x88) + \
+            struct.pack("<Q", 424242) + grad.tobytes()
+        obs.disable()
+        try:
+            for _ in range(2):
+                raw = cli._call_impl(0, OP_PUSH_DENSE_GRAD_ID
+                                     | TRACE_FLAG, 811, 0, payload,
+                                     idempotent=True)
+                assert struct.unpack("<I", raw)[0] == 1
+        finally:
+            obs.enable()
+        spans = srv.trace_spans()
+        assert np.allclose(cli.pull_dense(811), 1.0)  # applied ONCE
+    finally:
+        cli.stop_servers()
+        srv.stop()
+    # both the apply and the dedup ack are in the ring... the python
+    # client stamped its own live context; assert one dup span exists
+    pushes = [s for s in spans
+              if s["name"] == "ps_server/push_dense_grad"]
+    assert len(pushes) == 2
+    assert sorted(p["dup"] for p in pushes) == [0, 1]
+    # the wire context is echoed verbatim into both server spans
+    assert all(p["trace"] == 0x77 and p["parent"] == 0x88
+               for p in pushes)
+
+
+# -- flight recorder --------------------------------------------------------
+
+def test_flight_dump_on_kill_point(tracing, tmp_path):
+    flight.install(str(tmp_path / "flight"))
+    faults.inject("demo/unit", times=1)
+    with pytest.raises(faults.FaultInjected):
+        faults.kill_point("demo/unit")
+    p = flight.latest_dump()
+    assert p is not None
+    rec = json.load(open(p))
+    assert rec["reason"] == "kill_point"
+    assert rec["kill_point"] == "demo/unit"
+    assert rec["spans"][-1]["name"] == "fault/demo/unit"
+    assert rec["faults"]["fired"]["demo/unit"] == 1
+    assert "counters" in rec["metrics"]
+    # the fire is in the run-log too
+    obs.stop_run()
+    events = _load(tmp_path)
+    ev = [e for e in events if e.get("event") == "fault_fired"]
+    assert ev and ev[0]["point"] == "demo/unit"
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_flight_dump_on_thread_exception(tracing, tmp_path):
+    flight.install(str(tmp_path / "flight"))
+    before = flight.latest_dump()
+
+    def boom():
+        with obs.trace_span("worker/task", cat="user"):
+            pass
+        raise RuntimeError("worker died")
+
+    t = threading.Thread(target=boom, name="doomed")
+    t.start()
+    t.join()
+    p = flight.latest_dump()
+    assert p is not None and p != before
+    rec = json.load(open(p))
+    assert rec["reason"] == "unhandled_thread_exception"
+    assert rec["exception"]["type"] == "RuntimeError"
+    assert any(s["name"] == "worker/task" for s in rec["spans"])
+
+
+def test_flight_dump_is_atomic_and_bounded(tracing, tmp_path):
+    flight.install(str(tmp_path / "flight"), ring=32)
+    for i in range(50):
+        with obs.trace_span(f"s{i}", cat="user"):
+            pass
+    p = flight.dump("manual")
+    rec = json.load(open(p))
+    assert len(rec["spans"]) <= 32
+    assert rec["spans"][-1]["name"] == "s49"
+    assert not [f for f in os.listdir(tmp_path / "flight")
+                if f.endswith(".tmp")]
+
+
+def test_flight_not_installed_is_noop(tmp_path):
+    flight.uninstall()
+    assert flight.dump("nope") is None
+    assert flight.latest_dump(str(tmp_path)) is None
+
+
+# -- checkpoint stages in the trace ----------------------------------------
+
+def test_checkpoint_stage_spans_and_publish_event(tracing, tmp_path):
+    from paddle_tpu import checkpoint
+    root = str(tmp_path / "ckpt")
+    checkpoint.write_checkpoint(root, 3, {"w.bin": b"x" * 128},
+                                meta={"epoch": 1})
+    obs.stop_run()
+    events = _load(tmp_path)
+    names = [e.get("name") for e in events if e.get("kind") == "span"]
+    for stage in ("checkpoint/write_data", "checkpoint/write_manifest",
+                  "checkpoint/publish", "checkpoint/save"):
+        assert stage in names, names
+    # stage spans are children inside the save span's trace
+    save = [e for e in events if e.get("name") == "checkpoint/save"][0]
+    stages = [e for e in events if e.get("name", "").startswith(
+        "checkpoint/") and e["name"] != "checkpoint/save"
+        and e.get("kind") == "span"]
+    assert all(s["trace"] == save["trace"] for s in stages)
+    pub = [e for e in events if e.get("event") == "checkpoint_publish"][0]
+    assert pub["step"] == 3 and pub["bytes"] == 128 and pub["files"] == 1
+
+
+# -- satellites -------------------------------------------------------------
+
+def test_prometheus_label_value_escaping():
+    assert export_mod.escape_label_value('a"b\\c\nd') == \
+        'a\\"b\\\\c\\nd'
+    lbl = export_mod.format_labels(table='t"1', op="pull\nsparse")
+    assert lbl == '{table="t\\"1",op="pull\\nsparse"}'
+
+    def odd_collector():
+        return {"odd_metric" + export_mod.format_labels(
+            name='we"ird\nvalue\\x'): 5}
+
+    export_mod.register_collector("odd_test", odd_collector)
+    try:
+        text = export_mod.prometheus_text()
+    finally:
+        export_mod.unregister_collector("odd_test")
+    line = [ln for ln in text.splitlines() if "odd_metric" in ln
+            and not ln.startswith("#")]
+    assert len(line) == 1  # ONE line: the newline was escaped
+    assert '\\"' in line[0] and "\\n" in line[0]
+
+
+def test_summary_window_env_and_ctor(monkeypatch):
+    s = export_mod.Summary("w_test_a", window=16)
+    assert s.window == 16
+    monkeypatch.setenv("PADDLE_TPU_SUMMARY_WINDOW", "64")
+    s2 = export_mod.Summary("w_test_b")
+    assert s2.window == 64
+    for i in range(100):
+        s2.observe(float(i))
+    assert s2.count == 100  # lifetime, beyond the window
+    assert s2.quantiles()[0.5] >= 36.0  # only the last 64 in the ring
+    assert s2.snapshot()["window"] == 64
+    # the ring size is exported as a gauge next to the summary
+    name = "w_gauge_test"
+    export_mod.summary(name, window=32).observe(1.0)
+    text = export_mod.prometheus_text()
+    assert f"paddle_tpu_{name}_window 32" in text
+    assert f"# TYPE paddle_tpu_{name}_window gauge" in text
+
+
+def test_concurrent_scrapes_with_writer_threads(tracing):
+    """Satellite: /metrics + /healthz scraped concurrently while worker
+    threads hammer spans, counters and summaries — every response parses
+    (no torn lines), no deadlock, bounded time."""
+    from urllib.request import urlopen
+
+    from paddle_tpu import monitor
+
+    export_mod.register_health("scrape_test", lambda: {"status": "ok"})
+    server = export_mod.start_http_server(port=0)
+    stop = threading.Event()
+    errors = []
+
+    def writer(n):
+        i = 0
+        while not stop.is_set():
+            with obs.trace_span(f"w{n}", cat="user"):
+                monitor.stat_add("scrape_test_counter", 1)
+            export_mod.summary("scrape_test_ms").observe(i % 7)
+            export_mod.publish("scrape_test", {"x": float(i)})
+            i += 1
+
+    def scraper(path, check):
+        try:
+            for _ in range(20):
+                body = urlopen(
+                    f"http://127.0.0.1:{server.port}{path}",
+                    timeout=10).read()
+                check(body)
+        except Exception as e:  # pragma: no cover - failure detail
+            errors.append(f"{path}: {e!r}")
+
+    def check_metrics(body):
+        for ln in body.decode().splitlines():
+            assert ln.startswith("#") or " " in ln, ln
+
+    def check_health(body):
+        assert json.loads(body)["status"] == "ok"
+
+    writers = [threading.Thread(target=writer, args=(i,))
+               for i in range(3)]
+    scrapers = [threading.Thread(target=scraper,
+                                 args=("/metrics", check_metrics)),
+                threading.Thread(target=scraper,
+                                 args=("/healthz", check_health)),
+                threading.Thread(target=scraper, args=(
+                    "/telemetry.json", lambda b: json.loads(b)))]
+    try:
+        for t in writers + scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=60)
+            assert not t.is_alive(), "scraper deadlocked"
+    finally:
+        stop.set()
+        for t in writers:
+            t.join(timeout=10)
+        server.stop()
+        export_mod.unregister_health("scrape_test")
+    assert not errors, errors
+
+
+def test_span_leak_lint_rule(tmp_path):
+    # paddle_tpu.analysis.lint the MODULE (the package re-exports a
+    # lint() function under the same name)
+    from paddle_tpu.analysis import lint as _  # noqa: F401
+    import paddle_tpu.analysis.lint
+    lint = sys.modules["paddle_tpu.analysis.lint"]
+    src = tmp_path / "leaky.py"
+    src.write_text(
+        "from paddle_tpu.observability import tracing as t\n"
+        "def ok():\n"
+        "    with t.trace_span('a'):\n"
+        "        pass\n"
+        "    s = t.trace_span('b')\n"
+        "    with s:\n"
+        "        pass\n"
+        "def factory():\n"
+        "    return t.trace_span('c')\n"
+        "def bare():\n"
+        "    t.trace_span('leak')\n"
+        "def assigned():\n"
+        "    s = t.trace_span('leak2')\n"
+        "    s.set_attr(x=1)\n")
+    fs = [f for f in lint.lint_source(paths=[str(src)])
+          if f.rule == "span-without-context-manager"]
+    assert len(fs) == 2
+    assert sorted(f.severity for f in fs) == ["error", "warning"]
+    # the shipped instrumented paths stay clean under the default scan
+    assert not [f for f in lint.lint_source()
+                if f.rule == "span-without-context-manager"]

@@ -37,6 +37,7 @@ MODULES = [
     "paddle_tpu.quantization",
     "paddle_tpu.sparsity",
     "paddle_tpu.inference",
+    "paddle_tpu.observability",
     "paddle_tpu.serving",
     "paddle_tpu.checkpoint",
     "paddle_tpu.testing",
