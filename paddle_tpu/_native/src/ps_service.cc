@@ -85,6 +85,11 @@ enum Op : uint8_t {
   kPushDenseDeltaId = 14,
   kPushSparseGradId = 15,
   kPushSparseDeltaId = 16,
+  // drain the service-side trace-span ring over the wire (n != 0 drains,
+  // n == 0 peeks): a client of a REMOTE server — one not sharing this
+  // process, where pt_ps_trace_json is unreachable — collects the
+  // server's spans into its own run-log (PsClient.drain_server_spans)
+  kPullSpans = 17,
   // graph service (reference: common_graph_table.cc + graph_brpc_server.cc)
   kGraphAddNodes = 20,        // n ids | n*feat_dim f32 features
   kGraphAddEdges = 21,        // n src | n dst | n f32 weights
@@ -471,6 +476,21 @@ void record_trace_span(PsServer* ps, uint64_t trace, uint64_t parent,
   std::lock_guard<std::mutex> lk(ps->trace_mu);
   if (ps->trace_ring.size() >= kTraceRingCap) ps->trace_ring.pop_front();
   ps->trace_ring.push_back(s);
+}
+
+// one span as a JSON object, appended to `s` (shared by the in-process
+// pt_ps_trace_json export and the kPullSpans wire handler)
+void append_span_json(std::string& s, const TraceSpan& sp, bool first) {
+  char buf[256];
+  snprintf(buf, sizeof(buf),
+           "%s{\"trace\":%llu,\"parent\":%llu,\"span\":%llu,"
+           "\"table\":%u,\"op\":%u,\"dup\":%u,\"t0\":%lld,"
+           "\"t1\":%lld}",
+           first ? "" : ",", (unsigned long long)sp.trace,
+           (unsigned long long)sp.parent, (unsigned long long)sp.span,
+           sp.table, (unsigned)sp.op, (unsigned)sp.dup,
+           (long long)sp.t0, (long long)sp.t1);
+  s += buf;
 }
 
 constexpr size_t kSeenReqWindow = 1u << 16;
@@ -1158,6 +1178,29 @@ void handle_conn(PsServer* ps, int fd, size_t conn_idx) {
         send_resp(fd, &sz, 8);
         break;
       }
+      case kPullSpans: {
+        // Serialize the ring for a remote client; `n != 0` drains. The
+        // ring is swapped out BEFORE the send, so a lost response loses
+        // those spans — they are telemetry, not state, and the client's
+        // retry simply returns whatever accumulated since.
+        std::deque<TraceSpan> spans;
+        {
+          std::lock_guard<std::mutex> tlk(ps->trace_mu);
+          if (n != 0)
+            spans.swap(ps->trace_ring);
+          else
+            spans = ps->trace_ring;
+        }
+        std::string s = "[";
+        bool first = true;
+        for (auto& sp : spans) {
+          append_span_json(s, sp, first);
+          first = false;
+        }
+        s += "]";
+        send_resp(fd, s.data(), (uint32_t)s.size());
+        break;
+      }
       case kSparseSpillInfo: {
         SparseTable* tp = find_sparse(ps, table);
         uint64_t info[3] = {0, 0, 0};
@@ -1385,16 +1428,7 @@ PT_API int32_t pt_ps_trace_json(char* out, int32_t cap, int32_t drain) {
     std::lock_guard<std::mutex> tlk(g_ps->trace_mu);
     bool first = true;
     for (auto& sp : g_ps->trace_ring) {
-      char buf[256];
-      snprintf(buf, sizeof(buf),
-               "%s{\"trace\":%llu,\"parent\":%llu,\"span\":%llu,"
-               "\"table\":%u,\"op\":%u,\"dup\":%u,\"t0\":%lld,"
-               "\"t1\":%lld}",
-               first ? "" : ",", (unsigned long long)sp.trace,
-               (unsigned long long)sp.parent, (unsigned long long)sp.span,
-               sp.table, (unsigned)sp.op, (unsigned)sp.dup,
-               (long long)sp.t0, (long long)sp.t1);
-      s += buf;
+      append_span_json(s, sp, first);
       first = false;
     }
     if ((int32_t)s.size() + 2 <= cap && drain) g_ps->trace_ring.clear();

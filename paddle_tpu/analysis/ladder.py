@@ -218,6 +218,32 @@ def _zero3_ranks():
     return pairs
 
 
+def _ctr_like():
+    """wide & deep CTR core — slot-id embedding gathers (the cached
+    scan-window lookup is a gather from a device table; the Embedding
+    op is its program-level twin) + wide per-key scalar sum + MLP head
+    through a bce-with-logits loss, the workload class of the ctr bench
+    rows."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        ids = static.data("slot_ids", [4, 4], "int64")
+        label = static.data("label", [4, 1], "float32")
+        deep = nn.Embedding(64, 8)
+        wide = nn.Embedding(64, 1)
+        e = deep(ids)                          # [4, 4, 8]
+        w = wide(ids)                          # [4, 4, 1]
+        h = paddle.reshape(e, [4, 32])
+        w1 = static.create_parameter([32, 16], "float32")
+        w2 = static.create_parameter([16, 1], "float32")
+        h = nn.functional.relu(paddle.matmul(h, w1))
+        logit = paddle.add(paddle.matmul(h, w2), paddle.sum(w, axis=1))
+        loss = nn.functional.binary_cross_entropy_with_logits(logit, label)
+    return [(prog, [loss])]
+
+
 def _serving_like():
     """The serving engine's load-time pipeline over a dynamic-batch
     forward program: eval clone → prune-to-fetch → bf16 weight/compute
@@ -249,6 +275,7 @@ LADDER_BUILDERS = {
     "bert": _bert_like,
     "detection": _detection_like,
     "hbm_cache": _hbm_cache_like,
+    "ctr": _ctr_like,
     "serving": _serving_like,
     "allreduce": _allreduce_ranks,
     "zero1": _zero1_ranks,

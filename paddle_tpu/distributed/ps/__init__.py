@@ -33,6 +33,8 @@ from .trainer import DownpourTrainer, DownpourWorker  # noqa: F401
 from .heter import HeterClient, HeterServer, start_heter_server  # noqa: F401
 from .hbm_cache import (CachedSparseEmbedding, HbmEmbeddingCache,  # noqa: F401
                         PsTpuTrainer)
+from .async_cache import (CachePrefetcher, WindowPlan,  # noqa: F401
+                          WriteBackQueue)
 from .graph import GraphPsClient  # noqa: F401
 
 

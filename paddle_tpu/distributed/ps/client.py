@@ -41,6 +41,7 @@ OP_PUSH_DENSE_GRAD_ID = 13
 OP_PUSH_DENSE_DELTA_ID = 14
 OP_PUSH_SPARSE_GRAD_ID = 15
 OP_PUSH_SPARSE_DELTA_ID = 16
+OP_PULL_SPANS = 17
 OP_SPARSE_SPILL_INFO = 27
 
 # the one wire-op -> name map (client spans AND the server's per-table
@@ -56,6 +57,7 @@ _OP_NAMES = {
     OP_PUSH_DENSE_DELTA_ID: "push_dense_delta",
     OP_PUSH_SPARSE_GRAD_ID: "push_sparse_grad",
     OP_PUSH_SPARSE_DELTA_ID: "push_sparse_delta",
+    OP_PULL_SPANS: "pull_spans",
     OP_SPARSE_SPILL_INFO: "sparse_spill_info",
     20: "graph_add_nodes", 21: "graph_add_edges",
     22: "graph_sample_neighbors", 23: "graph_pull_list",
@@ -387,6 +389,54 @@ class PsClient:
                 raise RuntimeError(
                     f"ps server {i} failed to load snapshot "
                     f"{path_prefix}.{i}")
+
+    def drain_server_spans(self, to_runlog=True, drain=True):
+        """Pull service-side trace spans from every server over the wire
+        (wire op 17) — the remote-server twin of
+        ``server.drain_trace_to_runlog()``: a client of a server in
+        ANOTHER process (where the native ring is unreachable) collects
+        the service's spans into its own run-log, so a single merge of
+        client-side logs reconstructs the full client→server trace.
+
+        Returns the parsed span rows (``name``/``table``/``op``/
+        ``trace``/``parent``/``span``/``t0``/``t1``/``dup``/``server``).
+        With ``to_runlog`` and an active run-log, rows are also recorded
+        tagged ``process="ps_server"`` so ``tools/trace_view.py`` gives
+        the service its own track. ``drain=False`` peeks without
+        emptying the server's bounded ring.
+
+        Span timestamps are on the SERVER's CLOCK_MONOTONIC base — for a
+        same-host server that is also the client profiler's base; spans
+        from a server on a different host land unaligned (align via the
+        server host's own run-log manifest instead).
+        """
+        import json as _json
+
+        out = []
+        for i in range(self.n_servers):
+            # retriable: a re-sent drain after a lost response cannot
+            # corrupt state — the lost batch of spans is gone either way
+            # (telemetry, not state) and the retry returns what has
+            # accumulated since
+            raw = self._call(i, OP_PULL_SPANS, 0, 1 if drain else 0,
+                             idempotent=True)
+            rows = _json.loads(raw.decode()) if raw else []
+            for r in rows:
+                r["name"] = ("ps_server/"
+                             f"{_OP_NAMES.get(r['op'], 'op%d' % r['op'])}")
+                r["server"] = self.endpoints[i]
+            out.extend(rows)
+        if to_runlog and out:
+            from ...observability import runlog
+            if runlog.active() is not None:
+                for r in out:
+                    runlog.span(r["name"], "ps", r["t0"], r["t1"],
+                                r["trace"], r["span"], r["parent"],
+                                attrs={"table": r["table"],
+                                       "dup": bool(r["dup"]),
+                                       "server": r["server"]},
+                                process="ps_server", tid=0)
+        return out
 
     def sparse_spill_info(self, table):
         """Per-server (in_memory_rows, spilled_rows, spill_failures) for

@@ -217,7 +217,14 @@ class DataLoader:
     axis, producing the ``[k, ...]`` super-batches a scan-compiled step
     program (``to_static(fn, scan_steps=k)``) consumes; incomplete
     trailing groups are dropped. Composes with ``prefetch_to_device`` —
-    the whole k-stack transfers while the previous scan program runs."""
+    the whole k-stack transfers while the previous scan program runs.
+
+    ``prefetch_transform=fn`` runs ``fn(batch) -> batch`` inside the
+    prefetch chain, one batch AHEAD of consumption (before the device
+    stage when ``prefetch_to_device`` is on). The HBM embedding cache
+    rides this seam: a transform that submits the super-batch's ids to a
+    ``CachePrefetcher`` starts the PS pull + install for window N+1
+    while the consumer computes window N."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -225,7 +232,7 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  shm_capacity=64 << 20, prefetch_to_device=False,
-                 stack_steps=None):
+                 stack_steps=None, prefetch_transform=None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or default_collate_fn
@@ -237,6 +244,7 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.shm_capacity = shm_capacity
         self.prefetch_to_device = prefetch_to_device
+        self.prefetch_transform = prefetch_transform
         if stack_steps is not None and int(stack_steps) < 1:
             raise ValueError(f"stack_steps must be >= 1, got {stack_steps}")
         self.stack_steps = int(stack_steps) if stack_steps else None
@@ -270,7 +278,7 @@ class DataLoader:
         it = self._base_iter()
         if self.stack_steps:
             it = self._stack_iter(it)
-        if self.prefetch_to_device:
+        if self.prefetch_transform is not None or self.prefetch_to_device:
             it = self._device_prefetch_iter(it)
         return it
 
@@ -295,12 +303,17 @@ class DataLoader:
                 group = []
 
     def _device_prefetch_iter(self, it):
-        """Double-buffer device stage: issue the next batch's async
-        ``device_put`` before handing out the current one, so transfer
-        overlaps the consumer's compute."""
+        """Double-buffer device stage: run ``prefetch_transform`` and
+        issue the next batch's async ``device_put`` before handing out
+        the current one, so the transform's side effects (e.g. a cache
+        prefetch submit) and the transfer overlap the consumer's
+        compute."""
         pending = None
         for batch in it:
-            placed = _device_put_batch(batch)
+            if self.prefetch_transform is not None:
+                batch = self.prefetch_transform(batch)
+            placed = _device_put_batch(batch) if self.prefetch_to_device \
+                else batch
             if pending is not None:
                 yield pending
             pending = placed

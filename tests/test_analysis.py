@@ -441,8 +441,8 @@ class TestLadderAndCLI:
         fs, summary = analysis.ladder.verify_ladder()
         assert fs == []
         assert set(summary) == {"resnet", "gpt", "bert", "detection",
-                                "hbm_cache", "serving", "allreduce",
-                                "zero1", "zero3"}
+                                "hbm_cache", "ctr", "serving",
+                                "allreduce", "zero1", "zero3"}
 
     def test_cli_source_mode(self):
         r = subprocess.run(

@@ -1,0 +1,608 @@
+"""Async pipelined HBM embedding cache (ISSUE 9): the prefetch pipeline
+(CachePrefetcher/WindowPlan), the bounded background write-back queue
+(coalescing, backpressure, chaos kill + exactly-once restart), the
+telemetry-driven adaptive eviction watermark, and the CTR acceptance —
+cached scan-window training bitwise-equal with prefetch on/off and at
+loss parity with the uncached per-batch PS path.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor, nn
+from paddle_tpu.distributed import ps
+from paddle_tpu.distributed.ps import (CachePrefetcher, HbmEmbeddingCache,
+                                       PsClient, PsServer, TableConfig,
+                                       WriteBackQueue)
+from paddle_tpu.distributed.ps.communicator import SyncCommunicator
+from paddle_tpu.distributed.ps.embedding import (deterministic_init,
+                                                 flush_sparse_grads,
+                                                 reset_registry)
+from paddle_tpu.models.ctr import (WideAndDeep, synthetic_ctr_batches,
+                                   train_ctr_windows)
+from paddle_tpu.testing import faults
+
+DIM = 4
+
+
+def _start_server(tables):
+    srv = PsServer(tables, port=0)
+    port = srv.start()
+    cli = PsClient([f"127.0.0.1:{port}"])
+    return srv, cli
+
+
+def _sparse_setup(capacity, table_id=1000, lr=0.1, writeback=None):
+    srv, cli = _start_server(
+        [TableConfig(table_id, "sparse", DIM, "sgd", lr=lr,
+                     init_range=0.1, seed=table_id)])
+    cli.register_sparse(table_id, DIM)
+    cache = HbmEmbeddingCache(cli, table_id, DIM, capacity,
+                              optimizer="sgd", lr=lr, writeback=writeback)
+    return srv, cli, cache
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class _FakeClient:
+    """Recording PsClient stand-in for WriteBackQueue unit tests; an
+    optional gate blocks push_sparse_delta so producers can observe
+    backpressure deterministically."""
+
+    def __init__(self, gate=None, fail_times=0):
+        self.pushes = []          # (table, keys, deltas) as pushed
+        self.gate = gate
+        self.fail_times = fail_times
+        self._mu = threading.Lock()
+
+    def push_sparse_delta(self, table, keys, deltas):
+        if self.gate is not None:
+            self.gate.wait()
+        with self._mu:
+            if self.fail_times > 0:
+                self.fail_times -= 1
+                raise ConnectionError("injected push failure")
+            self.pushes.append((table, np.array(keys, copy=True),
+                                np.array(deltas, copy=True)))
+
+
+class TestWriteBackQueue:
+    def test_coalesces_duplicate_keys_by_summation(self):
+        gate = threading.Event()
+        cli = _FakeClient(gate=gate)
+        wb = WriteBackQueue(cli, range_bits=32)
+        try:
+            # wedge the worker on a sacrificial batch so the two real
+            # batches are guaranteed to be taken TOGETHER (coalesced)
+            wb.put(9, [0], np.zeros((1, DIM), np.float32))
+            deadline = time.monotonic() + 10
+            while not wb._inflight and time.monotonic() < deadline:
+                time.sleep(0.005)
+            wb.put(7, [1, 2], np.ones((2, DIM), np.float32))
+            wb.put(7, [2, 3], 2 * np.ones((2, DIM), np.float32))
+            gate.set()
+            wb.flush()
+        finally:
+            gate.set()
+            wb.stop(flush=False)
+        merged = {}
+        for t, keys, deltas in cli.pushes:
+            if t != 7:
+                continue
+            for k, d in zip(keys.tolist(), deltas):
+                # exactly-once per key across every wire push
+                assert k not in merged
+                merged[k] = d
+        np.testing.assert_array_equal(merged[1], np.ones(DIM))
+        np.testing.assert_array_equal(merged[2], 3 * np.ones(DIM))
+        np.testing.assert_array_equal(merged[3], 2 * np.ones(DIM))
+        assert wb.pushed_rows == 5 and wb.coalesced_rows == 1
+
+    def test_key_range_split_and_row_cap(self):
+        cli = _FakeClient()
+        # range_bits=2 -> ranges of 4 keys; cap 3 rows per wire push
+        wb = WriteBackQueue(cli, range_bits=2, max_rows_per_push=3)
+        try:
+            keys = np.array([0, 1, 2, 3, 4, 5, 100], np.uint64)
+            wb.put(1, keys, np.ones((keys.size, DIM), np.float32))
+            wb.flush()
+        finally:
+            wb.stop(flush=False)
+        for _t, k, _d in cli.pushes:
+            assert k.size <= 3
+            assert np.unique(k >> np.uint64(2)).size == 1  # one range each
+        got = np.sort(np.concatenate([k for _t, k, _d in cli.pushes]))
+        np.testing.assert_array_equal(got, keys)
+
+    def test_backpressure_blocks_put_at_high_watermark(self):
+        gate = threading.Event()
+        cli = _FakeClient(gate=gate)
+        wb = WriteBackQueue(cli, max_pending_rows=8)
+        monitor.stat_reset("hbm_writeback_backpressure")
+        try:
+            wb.put(1, np.arange(8, dtype=np.uint64),
+                   np.ones((8, DIM), np.float32))
+            # worker is now wedged in push (gate closed); the next put
+            # would exceed the watermark -> must BLOCK, not grow memory
+            done = threading.Event()
+
+            def producer():
+                wb.put(1, np.arange(8, 12, dtype=np.uint64),
+                       np.ones((4, DIM), np.float32))
+                done.set()
+
+            th = threading.Thread(target=producer, daemon=True)
+            th.start()
+            assert not done.wait(timeout=1.0), \
+                "put returned while the queue sat at its watermark"
+            assert monitor.stat_get("hbm_writeback_backpressure") >= 1
+            assert wb.pending_rows <= 12  # enqueued + in-flight, bounded
+            gate.set()
+            assert done.wait(timeout=10.0)
+            wb.flush()
+        finally:
+            gate.set()
+            wb.stop(flush=False)
+        assert wb.pushed_rows == 12
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_worker_death_requeues_then_restart_pushes_once(self):
+        cli = _FakeClient(fail_times=1)
+        wb = WriteBackQueue(cli)
+        try:
+            wb.put(1, [5], np.ones((1, DIM), np.float32))
+            deadline = time.monotonic() + 10
+            while wb._error is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert wb._error is not None
+            # nothing lost: the batch is requeued, put/flush surface it
+            assert wb.pending_rows == 1
+            with pytest.raises(RuntimeError, match="restart"):
+                wb.put(1, [6], np.ones((1, DIM), np.float32))
+            with pytest.raises(RuntimeError, match="restart"):
+                wb.flush()
+            wb.restart()
+            wb.flush()
+        finally:
+            wb.stop(flush=False)
+        assert len(cli.pushes) == 1 and wb.pushed_rows == 1
+
+    def test_put_after_stop_raises_and_restart_revives(self):
+        cli = _FakeClient()
+        wb = WriteBackQueue(cli)
+        wb.stop()
+        # no worker will drain a stopped queue — enqueueing silently
+        # would strand the deltas until flush() times out
+        with pytest.raises(RuntimeError, match="stopped"):
+            wb.put(1, [1], np.ones((1, DIM), np.float32))
+        wb.restart()  # clears the stop flag too, not just errors
+        try:
+            wb.put(1, [1], np.ones((1, DIM), np.float32))
+            wb.flush()
+        finally:
+            wb.stop(flush=False)
+        assert wb.pushed_rows == 1 and len(cli.pushes) == 1
+
+    def test_has_pending_is_read_your_writes_signal(self):
+        gate = threading.Event()
+        cli = _FakeClient(gate=gate)
+        wb = WriteBackQueue(cli)
+        try:
+            wb.put(3, [10, 11], np.ones((2, DIM), np.float32))
+            assert wb.has_pending(3, [11])
+            assert not wb.has_pending(3, [12])
+            assert not wb.has_pending(4, [11])  # other table
+            gate.set()
+            wb.flush()
+            assert not wb.has_pending(3, [11])
+        finally:
+            gate.set()
+            wb.stop(flush=False)
+
+
+class TestWriteBackChaos:
+    """ISSUE 9 satellite: a kill inside the write-back thread must leave
+    a flight-recorder dump, lose no delta, and — thanks to the PR-7
+    request-id dedup — apply each delta exactly once after restart."""
+
+    @pytest.fixture(autouse=True)
+    def _flight(self, tmp_path):
+        import paddle_tpu.observability as obs
+        from paddle_tpu import profiler
+        from paddle_tpu.observability import flight
+        profiler.reset()
+        flight.clear()
+        obs.enable()
+        flight.install(str(tmp_path / "flight"))
+        yield flight
+        obs.disable()
+        flight.uninstall()
+        flight.clear()
+        profiler.reset()
+
+    @pytest.mark.chaos
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_kill_dumps_and_restart_applies_exactly_once(self, _flight):
+        srv, cli, cache = _sparse_setup(capacity=16)
+        wb = WriteBackQueue(cli)
+        try:
+            keys = np.array([2, 4], np.uint64)
+            before = cli.pull_sparse(1000, keys)
+            faults.inject("ps/writeback", times=1)
+            delta = np.full((2, DIM), 0.5, np.float32)
+            wb.put(1000, keys, delta)
+            deadline = time.monotonic() + 10
+            while wb._error is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert isinstance(wb._error, faults.FaultInjected)
+            wb._thread.join(timeout=10)  # let the excepthook dump land
+            # no delta reached the wire, none was dropped
+            assert wb.pending_rows == 2
+            np.testing.assert_array_equal(
+                cli.pull_sparse(1000, keys), before)
+            # the armed flight recorder dumped TWICE: at the kill site
+            # (before the exception unwound) and again when the worker
+            # thread died with it unhandled
+            import os
+            d = os.path.dirname(_flight.latest_dump())
+            recs = [json.load(open(os.path.join(d, f)))
+                    for f in sorted(os.listdir(d)) if f.endswith(".json")]
+            kp = [r for r in recs if r["reason"] == "kill_point"]
+            assert kp and kp[-1]["kill_point"] == "ps/writeback"
+            assert kp[-1]["spans"][-1]["name"] == "fault/ps/writeback"
+            assert kp[-1]["faults"]["fired"]["ps/writeback"] == 1
+            th = [r for r in recs
+                  if r["reason"] == "unhandled_thread_exception"]
+            assert th and th[-1]["exception"]["type"] == "FaultInjected"
+            assert th[-1]["thread"] == "hbm-cache-writeback"
+            # restart: the requeued batch pushes; exactly one apply
+            wb.restart()
+            wb.flush()
+            np.testing.assert_allclose(
+                cli.pull_sparse(1000, keys), before + 0.5,
+                rtol=1e-6, atol=1e-7)
+        finally:
+            wb.stop(flush=False)
+            cli.stop_servers()
+            srv.stop()
+
+
+class TestPrefetcher:
+    def test_plans_in_order_while_consumer_computes(self):
+        srv, cli, cache = _sparse_setup(capacity=64)
+        pf = CachePrefetcher(cache, depth=2, bucket=8)
+        try:
+            wins = [np.arange(i * 8, i * 8 + 8, dtype=np.int64)
+                    .reshape(2, 4) for i in range(3)]
+            for w in wins:
+                pf.submit(w)
+            mirror = deterministic_init(
+                1000, np.arange(64, dtype=np.uint64), DIM, 0.1)
+            for w in wins:
+                plan = pf.take()
+                slots_t, inv_t = plan.feeds()
+                slots = np.asarray(slots_t.numpy())   # [k, W]
+                inv = np.asarray(inv_t.numpy())       # [k, 2, 4] -> flat
+                tbl = np.asarray(cache.table)
+                got = np.stack(
+                    [tbl[slots[i]][inv[i].reshape(-1)].reshape(4, DIM)
+                     for i in range(2)])
+                np.testing.assert_allclose(got, mirror[w], rtol=1e-5,
+                                           atol=1e-7)
+                cache.drain_window(plan)
+            assert pf.windows == 3 and pf.pull_s > 0.0
+            assert 0.0 <= pf.overlap_efficiency() <= 1.0
+        finally:
+            pf.close()
+            cli.stop_servers()
+            srv.stop()
+
+    def test_planner_error_surfaces_on_take_then_submit(self):
+        # window working set (9 uniques) larger than capacity-1 rows
+        srv, cli, cache = _sparse_setup(capacity=8)
+        pf = CachePrefetcher(cache, depth=1)
+        try:
+            pf.submit(np.arange(9, dtype=np.int64).reshape(1, 9))
+            with pytest.raises(RuntimeError, match="prefetcher failed"):
+                pf.take(timeout=10)
+            with pytest.raises(RuntimeError, match="prefetcher failed"):
+                pf.submit(np.zeros((1, 1), np.int64))
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
+    def test_close_releases_unconsumed_plans_and_blocked_worker(self):
+        # the consumer abandons the pipeline with the worker BLOCKED on
+        # the full depth-bounded output queue; close() must drain it so
+        # the join can't stall, and every unconsumed plan's eviction
+        # pins must drop with it
+        srv, cli, cache = _sparse_setup(capacity=64)
+        pf = CachePrefetcher(cache, depth=1)
+        try:
+            for i in range(3):
+                pf.submit(np.arange(i * 4, i * 4 + 4, dtype=np.int64)
+                          .reshape(1, 4))
+            # wait for the worker to finish plan 1 -> it is now blocked
+            # putting it (plan 0 already fills the depth-1 queue)
+            deadline = time.monotonic() + 10
+            while pf.windows < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert pf.windows >= 2
+            t0 = time.monotonic()
+            pf.close()
+            assert time.monotonic() - t0 < 15, \
+                "close() sat out the join timeout on a blocked worker"
+            assert not pf._thread.is_alive()
+            assert not cache._plan_pins
+            with pytest.raises(RuntimeError, match="closed"):
+                pf.take(timeout=1)
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
+    def test_window_pins_block_eviction_until_release(self):
+        # capacity 9 = scratch + 8 rows; a planned-but-unconsumed window
+        # owns 4 of them and must survive later faulting pressure
+        srv, cli, cache = _sparse_setup(capacity=9)
+        try:
+            plan = cache.plan_window(
+                np.array([[1, 2, 3, 4]], np.int64), bucket=4)
+            out = cache.lookup(paddle.to_tensor(
+                np.array([[10, 11, 12, 13]], np.int64)))
+            del out
+            # the four new keys evicted nothing pinned
+            assert {1, 2, 3, 4} <= set(cache._slots)
+            # demanding 5 more slots than the unpinned pool can yield
+            # fails LOUDLY instead of stealing the planned window's rows
+            with pytest.raises(RuntimeError, match="pinned"):
+                cache.lookup(paddle.to_tensor(
+                    np.array([[20, 21, 22, 23, 24]], np.int64)))
+            # the FAILED eviction left every candidate resident
+            assert {1, 2, 3, 4, 10, 11, 12, 13} <= set(cache._slots)
+            plan.release()
+            # released pins free exactly the planned window's 4 rows
+            # (10..13 still hold un-applied grads and stay protected)
+            out = cache.lookup(paddle.to_tensor(
+                np.array([[20, 21, 22, 23]], np.int64)))
+            assert cache.stats["evict"] >= 4
+            assert not ({1, 2, 3, 4} & set(cache._slots))
+            assert {10, 11, 12, 13} <= set(cache._slots)
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
+
+class TestDeferredEvictResurrection:
+    """A dirty key deferred-evicted by one plan and re-planned before
+    the flush must NOT be re-pulled from the PS (the server has not
+    seen its delta yet): its still-intact device rows relocate to the
+    new slot and the un-pushed delta rides along (read-your-writes on
+    the planner path, where WriteBackQueue.has_pending can't see the
+    parked delta)."""
+
+    def test_replanned_dirty_key_keeps_local_training(self):
+        # capacity 7 = scratch + 6 usable rows
+        srv, cli, cache = _sparse_setup(capacity=7)
+        try:
+            # train keys 1, 2 -> two dirty resident rows (delta -0.1)
+            out = cache.lookup(paddle.to_tensor(
+                np.array([[1, 2]], np.int64)))
+            paddle.ops.sum(out).backward()
+            cache.apply_grads()
+            # plan1: 5 misses onto 4 free slots -> the planner defers
+            # the eviction of dirty key 1 (LRU front); its old slot is
+            # handed straight to one of plan1's pending installs
+            plan1 = cache.plan_window(
+                np.array([[3, 4, 5, 6, 7]], np.int64), bucket=8)
+            assert 1 not in cache._slots and cache._pending_evict
+            # plan2 re-plans key 1 BEFORE any flush: resurrection. Its
+            # new slot comes from deferred-evicting dirty key 2 — the
+            # copy's destination is another deferred victim's freed
+            # slot, so the flush MUST order deltas -> copies -> installs
+            plan2 = cache.plan_window(np.array([[1]], np.int64),
+                                      bucket=2)
+            assert 1 in cache._slots and cache._pending_copy
+            plan2.feeds()  # one flush applies all three stages
+            assert not cache._pending_copy
+            assert not cache._pending_install_slots
+            mirror = deterministic_init(
+                1000, np.arange(8, dtype=np.uint64), DIM, 0.1)
+            # key 1's row is its TRAINED value, not the stale server
+            # value a re-pull would have installed
+            s1 = cache._slots[1]
+            np.testing.assert_allclose(np.asarray(cache.table)[s1],
+                                       mirror[1] - 0.1, rtol=1e-5)
+            # key 2's delta went out with the flush (sync path) ...
+            np.testing.assert_allclose(
+                cli.pull_sparse(1000, np.array([2], np.uint64))[0],
+                mirror[2] - 0.1, rtol=1e-5)
+            # ... and key 1 is STILL dirty: end_pass pushes its delta
+            # exactly once — server equals device afterwards
+            cache.end_pass()
+            np.testing.assert_allclose(
+                cli.pull_sparse(1000, np.array([1], np.uint64))[0],
+                mirror[1] - 0.1, rtol=1e-5)
+            plan1.release()
+            plan2.release()
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
+
+class TestAdaptiveWatermark:
+    def test_free_target_tracks_latency_and_miss_pressure(self):
+        srv, cli, cache = _sparse_setup(capacity=100)
+        try:
+            cache.watermark_min_frac, cache.watermark_max_frac = 0.0, 0.2
+            # no history yet -> lazy floor
+            assert cache.free_target() == 0
+            # cheap loopback pulls -> stay lazy even under misses
+            cache._pull_ms_ema = 0.05
+            cache._hit_ema, cache._miss_ema = 50.0, 50.0
+            assert cache.free_target() == 0
+            # expensive pulls + real miss pressure -> evict ahead, hard
+            cache._pull_ms_ema = 50.0
+            assert cache.free_target() == 20
+            # expensive pulls but the working set fits (no misses) ->
+            # nothing to prepare for
+            cache._hit_ema, cache._miss_ema = 100.0, 0.0
+            assert cache.free_target() == 0
+            # mid latency, mid pressure -> between the bounds
+            cache._pull_ms_ema = 1.0
+            cache._hit_ema, cache._miss_ema = 90.0, 10.0
+            assert 0 < cache.free_target() <= 20
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
+    def test_evict_ahead_frees_dirty_rows_through_writeback(self):
+        monitor.stat_reset("hbm_cache_evict")
+        srv, cli, _ = _sparse_setup(capacity=17)
+        wb = WriteBackQueue(cli)
+        cache = HbmEmbeddingCache(cli, 1000, DIM, 17, optimizer="sgd",
+                                  lr=0.1, writeback=wb,
+                                  watermark=(0.0, 0.5))
+        try:
+            ids = np.arange(16, dtype=np.int64).reshape(1, 16)
+            out = cache.lookup(paddle.to_tensor(ids))
+            paddle.ops.sum(out).backward()
+            cache.apply_grads()  # 16 dirty resident rows, 0 free
+            assert len(cache._free) == 0
+            # simulate an expensive PS under miss pressure
+            cache._pull_ms_ema = 50.0
+            cache._hit_ema, cache._miss_ema = 50.0, 50.0
+            target = cache.free_target()
+            assert target == 8  # 0.5 * 17 rounded down
+            freed = cache.evict_ahead()
+            assert freed == 8 and len(cache._free) >= target
+            # victims' trained deltas went through the background queue
+            wb.flush()
+            mirror = deterministic_init(
+                1000, np.arange(16, dtype=np.uint64), DIM, 0.1)
+            evicted = [k for k in range(16) if k not in cache._slots]
+            assert len(evicted) == 8
+            got = cli.pull_sparse(1000, np.asarray(evicted, np.uint64))
+            np.testing.assert_allclose(got, mirror[evicted] - 0.1,
+                                       rtol=1e-5)
+            # lazy regime: a cheap PS stops the ahead-of-time eviction
+            cache._pull_ms_ema = 0.01
+            assert cache.evict_ahead() == 0
+        finally:
+            wb.stop(flush=False)
+            cli.stop_servers()
+            srv.stop()
+
+
+class TestCtrPipelineParity:
+    """ISSUE 9 acceptance: cached CTR training at loss parity with the
+    uncached PS path — bitwise with prefetch disabled, ≤1e-6 final-loss
+    delta with the async pipeline on."""
+
+    K, NB, BATCH, SLOTS, VOCAB, EDIM = 4, 16, 64, 4, 2000, 8
+
+    def _setup(self, cached, writeback=None):
+        reset_registry()
+        paddle.seed(0)
+        tables = [TableConfig(1000, "sparse", self.EDIM, "sgd", lr=0.05,
+                              init_range=0.05, seed=1000),
+                  TableConfig(1001, "sparse", 1, "sgd", lr=0.05,
+                              init_range=0.05, seed=1001)]
+        srv, cli = _start_server(tables)
+        model = WideAndDeep(self.VOCAB, dim=self.EDIM, slots=self.SLOTS,
+                            hidden=(16,), cached=cached, capacity=1 << 10,
+                            optimizer="sgd", lr=0.05, writeback=writeback)
+        comm = SyncCommunicator(cli, n_workers=1)
+        ps.bind_model(model, comm)
+        opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                    learning_rate=0.001)
+        batches = synthetic_ctr_batches(self.NB, batch_size=self.BATCH,
+                                        slots=self.SLOTS,
+                                        vocab=self.VOCAB, seed=3)
+        return srv, cli, model, comm, opt, batches
+
+    def _run_cached(self, prefetch, use_writeback=True):
+        srv, cli, model, comm, opt, batches = self._setup(True)
+        wb = WriteBackQueue(cli) if use_writeback else None
+        if wb is not None:
+            for c in model.caches():
+                c.writeback = wb
+        try:
+            r = train_ctr_windows(model, opt, batches, k=self.K,
+                                  prefetch=prefetch, flush=True)
+            return np.asarray(r["losses"]), r
+        finally:
+            if wb is not None:
+                wb.stop(flush=False)
+            cli.stop_servers()
+            srv.stop()
+
+    def _run_uncached_window(self):
+        """The uncached PS baseline with the SAME window structure the
+        scan pipeline trains under: per-batch pulls read the server rows
+        as of the last window boundary, per-step sparse grads defer and
+        push once per window (sgd is linear — the deferred sum IS the
+        sequential result), dense params step eagerly."""
+        srv, cli, model, comm, opt, batches = self._setup(False)
+        try:
+            losses = []
+            for w in range(self.NB // self.K):
+                for i in range(self.K):
+                    ids, label = batches[w * self.K + i]
+                    logit = model(paddle.to_tensor(ids))
+                    loss = nn.functional.binary_cross_entropy_with_logits(
+                        logit, paddle.to_tensor(label))
+                    loss.backward()
+                    flush_sparse_grads(comm)
+                    opt.step()
+                    opt.clear_grad()
+                    losses.append(float(loss.numpy()))
+                for table_id, keys, grads in comm._sparse_push:
+                    cli.push_sparse_grad(table_id, keys, grads)
+                comm._sparse_push.clear()
+            return np.asarray(losses)
+        finally:
+            cli.stop_servers()
+            srv.stop()
+
+    def test_prefetch_on_equals_off_bitwise_and_learns(self):
+        on, r_on = self._run_cached(prefetch=True)
+        off, r_off = self._run_cached(prefetch=False)
+        np.testing.assert_array_equal(on, off)
+        assert np.mean(on[-self.K:]) < np.mean(on[:self.K])
+        assert r_off["overlap_efficiency"] == 0.0
+        assert 0.0 <= r_on["overlap_efficiency"] <= 1.0
+
+    def test_cached_pipeline_matches_uncached_ps_path(self):
+        cached, _ = self._run_cached(prefetch=True)
+        uncached = self._run_uncached_window()
+        assert abs(cached[-1] - uncached[-1]) <= 1e-6
+        np.testing.assert_allclose(cached, uncached, atol=1e-6)
+
+    def test_scan_step_program_verifies_clean(self):
+        """The compiled CTR window program passes the analysis verifier
+        (tentpole contract: scan-integrated cache lookups are legal,
+        shape-stable, hazard-free programs)."""
+        from paddle_tpu import analysis
+        from paddle_tpu.models.ctr import build_ctr_scan_step
+
+        srv, cli, model, comm, opt, batches = self._setup(True)
+        try:
+            step = build_ctr_scan_step(model, opt, self.K)
+            r = train_ctr_windows(model, opt, batches[:2 * self.K],
+                                  k=self.K, prefetch=False, step=step)
+            assert len(r["losses"]) == 2 * self.K
+            assert analysis.errors(step.verify()) == []
+        finally:
+            cli.stop_servers()
+            srv.stop()

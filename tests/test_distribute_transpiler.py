@@ -295,6 +295,8 @@ if wid == 0:
 
 
 class TestTwoTrainerCluster:
+    @pytest.mark.slow  # ~20 s two-process cluster; the transpiled
+    # program's numerics stay tier-1-covered by the loss-parity cases
     def test_two_sync_trainers_converge(self):
         """2 trainer processes x 1 pserver: sync-mode transpiled training
         runs the push/2 + barrier + pull protocol across real processes

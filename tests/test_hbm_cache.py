@@ -388,8 +388,13 @@ class TestPsTpuTrainerPass:
             r2 = trainer.train_pass(_batches(10))
             assert trainer.caches[0].stats["miss"] == 0
             assert trainer.caches[0].stats["hit"] > 0
-            assert (r1["losses"][-1] < r1["losses"][0]
-                    or r2["losses"][-1] < r1["losses"][0])
+            # warm-cache pass 2 must continue training from pass 1's
+            # trained rows: compare PASS MEANS, not two single-batch
+            # endpoint losses — after 20 barely-moving sgd steps the
+            # endpoints are dominated by per-batch noise and flip on
+            # init numerics (the long-standing tier-1 environment
+            # flake); the 10-batch means decrease for every init
+            assert np.mean(r2["losses"]) < np.mean(r1["losses"])
             # write-back happened: server sees trained values
             slot_of = trainer.caches[0]._slots
             some_key = next(iter(slot_of))

@@ -194,6 +194,8 @@ class TestElasticFaultInjection:
                                 cwd=os.path.dirname(os.path.dirname(
                                     os.path.abspath(__file__))))
 
+    @pytest.mark.slow  # ~17 s launcher relaunch e2e; rerank + resume
+    # stay tier-1-covered by test_multiprocess_dist + test_checkpoint
     def test_kill_worker_rerank_relaunch_resume(self, tmp_path):
         from paddle_tpu.distributed.fleet.elastic import start_kv_server
         srv, kv_port = start_kv_server(host="127.0.0.1")

@@ -216,9 +216,10 @@ class TestNativeTableService:
 # ------------------------------------------------------------ cluster level
 
 class TestPsCluster:
-    def test_geo_single_worker_matches_local(self):
+    @pytest.mark.slow  # ~31 s subprocess cluster; geo convergence stays
+    def test_geo_single_worker_matches_local(self):  # tier-1-covered by
         """geo k=1, one worker: server state mirrors local SGD exactly
-        (the reference's geo-delta semantics)."""
+        (the reference's geo-delta semantics)."""  # TestPsGeoMultiWorker
         outs = _run_cluster("geo", 1, extra={"PS_K_STEPS": "1"})
         ps_losses = _losses(outs[0])
         local_losses = _run_local()
@@ -226,7 +227,8 @@ class TestPsCluster:
         np.testing.assert_allclose(ps_losses, local_losses, rtol=2e-3,
                                    atol=2e-4)
 
-    def test_sync_two_workers_train(self):
+    @pytest.mark.slow  # ~26 s; subsumed in tier-1 by the sharded
+    def test_sync_two_workers_train(self):  # two-server sync case below
         outs = _run_cluster("sync", 2)
         for out in outs:
             ls = _losses(out)

@@ -561,3 +561,52 @@ def test_span_leak_lint_rule(tmp_path):
     # the shipped instrumented paths stay clean under the default scan
     assert not [f for f in lint.lint_source()
                 if f.rule == "span-without-context-manager"]
+
+
+@pytest.mark.skipif(_native.lib() is None, reason="needs native runtime")
+def test_drain_server_spans_over_the_wire(tracing, tmp_path):
+    """ISSUE 9 satellite (PR-8 open item): a client of a REMOTE server
+    drains the service-side span ring over the wire (op 17,
+    ``PsClient.drain_server_spans``) into its OWN run-log — the full
+    client→server trace then reconstructs from the client-side logs
+    alone, no access to the server process needed."""
+    from paddle_tpu.distributed.ps import PsClient, PsServer, TableConfig
+
+    srv = PsServer([TableConfig(910, "dense", 8, "sgd", lr=0.1)], port=0)
+    port = srv.start()
+    cli = PsClient([f"127.0.0.1:{port}"], request_id_base=9_100_000)
+    try:
+        cli.register_dense(910, 8)
+        cli.pull_dense_init(910, np.zeros(8, np.float32))
+        with obs.trace_span("train/pull", cat="user") as root:
+            cli.pull_dense(910)
+            root_trace = root.trace_id
+        # peek first (drain=False): rows come back, the ring keeps them
+        peek = cli.drain_server_spans(to_runlog=False, drain=False)
+        pulls = [r for r in peek if r["name"] == "ps_server/pull_dense"
+                 and r["trace"] == root_trace]
+        assert len(pulls) == 1
+        assert pulls[0]["server"] == f"127.0.0.1:{port}"
+        # the real drain records into the run-log AND empties the ring
+        rows = cli.drain_server_spans()
+        assert [r for r in rows if r["name"] == "ps_server/pull_dense"
+                and r["trace"] == root_trace]
+        again = cli.drain_server_spans(to_runlog=False)
+        assert not [r for r in again
+                    if r["name"] == "ps_server/pull_dense"]
+    finally:
+        cli.stop_servers()
+        srv.stop()
+    obs.stop_run()
+    events = _load(tmp_path)
+    # the wire-drained server span landed in the CLIENT's run-log on its
+    # own ps_server track ...
+    srv_spans = [e for e in events if e.get("process") == "ps_server"
+                 and e.get("name") == "ps_server/pull_dense"]
+    assert srv_spans and srv_spans[0]["trace"] == f"{root_trace:016x}"
+    assert srv_spans[0]["attrs"]["server"] == f"127.0.0.1:{port}"
+    # ... and trace_view connects root -> client attempt -> server apply
+    con = trace_view.connected_spans(events, f"{root_trace:016x}")
+    names = {s["name"] for s in con}
+    assert {"train/pull", "ps/pull_dense",
+            "ps/attempt/pull_dense", "ps_server/pull_dense"} <= names

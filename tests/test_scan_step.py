@@ -65,6 +65,8 @@ def test_scan_matches_unrolled_linear():
     assert len(sstep._last_partition["donated"]) >= 6
 
 
+@pytest.mark.slow  # ~22 s (the k=2 UNROLL compile dominates); scan
+# equivalence itself is tier-1-covered at toy scale in this file
 def test_scan_matches_unrolled_bert_cpu_small():
     """Acceptance: scan-vs-unrolled loss equivalence on the CPU-small
     BERT config (k=2, same seed, allclose) — the bench.py program

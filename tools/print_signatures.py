@@ -31,6 +31,7 @@ MODULES = [
     "paddle_tpu.linalg",
     "paddle_tpu.vision.models",
     "paddle_tpu.vision.transforms",
+    "paddle_tpu.models",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.distributed.ps",
