@@ -86,26 +86,49 @@ def bench_resnet50():
             "backend": backend, "batch": bs}
 
 
-def _run_json_subprocess(cmd, what, env=None, timeout=1800):
+def _run_json_subprocess(cmd, what, env=None, timeout=1800,
+                         all_records=False):
     """Run a bench subprocess and parse the LAST JSON line it prints
-    (both bench.py and this ladder emit one record per line on stdout)."""
+    (both bench.py and this ladder emit one record per line on stdout);
+    ``all_records`` returns EVERY JSON line instead (multi-row benches)
+    and refuses a non-zero exit — a crashed child may still have
+    printed SOME records, and partial output must not pass as a
+    successful multi-row bench."""
     import subprocess
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     r = subprocess.run(cmd, capture_output=True, text=True, cwd=repo,
                        timeout=timeout, env=env)
-    for line in reversed(r.stdout.splitlines()):
+    if all_records and r.returncode != 0:
+        raise RuntimeError(
+            f"{what} failed (rc={r.returncode}): "
+            f"{(r.stderr or r.stdout)[-300:]}")
+    records = []
+    bad_last = False
+    for line in r.stdout.splitlines():
         line = line.strip()
         if line.startswith("{"):
-            return json.loads(line)
+            try:
+                records.append(json.loads(line))
+                bad_last = False
+            except json.JSONDecodeError:
+                bad_last = True  # stray log line — or a truncated record
+    if records and not (bad_last and not all_records):
+        # single-record mode must NOT skip an unparseable FINAL line: a
+        # child killed mid-write of its last record would otherwise pass
+        # a stale intermediate record as the bench result (all_records
+        # mode catches that crash through the returncode check above)
+        return records if all_records else records[-1]
     raise RuntimeError(
-        f"{what} produced no JSON record (rc={r.returncode}): "
+        f"{what} produced no usable JSON record (rc={r.returncode}): "
         f"{(r.stderr or r.stdout)[-300:]}")
 
 
-def _reexec_bench(name, n_virtual):
+def _reexec_bench(name, n_virtual, all_records=False):
     """Run one bench in a subprocess with a virtual n-device CPU mesh
     (XLA's host device count is fixed at backend init, so the flag can't
-    be applied in-process once jax is up)."""
+    be applied in-process once jax is up). ``all_records`` collects
+    EVERY JSON line the bench prints (multi-row benches) instead of the
+    last one."""
     env = dict(os.environ)
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                         f" --xla_force_host_platform_device_count="
@@ -113,7 +136,8 @@ def _reexec_bench(name, n_virtual):
     env["JAX_PLATFORMS"] = "cpu"
     return _run_json_subprocess(
         [sys.executable, os.path.abspath(__file__), "--configs", name],
-        f"virtual-mesh re-exec of bench {name!r}", env=env)
+        f"virtual-mesh re-exec of bench {name!r}", env=env,
+        all_records=all_records)
 
 
 def bench_gpt_sharding_pp(n_virtual=8):
@@ -617,6 +641,86 @@ def bench_tracing_overhead():
             "disabled = shared null span (guard-only)"}
 
 
+def bench_memory(n_virtual=8):
+    """HBM memory accounting rows (observability.memory): compiled-step
+    XLA attribution peak + per-rank state residency of a ZeRO-3 scan
+    step on the 8-device mesh. Byte accounting is backend-deterministic
+    (unlike wall time), so these rows VALUE-gate even between CPU runs
+    — direction pinned lower-is-better: more bytes is a regression."""
+    import jax
+    if jax.device_count() < n_virtual:
+        if jax.default_backend() == "cpu":
+            return _reexec_bench("memory", n_virtual, all_records=True)
+        return [{"metric": m, "value": -1.0, "unit": "MB",
+                 "direction": "lower", "backend": jax.default_backend(),
+                 "note": f"needs {n_virtual} devices (have "
+                         f"{jax.device_count()})"}
+                for m in ("mlp_zero3_scan_hbm_peak_mb",
+                          "mlp_zero3_state_resident_mb")]
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import parallel_env
+    from paddle_tpu.observability import memory
+
+    dp, k = n_virtual, 4
+    mesh = parallel_env.make_mesh({"dp": dp})
+    parallel_env.set_mesh(mesh)
+    try:
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                          nn.Linear(128, 32))
+        opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                     learning_rate=0.01)
+        opt._zero_enable(axis="dp", stage=3)
+
+        def one(x, y):
+            loss = nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        step = paddle.jit.to_static(one, scan_steps=k, dp_axis="dp")
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(k, 16, 64).astype("float32"))
+        y = paddle.to_tensor(rng.randint(0, 32, (k, 16)).astype("int64"))
+        step(x, y)
+        stats = next(iter(step.memory_stats().values()))
+        step.export_memory_stats()
+        ledger = memory.export_state_ledger()
+        # value-gate on THIS optimizer's flat stores walked directly —
+        # the global ledger total picks up whatever stateful tensors
+        # earlier in-process benches left alive, which would make a
+        # 10%-tolerance gate on a small pinned value nondeterministic;
+        # the ledger totals ride along as ungated metadata
+        model_state = 0
+        for sdict in opt._zero["stores"]:
+            for store in sdict.values():
+                _g, resident = memory.value_bytes(store.tensor._value)
+                model_state += resident
+        common = dict(backend=jax.default_backend(), unit="MB",
+                      direction="lower", dp=dp, k=k)
+        return [
+            {"metric": "mlp_zero3_scan_hbm_peak_mb",
+             "value": memory.mb(stats["peak_bytes"]),
+             "argument_mb": memory.mb(stats["argument_bytes"]),
+             "temp_mb": memory.mb(stats["temp_bytes"]),
+             "alias_mb": memory.mb(stats["alias_bytes"]),
+             "note": "XLA memory_analysis peak (arg+out+temp+code-alias) "
+             "of the compiled zero3 scan step", **common},
+            {"metric": "mlp_zero3_state_resident_mb",
+             "value": memory.mb(model_state),
+             "ledger_total_mb": memory.mb(ledger["total_bytes"]),
+             "ledger_global_mb": memory.mb(ledger["total_global_bytes"]),
+             "note": "per-rank resident zero3 model state (param + "
+             "moment flat stores walked directly; 1/dp of the "
+             "replicated layout); ledger totals ride as metadata",
+             **common},
+        ]
+    finally:
+        parallel_env.set_mesh(None)
+
+
 def bench_bert():
     """Config 3: the flagship BERT pretraining step — bench.py run as a
     subprocess (it owns program structure, OOM fallback and timing) with
@@ -632,7 +736,8 @@ BENCHES = {"resnet": bench_resnet50, "gpt": bench_gpt_sharding_pp,
            "allreduce": bench_allreduce, "detection": bench_detection,
            "hbm_cache": bench_hbm_cache, "ctr": bench_ctr,
            "serving": bench_serving, "checkpoint": bench_checkpoint,
-           "tracing_overhead": bench_tracing_overhead, "bert": bench_bert}
+           "tracing_overhead": bench_tracing_overhead,
+           "memory": bench_memory, "bert": bench_bert}
 
 
 def run_benches(configs):
@@ -667,7 +772,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="resnet,gpt,allreduce,detection,"
                     "hbm_cache,ctr,serving,checkpoint,tracing_overhead,"
-                    "bert")
+                    "memory,bert")
     ap.add_argument("--out", help="write the run's records as a JSON file")
     ap.add_argument("--results", help="gate a previously recorded results "
                     "JSON instead of running the ladder")

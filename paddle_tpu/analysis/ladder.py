@@ -12,7 +12,8 @@ pin a perf baseline while any of them fails verification — the ladder's
 timings are only meaningful for programs the verifier accepts.
 """
 
-__all__ = ["LADDER_BUILDERS", "build_ladder_programs", "verify_ladder"]
+__all__ = ["LADDER_BUILDERS", "build_ladder_programs", "verify_ladder",
+           "attribute_memory"]
 
 
 def _resnet_like():
@@ -290,13 +291,24 @@ def build_ladder_programs(configs=None):
     return {n: LADDER_BUILDERS[n]() for n in names}
 
 
-def verify_ladder(configs=None, mesh_axes=("dp",)):
-    """Run the full analyzer over every ladder program. Returns
+def verify_ladder(configs=None, mesh_axes=("dp",), memory=True,
+                  programs=None):
+    """Run the full analyzer over every ladder program — including
+    XLA memory attribution of each twin (``observability.memory
+    .attribute_program``): a twin whose executable yields no byte
+    accounting refuses the ladder exactly like a verify failure, so a
+    perf baseline is never pinned from programs the memory gate cannot
+    measure. ``programs`` takes pre-built ``{name: pairs}`` (from
+    :func:`build_ladder_programs`) so a caller running both this and
+    :func:`attribute_memory` builds the twins once. Returns
     ``(findings, summary)`` where summary maps config -> op counts per
     program. Clean = no findings at all."""
     from . import lint, verify
     from .collectives import check_collective_order
     from .dtype_check import check_dtypes
+    from .findings import ERROR, Finding
+    from ..observability.memory import (MemoryAttributionError,
+                                        attribute_program)
 
     findings = []
     summary = {}
@@ -306,13 +318,45 @@ def verify_ladder(configs=None, mesh_axes=("dp",)):
             f.message = f"[{config}] {f.message}"
             findings.append(f)
 
-    for name, pairs in build_ladder_programs(configs).items():
+    if programs is None:
+        programs = build_ladder_programs(configs)
+    for name, pairs in programs.items():
         summary[name] = [len(p.ops) for p, _t in pairs]
-        for prog, targets in pairs:
+        for pi, (prog, targets) in enumerate(pairs):
             _tag(name, verify(prog, targets=targets, mesh_axes=mesh_axes))
             _tag(name, check_dtypes(prog))
             _tag(name, lint(prog))
+            if memory:
+                try:
+                    attribute_program(prog, targets)
+                except MemoryAttributionError as e:
+                    _tag(name, [Finding(
+                        "memory-attribution-failed", ERROR,
+                        f"program {pi}: {e}")])
         if name in ("allreduce", "zero1", "zero3"):
             _tag(name, check_collective_order([p for p, _t in pairs],
                                               mesh_axes=mesh_axes))
     return findings, summary
+
+
+def attribute_memory(configs=None, programs=None):
+    """Memory attribution of every ladder twin: ``{config: [stats per
+    program]}`` (``tools/mem_view.py --ladder`` renders this; a failed
+    attribution surfaces as a stats dict with an ``"error"`` key so the
+    table still names the broken twin). ``programs`` takes pre-built
+    ``{name: pairs}`` to skip the rebuild."""
+    from ..observability.memory import MemoryAttributionError, \
+        attribute_program
+
+    out = {}
+    if programs is None:
+        programs = build_ladder_programs(configs)
+    for name, pairs in programs.items():
+        rows = []
+        for prog, targets in pairs:
+            try:
+                rows.append(attribute_program(prog, targets))
+            except MemoryAttributionError as e:
+                rows.append({"error": str(e)[:300]})
+        out[name] = rows
+    return out

@@ -20,6 +20,7 @@ class Generator:
     def __init__(self, seed=_DEFAULT_SEED):
         self._key_t = Tensor(jax.random.key_data(jax.random.PRNGKey(seed)))
         self._key_t.persistable = True
+        self._key_t._ledger_category = "rng"  # memory-ledger attribution
         self._key_t._mark_stateful()
         self._seed = seed
 

@@ -548,6 +548,7 @@ class HbmEmbeddingCache:
                 t = Tensor(self._table, stop_gradient=False,
                            name=f"hbm_cache_table_{self.table_id}")
                 t.persistable = True
+                t._ledger_category = "hbm_cache"
                 t._mark_stateful()
                 self._table = None
                 self._table_t = t

@@ -96,9 +96,14 @@ def _stats_collector():
     from ...observability.export import format_labels
     out = {}
     for r in server_op_stats():
-        key = format_labels(table=r["table"], op=r["op"])
-        out[f"ps_server_op_calls{key}"] = r["calls"]
-        out[f"ps_server_op_ns{key}"] = r["ns"]
+        key = format_labels("ps_server_op", table=r["table"], op=r["op"])
+        # SUM on duplicate keys: past the cardinality cap every
+        # overflowed (table,op) shares one __overflow__ suffix — the
+        # overflow series must aggregate their traffic, not report
+        # whichever combo iterated last
+        ck, nk = f"ps_server_op_calls{key}", f"ps_server_op_ns{key}"
+        out[ck] = out.get(ck, 0) + r["calls"]
+        out[nk] = out.get(nk, 0) + r["ns"]
     lib = _native.lib()
     if lib is not None:
         out["ps_server_dup_requests"] = int(lib.pt_ps_dup_requests())

@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..core import state as state_mod
 from ..core.tensor import Tensor
 from ..observability import tracing as _obs
+from ..testing import faults as _faults
 
 _is_tracing = False
 
@@ -416,15 +417,22 @@ class StaticFunction:
         compiled, out_wrap, aux = entry
         self._last_aux = aux
 
+        # chaos seam: an injected RESOURCE_EXHAUSTED here simulates a
+        # training-step allocation failure on the exact path a real XLA
+        # OOM surfaces (the flight recorder classifies and dumps it)
+        _faults.kill_point("jit/step")
         out_flat = compiled(dyn_vals)
         return out_wrap(out_flat)
 
     def _make_aux(self, get_jitted, **meta):
         """Per-entry introspection handle: captures abstract twins of the
         first call's arguments, from which the optimized (post-SPMD) HLO
-        can be re-derived on demand — the source of truth for in-trace
-        collective byte accounting. The lazy ``lower().compile()`` is a
-        second backend compile, paid only when stats are requested."""
+        AND the executable's XLA memory analysis can be re-derived on
+        demand — the sources of truth for in-trace collective byte
+        accounting and per-program HBM attribution. The lazy
+        ``lower().compile()`` is a second backend compile (abstract
+        args: no HBM buffers pinned), paid once per entry on the first
+        stats request and shared by every accessor."""
         aux = dict(meta)
 
         def capture(args):
@@ -432,18 +440,49 @@ class StaticFunction:
                 aux["example_args"] = jax.tree_util.tree_map(
                     _abstract_arg, args)
 
+        def _materialize():
+            # ONE lazy AOT compile feeds every introspection artifact
+            # (HLO text, memory stats, top buffers); the loaded
+            # executable itself is NOT retained — on a real backend its
+            # generated code occupies device memory, and pinning a
+            # duplicate executable per entry for the lifetime of the
+            # StaticFunction would double the footprint this layer
+            # exists to account for
+            if "hlo" in aux:
+                return
+            ex = aux.get("example_args")
+            if ex is None:
+                raise RuntimeError(
+                    "program has not executed yet; run the step once "
+                    "before asking for its compiled HLO")
+            from ..observability import memory
+            compiled = get_jitted().lower(*ex).compile()
+            hlo = compiled.as_text()
+            try:
+                aux["memory"] = memory.program_stats(compiled)
+                aux["memory_buffers"] = memory.top_buffers(hlo)
+            except memory.MemoryAttributionError as e:
+                # a backend without usable memory_analysis() must not
+                # break hlo_text(); memory_stats() re-raises
+                aux["memory_error"] = e
+            aux["hlo"] = hlo
+
         def hlo_text():
-            if "hlo" not in aux:
-                ex = aux.get("example_args")
-                if ex is None:
-                    raise RuntimeError(
-                        "program has not executed yet; run the step once "
-                        "before asking for its compiled HLO")
-                aux["hlo"] = get_jitted().lower(*ex).compile().as_text()
+            _materialize()
             return aux["hlo"]
+
+        def memory_stats():
+            # argument/output/temp/alias/generated-code bytes + the
+            # top result buffers (what an OOM dump names); cached per
+            # entry like the HLO text
+            _materialize()
+            if "memory" not in aux:
+                raise aux["memory_error"]
+            return aux["memory"]
 
         aux["capture"] = capture
         aux["hlo_text"] = hlo_text
+        aux["memory_stats"] = memory_stats
         return aux
 
     def hlo_text(self):
@@ -475,6 +514,62 @@ class StaticFunction:
         from ..observability import hlo_bytes
         stats = self.collective_stats()
         hlo_bytes.export_collective_bytes(stats)
+        return stats
+
+    def memory_stats(self):
+        """Per-program HBM attribution from the compiled executable's
+        XLA ``memory_analysis()`` — one record per compiled entry
+        (build order), keyed ``<fn>#<i>:<kind>``::
+
+            {"train_step#0:scan": {"argument_bytes": ..,
+                                   "output_bytes": .., "temp_bytes": ..,
+                                   "alias_bytes": ..,
+                                   "generated_code_bytes": ..,
+                                   "peak_bytes": ..}}
+
+        Donated state rides the carry as aliased input/output pairs, so
+        ``alias_bytes`` ≈ the carried state and ``peak_bytes`` counts it
+        once. Only entries that have executed at least once are
+        attributable (the abstract arg twins are captured on first
+        call); unexecuted entries are skipped."""
+        out = {label: aux["memory_stats"]()
+               for label, aux in self._memory_entries()}
+        if not out:
+            raise RuntimeError(
+                "no executed compiled entry yet; call the step once "
+                "before asking for its memory attribution")
+        return out
+
+    def _memory_entries(self):
+        """``(label, aux)`` per attributable compiled entry — the ONE
+        place the ``<fn>#<i>:<kind>`` label scheme lives."""
+        name = getattr(self, "__name__", "fn")
+        out = []
+        for i, (_key, entry) in enumerate(self._cache.items()):
+            aux = entry[2]
+            if aux.get("example_args") is None:
+                continue
+            out.append((f"{name}#{i}:{aux.get('kind', 'unrolled')}", aux))
+        return out
+
+    def export_memory_stats(self):
+        """Export :meth:`memory_stats` as
+        ``program_hbm_bytes{entry=,kind=}`` gauges and register each
+        entry (with its top buffers) in the process-wide program-memory
+        registry the flight recorder snapshots at death; returns the
+        stats."""
+        from ..observability import memory
+        # ONE walk builds and registers: a second _memory_entries()
+        # pass could see an entry another thread compiled in between
+        stats = {}
+        for label, aux in self._memory_entries():
+            stats[label] = memory.record_program_memory(
+                label, aux["memory_stats"](),
+                buffers=aux.get("memory_buffers"))
+        if not stats:
+            raise RuntimeError(
+                "no executed compiled entry yet; call the step once "
+                "before asking for its memory attribution")
         return stats
 
     def _place_args(self, dyn_vals, mesh):

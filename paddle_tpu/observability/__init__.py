@@ -25,9 +25,10 @@ tokens/s / MFU / data-wait gauges into it. The perf gate:
 """
 from .. import profiler as _profiler
 from . import export, flight, gate, hlo_bytes, runlog, step  # noqa: F401
-from . import tracing  # noqa: F401
+from . import memory, tracing  # noqa: F401
 from .gate import compare, load_results  # noqa: F401
 from .hlo_bytes import collective_stats, export_collective_bytes  # noqa: F401
+from .memory import state_ledger  # noqa: F401
 from .runlog import start_run, stop_run  # noqa: F401
 from .step import StepTimer  # noqa: F401
 from .tracing import (CATEGORIES, attach_context, count,  # noqa: F401
@@ -37,10 +38,11 @@ from .tracing import (CATEGORIES, attach_context, count,  # noqa: F401
 __all__ = [
     "enable", "disable", "enabled", "trace_span", "current_span", "count",
     "CATEGORIES", "StepTimer", "export_chrome_trace",
-    "collective_stats", "export_collective_bytes",
+    "collective_stats", "export_collective_bytes", "state_ledger",
     "trace_context", "attach_context", "mint_context", "record_span",
     "start_run", "stop_run",
     "tracing", "export", "gate", "hlo_bytes", "step", "runlog", "flight",
+    "memory",
 ]
 
 
@@ -51,9 +53,10 @@ def export_chrome_trace(path):
 
 
 def reset():
-    """Clear recorded events, counters-board gauges, and summary windows
-    (monitor counters are shared state and are left alone; reset them
-    individually)."""
+    """Clear recorded events, counters-board gauges, summary windows,
+    and the program-memory attribution registry (monitor counters are
+    shared state and are left alone; reset them individually)."""
     _profiler.reset()
     export.clear_gauges()
     export.clear_summaries()
+    memory.clear_program_memory()

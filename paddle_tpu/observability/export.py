@@ -24,12 +24,14 @@ import time
 
 from .. import monitor
 
-__all__ = ["publish", "gauges", "prometheus_text", "telemetry_dict",
+__all__ = ["publish", "gauges", "set_gauge", "prometheus_text",
+           "telemetry_dict",
            "write_json", "start_http_server", "register_collector",
            "unregister_collector", "summary", "summaries", "Summary",
            "register_health", "unregister_health", "health_dict",
            "escape_label_value", "format_labels",
-           "PROM_PREFIX", "SUMMARY_QUANTILES", "DEFAULT_SUMMARY_WINDOW"]
+           "PROM_PREFIX", "SUMMARY_QUANTILES", "DEFAULT_SUMMARY_WINDOW",
+           "DEFAULT_MAX_LABEL_SETS"]
 
 PROM_PREFIX = "paddle_tpu"
 
@@ -67,15 +69,73 @@ def escape_label_value(value):
             .replace("\n", "\\n"))
 
 
-def format_labels(**labels):
+# -- label-cardinality guard ----------------------------------------------
+# Per-metric bounded label-set registry: an unbounded label space (every
+# distinct table id x op, or user-controlled strings leaking into a
+# label) grows the counter registry and every scrape without limit. Past
+# the cap, NEW label combinations collapse to a single __overflow__
+# series; combinations seen before the cap keep exporting normally.
+DEFAULT_MAX_LABEL_SETS = 1000
+
+
+def _max_label_sets():
+    import os
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_MAX_LABEL_SETS",
+                                         str(DEFAULT_MAX_LABEL_SETS))))
+    except ValueError:
+        return DEFAULT_MAX_LABEL_SETS
+
+
+_label_sets = {}  # metric -> set of label suffixes already admitted
+_label_sets_lock = threading.Lock()
+
+
+def clear_label_sets():
+    """Reset the per-metric label-set registry (tests)."""
+    with _label_sets_lock:
+        _label_sets.clear()
+
+
+def format_labels(_metric=None, **labels):
     """Render a ``{key="value",...}`` label suffix with properly escaped
     values — the ONE way producers attach labels to a counter/collector
-    metric name (``'ps_server_op_ns' + format_labels(table=t, op=op)``).
-    Label names are sanitized to the Prometheus name alphabet."""
+    metric name (``'ps_server_op_ns' + format_labels("ps_server_op_ns",
+    table=t, op=op)``). Label names are sanitized to the Prometheus name
+    alphabet.
+
+    ``_metric`` (optional first positional) engages the per-metric
+    label-cardinality guard: each metric admits at most
+    ``PADDLE_TPU_MAX_LABEL_SETS`` (default 1000) distinct label
+    combinations — an overflowing combination collapses to
+    ``{<keys>="__overflow__"}`` and bumps
+    ``metrics_label_overflow_total``, so a ``{table=,op=}``-style
+    blowup degrades to one bounded series instead of growing the
+    registry and every scrape without limit."""
     inner = ",".join(
         f'{_name_re.sub("_", str(k))}="{escape_label_value(v)}"'
         for k, v in labels.items())
-    return "{" + inner + "}"
+    suffix = "{" + inner + "}"
+    if _metric is not None and labels:
+        with _label_sets_lock:
+            seen = _label_sets.setdefault(str(_metric), set())
+            if suffix not in seen:
+                if len(seen) >= _max_label_sets():
+                    monitor.stat_add("metrics_label_overflow_total", 1)
+                    return ("{" + ",".join(
+                        f'{_name_re.sub("_", str(k))}="__overflow__"'
+                        for k in labels) + "}")
+                seen.add(suffix)
+    return suffix
+
+
+def set_gauge(name, value):
+    """Set one last-value gauge by its full (possibly labeled) name —
+    the labeled-gauge seam :func:`publish` (prefix + plain keys) does
+    not cover (``program_hbm_bytes{entry=,kind=}``,
+    ``state_resident_bytes{category=}``)."""
+    with _gauges_lock:
+        _gauges[name] = float(value)
 
 
 class Summary:
@@ -322,7 +382,10 @@ def prometheus_text(prefix=PROM_PREFIX):
         lines.append(f"{mname} {value}")
     for name, value in sorted(gauges().items()):
         mname = f"{prefix}_{_prom_name(name)}"
-        lines.append(f"# TYPE {mname} gauge")
+        base = mname.split("{", 1)[0]
+        if base not in typed:  # one TYPE line per family, not per label set
+            typed.add(base)
+            lines.append(f"# TYPE {base} gauge")
         lines.append(f"{mname} {value:.6g}")
     with _summaries_lock:
         summs = sorted(_summaries.items())

@@ -125,21 +125,52 @@ def _metrics_snapshot():
         return {"error": str(e)[:300]}
 
 
+def _memory_section():
+    try:
+        from . import memory
+        return memory.flight_section()
+    except Exception as e:
+        return {"error": str(e)[:300]}
+
+
+def _classify(reason, exc):
+    """Recognize allocation failures: a dump whose exception matches the
+    XLA allocation-error vocabulary (``RESOURCE_EXHAUSTED``, "out of
+    memory", ...) is tagged ``reason="oom"`` so dump triage can route
+    OOMs to the memory snapshot instead of the traceback."""
+    try:
+        from . import memory
+        if memory.is_oom_error(exc):
+            return "oom"
+    except Exception:
+        pass
+    return reason
+
+
 def dump(reason, exc=None, extra=None):
     """Write one flight-recorder dump; returns the path (None when not
     installed). Atomic tmp+rename — a reader never sees a torn dump.
-    Never raises: the recorder must not mask the original failure."""
+    Never raises: the recorder must not mask the original failure.
+    An exception classified as an allocation failure retags the dump
+    ``reason="oom"`` (the triggering path stays in ``cause``); every
+    dump carries a ``memory`` section — per-category state-residency
+    bytes plus the recorded per-program attributions with their top
+    buffers — so an OOM names where the HBM went at death."""
     d = _dir[0]
     if d is None:
         return None
     try:
         import time
-        rec = {"format": 1, "reason": reason, "pid": os.getpid(),
+        tagged = _classify(reason, exc)
+        rec = {"format": 1, "reason": tagged, "pid": os.getpid(),
                "time": time.time(),
                "thread": threading.current_thread().name,
                "spans": recent_spans(),
                "metrics": _metrics_snapshot(),
+               "memory": _memory_section(),
                "faults": _faults_snapshot()}
+        if tagged != reason:
+            rec["cause"] = reason
         if exc is not None:
             rec["exception"] = {
                 "type": type(exc).__name__, "message": str(exc)[:2000],
@@ -162,10 +193,12 @@ def dump(reason, exc=None, extra=None):
         return None
 
 
-def on_kill_point(point):
+def on_kill_point(point, exc=None):
     """testing.faults hook: a kill-point FIRED. Called before the
-    injected exception is raised so the evidence outlives it."""
-    dump("kill_point", extra={"kill_point": point})
+    injected exception is raised so the evidence outlives it. The
+    injected exception rides along so a synthetic allocation failure
+    classifies as ``reason="oom"`` exactly like a real one."""
+    dump("kill_point", exc=exc, extra={"kill_point": point})
 
 
 def latest_dump(dir=None):

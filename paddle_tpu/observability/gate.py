@@ -31,10 +31,19 @@ __all__ = ["load_results", "compare", "format_report", "write_baseline",
 
 DEFAULT_TOLERANCE = 0.10  # fractional noise allowance
 
-_LOWER_BETTER_UNITS = {"ms", "s", "ns", "us"}
+# time-like units and resource-footprint units both regress UPWARD
+_LOWER_BETTER_UNITS = {"ms", "s", "ns", "us", "MB", "MiB", "GB", "bytes"}
 
 
 def higher_is_better(record):
+    """Regression direction of one record: an explicit ``"direction":
+    "lower"|"higher"`` pin wins (the memory rows pin ``lower`` — more
+    resident bytes is a regression even though "MB" is not a time
+    unit); otherwise inferred from the unit — time-like and
+    byte-footprint units regress upward, rates/ratios downward."""
+    direction = record.get("direction")
+    if direction in ("lower", "higher"):
+        return direction == "higher"
     return record.get("unit", "") not in _LOWER_BETTER_UNITS
 
 

@@ -191,7 +191,8 @@ def export_collective_bytes(stats):
     from . import runlog
     from .export import format_labels
     for s in stats:
-        labels = format_labels(op=s["op"], axis=s["axis"])
+        labels = format_labels("collective_bytes", op=s["op"],
+                               axis=s["axis"])
         monitor.stat_add("collective_bytes" + labels, s["bytes"])
         monitor.stat_add("collective_count" + labels, s["count"])
     if stats and runlog.active() is not None:

@@ -70,38 +70,93 @@ class RunLog:
     Thread-safe: every record is serialized under a lock and written as
     one line + flush, so a crash loses at most the line being written
     and concurrent worker threads never interleave bytes.
+
+    ``max_bytes`` bounds each file: when a write crosses the limit the
+    log ROLLS to ``<base>.partN.jsonl`` — the new part opens with a
+    continuation manifest (same run/rank/pid identity plus ``part`` and
+    ``continues``) so a week-long run cannot fill the disk with one
+    file and ``tools/trace_view.py`` merges the parts back into one
+    process track transparently.
     """
 
     def __init__(self, path, run_id=None, rank=None, meta=None,
-                 process=None):
+                 process=None, max_bytes=None):
+        self.base_path = path
         self.path = path
+        self.paths = [path]
         self.run_id = run_id
         self.rank = rank
         self.process = process or "main"
+        self.max_bytes = (None if not max_bytes
+                          else max(4096, int(max_bytes)))
+        self.part = 0
         self._f = open(path, "a")
+        # append mode may land on an existing file (same pid re-running
+        # start_run, or an explicit path=): count what's already there
+        # or max_bytes would bound only the NEW bytes, not the file
+        self._bytes = self._f.tell()
         self._lock = threading.Lock()
         self.events_written = 0
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
+        self._git_sha = _git_sha(repo_root)
+        self._meta = meta or {}
         # wall + monotonic anchors: the merge tool computes this file's
         # monotonic->wall offset from the pair, which is what aligns
         # logs from processes (or hosts) with different clock bases
-        self._write({
-            "kind": "manifest", "run_id": run_id, "rank": rank,
+        self._write(self._manifest())
+
+    def _manifest(self, continues=None):
+        rec = {
+            "kind": "manifest", "run_id": self.run_id, "rank": self.rank,
             "pid": os.getpid(), "process": self.process,
             "time": time.time(), "mono_ns": _now_ns(),
-            "git_sha": _git_sha(repo_root),
-            "meta": meta or {},
-        })
+            "git_sha": self._git_sha,
+            "meta": self._meta,
+        }
+        if self.part:
+            rec["part"] = self.part
+        if continues:
+            rec["continues"] = continues
+        return rec
+
+    def _part_path(self, n):
+        base = self.base_path
+        if base.endswith(".jsonl"):
+            return f"{base[:-len('.jsonl')]}.part{n}.jsonl"
+        return f"{base}.part{n}"
+
+    def _write_line(self, line):
+        self._f.write(line + "\n")
+        self._bytes += len(line) + 1
+        self.events_written += 1
 
     def _write(self, rec):
         line = json.dumps(rec, default=str)
         with self._lock:
             if self._f is None:
                 return
-            self._f.write(line + "\n")
+            self._write_line(line)
+            if self.max_bytes is not None and self._bytes >= self.max_bytes:
+                # roll INSIDE the lock: close the full part, open the
+                # next one, and lead it with a continuation manifest
+                # (fresh clock anchors; same process identity)
+                prev = self.path
+                self._f.flush()
+                try:
+                    os.fsync(self._f.fileno())
+                except OSError:
+                    pass
+                self._f.close()
+                self.part += 1
+                self.path = self._part_path(self.part)
+                self.paths.append(self.path)
+                self._f = open(self.path, "a")
+                self._bytes = self._f.tell()
+                self._write_line(json.dumps(
+                    self._manifest(continues=os.path.basename(prev)),
+                    default=str))
             self._f.flush()
-            self.events_written += 1
 
     def span(self, name, cat, t0, t1, trace_id, span_id, parent_id,
              attrs=None, process=None, tid=None):
@@ -137,15 +192,32 @@ class RunLog:
                 self._f = None
 
 
+def _env_max_bytes():
+    """``PADDLE_TPU_RUNLOG_MAX_MB`` -> bytes (None when unset/invalid)."""
+    raw = os.environ.get("PADDLE_TPU_RUNLOG_MAX_MB")
+    if not raw:
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        return None
+    return int(mb * 1024 * 1024) if mb > 0 else None
+
+
 def start_run(dir=None, path=None, run_id=None, rank=None, meta=None,
-              process=None):
+              process=None, max_bytes=None):
     """Open the process-wide run-log (replacing any active one). Either
     ``dir`` (file name derived: ``<run_id>.rank<r>.pid<pid>.jsonl``) or
-    an explicit ``path``. ``rank`` defaults to ``PADDLE_TRAINER_ID``."""
+    an explicit ``path``. ``rank`` defaults to ``PADDLE_TRAINER_ID``.
+    ``max_bytes`` (or ``PADDLE_TPU_RUNLOG_MAX_MB``) bounds each file:
+    past the limit the log rolls to ``<base>.partN.jsonl`` with a
+    continuation manifest — see :class:`RunLog`."""
     if rank is None:
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if run_id is None:
         run_id = os.environ.get("PADDLE_TPU_RUN_ID", "run")
+    if max_bytes is None:
+        max_bytes = _env_max_bytes()
     if path is None:
         if dir is None:
             raise ValueError("start_run needs dir= or path=")
@@ -153,7 +225,7 @@ def start_run(dir=None, path=None, run_id=None, rank=None, meta=None,
         path = os.path.join(
             dir, f"{run_id}.rank{rank}.pid{os.getpid()}.jsonl")
     log = RunLog(path, run_id=run_id, rank=rank, meta=meta,
-                 process=process)
+                 process=process, max_bytes=max_bytes)
     with _lock:
         old, _active[0] = _active[0], log
     if old is not None:

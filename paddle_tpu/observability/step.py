@@ -106,6 +106,16 @@ class StepTimer:
                 runlog.event("step", name=self.publish_as,
                              **{k: round(v, 6) if isinstance(v, float)
                                 else v for k, v in t.items()})
+                if self.total_steps % self.window == 0:
+                    # window boundary: a memory_snapshot event (state
+                    # residency by category + recorded program
+                    # attributions) lands next to the step stream —
+                    # a metadata-only walk, paid once per window
+                    from . import memory
+                    try:
+                        memory.runlog_snapshot()
+                    except Exception:
+                        pass  # telemetry must never fail the step
         return t
 
     def telemetry(self):

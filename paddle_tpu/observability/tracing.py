@@ -341,7 +341,7 @@ class _SampledOpObserver:
         # keyed by the canonical dispatch op name, label-escaped per the
         # exposition format
         from .export import format_labels
-        key = format_labels(op=_op_label(name))
+        key = format_labels("dispatch_op", op=_op_label(name))
         monitor.stat_add("dispatch_op_sampled" + key, 1)
         monitor.stat_add("dispatch_op_ns" + key, end_ns - token)
 

@@ -32,6 +32,7 @@ class _LRValue:
             lr_value = float(lr)
         self.tensor = Tensor(jnp.asarray(lr_value, jnp.float32))
         self.tensor.persistable = True
+        self.tensor._ledger_category = "lr"  # memory-ledger attribution
         self.tensor._mark_stateful()
         if self.scheduler is not None:
             self.scheduler._bind(self)
@@ -326,6 +327,7 @@ class Optimizer:
         self._flat_stores = {}  # slot -> _FlatStore
         self._flat_pending = []  # (slot, param, fill) until finalized
         self._step_count = Tensor(jnp.zeros((), jnp.int32))
+        self._step_count._ledger_category = "lr"
         self._step_count._mark_stateful()
         for group in self._param_groups:
             for p in group["params"]:
@@ -350,6 +352,8 @@ class Optimizer:
                 fills.append((n_rows, size, fill))
                 row_off += n_rows
             store = _FlatStore(fills)
+            store.tensor._ledger_category = ("master" if slot == "master"
+                                             else "opt_moment")
             self._flat_stores[slot] = store
             for p, ro, n_rows, size, shape in views:
                 self._accumulators[(slot, id(p))] = _FlatSlot(
@@ -375,6 +379,7 @@ class Optimizer:
             t = Tensor(jnp.full(param._value.shape, fill,
                                 dtype or jnp.float32))
             t.persistable = True
+            t._ledger_category = "opt_moment"
             t._mark_stateful()
             self._accumulators[key] = t
         return self._accumulators[key]
@@ -396,6 +401,7 @@ class Optimizer:
         if t is None:
             t = Tensor(param._value.astype(jnp.float32))
             t.persistable = True
+            t._ledger_category = "master"
             t._mark_stateful()
             self._accumulators[key] = t
         return t
@@ -577,6 +583,8 @@ class Optimizer:
                 store = _FlatStore(zb.fills(), pad_rows=zb.pad_rows)
                 store.tensor.pspec = PartitionSpec(axis, None)
                 store.tensor.name = f"zero_{slot}_b{bi}"
+                store.tensor._ledger_category = (
+                    "zero_master" if slot == "master" else "zero_moment")
                 sdict[slot] = store
             if int(stage) >= 2:
                 # sharded window accumulator for to_static's
@@ -587,6 +595,7 @@ class Optimizer:
                 store = _FlatStore(zb.fills(0.0), pad_rows=zb.pad_rows)
                 store.tensor.pspec = PartitionSpec(axis, None)
                 store.tensor.name = f"zero_gacc_b{bi}"
+                store.tensor._ledger_category = "gacc"
                 store.tensor._carry_optional = True
                 sdict["gacc"] = store
             if int(stage) == 3:
@@ -597,6 +606,7 @@ class Optimizer:
                                    dtype=zb.param_dtype)
                 store.tensor.pspec = PartitionSpec(axis, None)
                 store.tensor.name = f"zero_param_b{bi}"
+                store.tensor._ledger_category = "zero_param"
                 store.tensor._value = zb.flatten(
                     [p._value for p in bparams], dtype=zb.param_dtype)
                 sdict["param"] = store

@@ -464,6 +464,22 @@ class Engine:
 
     run = predict  # Predictor-style alias
 
+    def memory_stats(self):
+        """Per-bucket executable HBM attribution (XLA
+        ``memory_analysis()`` of each AOT executable): ``{bucket:
+        {argument/output/temp/alias/generated_code/peak _bytes}}``.
+        Each bucket is also registered in the program-memory registry
+        (``program_hbm_bytes{entry="serving_b<bucket>",kind=}`` gauges
+        + flight-recorder snapshot), so the serving fleet's per-bucket
+        footprint rides the same export path as training programs."""
+        from ..observability import memory as _memory
+        out = {}
+        for b in self.bucket_ladder:
+            stats = _memory.program_stats(self._execs[b])
+            _memory.record_program_memory(f"serving_b{b}", stats)
+            out[b] = stats
+        return out
+
     def stats(self):
         with self._lock:
             s = dict(self._stats)
@@ -627,10 +643,12 @@ class Engine:
             self._dev_summary.observe(dev_ms)
             _monitor.stat_add(
                 "serving_requests_total"
-                + _export.format_labels(bucket=bucket), len(batch))
+                + _export.format_labels("serving_requests_total",
+                                        bucket=bucket), len(batch))
             _monitor.stat_add(
                 "serving_batches_total"
-                + _export.format_labels(bucket=bucket), 1)
+                + _export.format_labels("serving_batches_total",
+                                        bucket=bucket), 1)
             if pad:
                 _monitor.stat_add("serving_padded_rows_total", pad)
             _export.publish("serving", {"batch_fill_ratio": rows / bucket})

@@ -16,6 +16,9 @@ Instrumented points (grep for ``kill_point(`` to enumerate):
   socket (inject ``ConnectionError`` to exercise retry/backoff, or
   ``latency_s`` to exercise deadlines)
 - ``serving/device_step`` — the serving engine's batched device step
+- ``jit/step``       — each compiled-step execution (inject a
+  ``RESOURCE_EXHAUSTED``-message exception to exercise the flight
+  recorder's OOM classification)
 """
 import threading
 import time
@@ -121,6 +124,15 @@ def _make_exc(exc, point):
 def kill_point(point):
     """Mark a failure-prone stage. No-op (one dict increment) unless a
     test armed this point with :func:`inject`."""
+    if not _armed:
+        # fast path: nothing armed anywhere in the process. Count the
+        # pass WITHOUT the global lock — `jit/step` runs through here
+        # on every compiled-step execution, and serializing all
+        # dispatch threads on a mutex for a diagnostic counter is the
+        # wrong trade (GIL-level increment accuracy is enough here;
+        # armed scenarios below keep exact locked counting).
+        _hits[point] = _hits.get(point, 0) + 1
+        return
     with _lock:
         _hits[point] = _hits.get(point, 0) + 1
         f = _armed.get(point)
@@ -141,17 +153,19 @@ def kill_point(point):
     # every other kill-point in the process behind it
     if latency:
         time.sleep(latency)
-    _on_fired(point)
+    _on_fired(point, exc)
     if exc is not None:
         raise exc
 
 
-def _on_fired(point):
+def _on_fired(point, exc=None):
     """A kill-point FIRED: leave evidence before the injected exception
     unwinds — a zero-width span at the kill site, a run-log event, and
     (when the flight recorder is armed) an atomic crash dump whose last
-    span is this one. Never raises: injecting the *configured* fault is
-    the contract, not a recorder error."""
+    span is this one (the injected exception rides into the dump so an
+    allocation-failure injection classifies as ``reason="oom"``).
+    Never raises: injecting the *configured* fault is the contract, not
+    a recorder error."""
     try:
         from ..observability import flight, runlog, tracing
         now = tracing.now_ns()
@@ -167,7 +181,7 @@ def _on_fired(point):
                           {"kill_point": point})
         runlog.event("fault_fired", point=point)
         if flight.installed():
-            flight.on_kill_point(point)
+            flight.on_kill_point(point, exc)
     except Exception:
         pass
 

@@ -50,13 +50,31 @@ def main(argv=None):
         # installed; the config update must come before first jax use)
         import jax
         jax.config.update("jax_platforms", "cpu")
-        from paddle_tpu.analysis import ladder
+        from paddle_tpu.analysis import ERROR, Finding, ladder
+        from paddle_tpu.observability import memory as mem
         configs = args.configs.split(",") if args.configs else None
-        fs, summary = ladder.verify_ladder(configs=configs)
+        # build the twins once, verify without the built-in attribution
+        # pass, then attribute here — the stats feed both the gate (an
+        # unattributable twin refuses the ladder, like a verify failure)
+        # and the per-config hbm_peak column, without building or
+        # compiling twins twice
+        programs = ladder.build_ladder_programs(configs)
+        fs, summary = ladder.verify_ladder(memory=False,
+                                           programs=programs)
         findings.extend(fs)
+        attribution = ladder.attribute_memory(programs=programs)
+        for name, rows in sorted(attribution.items()):
+            for pi, s in enumerate(rows):
+                if "error" in s:
+                    findings.append(Finding(
+                        "memory-attribution-failed", ERROR,
+                        f"[{name}] program {pi}: {s['error']}"))
         for name, op_counts in sorted(summary.items()):
+            peaks = [("err" if "error" in s
+                      else f"{mem.mb(s['peak_bytes']):g}MB")
+                     for s in attribution.get(name, [])]
             print(f"ladder[{name}]: {len(op_counts)} program(s), "
-                  f"ops={op_counts}")
+                  f"ops={op_counts}, hbm_peak={peaks}")
     if run_source:
         from paddle_tpu.analysis import lint_source
         findings.extend(lint_source(paths=args.source or None))

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""HBM memory viewer + budget gate over the attribution layer.
+
+Renders per-entry program memory breakdowns (argument / output / temp /
+alias / generated-code / peak bytes, ``observability.memory``) and the
+framework-state residency ledger as tables, and optionally checks every
+program peak against an HBM budget — the pre-flight answer to "does
+this config fit the chip?" that today is discovered by OOM-ing.
+
+Sources (pick one):
+
+    # attribute the benchmark ladder's verified program twins
+    python tools/mem_view.py --ladder [--configs resnet,zero3]
+
+    # render a recorded snapshot (a flight dump's "memory" section, a
+    # run-log memory_snapshot event, or observability.memory.snapshot()
+    # written as JSON)
+    python tools/mem_view.py --snapshot dump.json
+
+    # gate: exit 3 when any program peak exceeds the budget
+    python tools/mem_view.py --ladder --budget-mb 16000
+
+Exit codes: 0 ok, 1 usage/attribution error, 3 budget exceeded.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+KINDS = ("argument", "output", "temp", "alias", "generated_code", "peak")
+
+
+def _mb(nbytes):
+    return nbytes / (1024 * 1024)
+
+
+def _render(rows):
+    """Column-aligned ASCII table; first row is the header, followed by
+    a dash separator."""
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = []
+    for i, r in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def format_program_table(programs):
+    """ASCII table over ``{entry: stats}`` records (MB, 3 decimals);
+    records carrying an ``"error"`` key render as ERR rows."""
+    rows = [["entry"] + [f"{k}_mb" for k in KINDS]]
+    for entry in sorted(programs):
+        stats = programs[entry]
+        if "error" in stats:
+            rows.append([entry, "ERR: " + str(stats["error"])[:60]]
+                        + [""] * (len(KINDS) - 1))
+            continue
+        rows.append([entry] + [f"{_mb(stats[f'{k}_bytes']):.3f}"
+                               for k in KINDS])
+    return _render(rows)
+
+
+def format_state_table(state):
+    """ASCII table over a ledger/snapshot ``state`` section: per-category
+    resident (per-rank) and global bytes."""
+    cats = state.get("categories", {})
+    rows = [["category", "resident_mb", "global_mb", "tensors"]]
+    for cat in sorted(cats, key=lambda c: -cats[c]["bytes"]):
+        s = cats[cat]
+        rows.append([cat, f"{_mb(s['bytes']):.3f}",
+                     f"{_mb(s['global_bytes']):.3f}", str(s["count"])])
+    rows.append(["TOTAL", f"{_mb(state.get('total_bytes', 0)):.3f}",
+                 f"{_mb(state.get('total_global_bytes', 0)):.3f}", ""])
+    return _render(rows)
+
+
+def check_budget(programs, budget_mb):
+    """``(ok, over)`` where ``over`` lists ``(entry, peak_mb)`` for every
+    program whose peak exceeds the budget (error records count as over —
+    an unattributable program cannot be certified to fit)."""
+    over = []
+    for entry, stats in sorted(programs.items()):
+        if "error" in stats:
+            over.append((entry, None))
+        elif _mb(stats["peak_bytes"]) > budget_mb:
+            over.append((entry, _mb(stats["peak_bytes"])))
+    return not over, over
+
+
+def _ladder_programs(configs):
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # twins are smoke-scale
+    from paddle_tpu.analysis import ladder
+    out = {}
+    for name, rows in ladder.attribute_memory(configs=configs).items():
+        for pi, stats in enumerate(rows):
+            label = name if len(rows) == 1 else f"{name}#{pi}"
+            out[label] = stats
+    return out
+
+
+def _snapshot_sections(path):
+    """(programs, state) from a snapshot-ish JSON: accepts a raw
+    ``memory.snapshot()``, a flight dump (reads its ``memory`` key), or
+    a run-log memory_snapshot event."""
+    with open(path) as f:
+        data = json.load(f)
+    if "memory" in data and isinstance(data["memory"], dict):
+        data = data["memory"]  # flight dump
+    return data.get("programs", {}), data.get("state", {})
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render per-program HBM attribution + state "
+                    "residency tables; optionally gate on a budget")
+    ap.add_argument("--ladder", action="store_true",
+                    help="attribute the benchmark ladder's program twins")
+    ap.add_argument("--configs", default=None,
+                    help="comma list of ladder configs (default: all)")
+    ap.add_argument("--snapshot", metavar="JSON",
+                    help="render a recorded memory snapshot / flight "
+                    "dump instead of attributing the ladder")
+    ap.add_argument("--budget-mb", type=float, default=None,
+                    help="fail (exit 3) when any program peak exceeds "
+                    "this many MB")
+    args = ap.parse_args(argv)
+
+    if bool(args.ladder) == bool(args.snapshot):
+        ap.error("pick exactly one source: --ladder or --snapshot FILE")
+
+    state = None
+    if args.snapshot:
+        programs, state = _snapshot_sections(args.snapshot)
+    else:
+        configs = args.configs.split(",") if args.configs else None
+        programs = _ladder_programs(configs)
+
+    if programs:
+        print(format_program_table(programs))
+    else:
+        print("no program attributions in this source")
+    if state:
+        print()
+        print(format_state_table(state))
+
+    rc = 0
+    if any("error" in s for s in programs.values()):
+        rc = 1
+    if args.budget_mb is not None:
+        ok, over = check_budget(programs, args.budget_mb)
+        if ok:
+            print(f"\nBUDGET: PASS (every program peak <= "
+                  f"{args.budget_mb:g} MB)")
+        else:
+            for entry, peak in over:
+                print(f"\nBUDGET: {entry} "
+                      + ("attribution failed" if peak is None
+                         else f"peak {peak:.3f} MB > {args.budget_mb:g} MB"))
+            print("BUDGET: FAIL")
+            rc = 3
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

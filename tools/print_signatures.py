@@ -39,6 +39,7 @@ MODULES = [
     "paddle_tpu.sparsity",
     "paddle_tpu.inference",
     "paddle_tpu.observability",
+    "paddle_tpu.observability.memory",
     "paddle_tpu.serving",
     "paddle_tpu.checkpoint",
     "paddle_tpu.testing",

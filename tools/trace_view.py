@@ -35,18 +35,31 @@ import argparse
 import collections
 import json
 import os
+import re
 import sys
+
+# rotated run-log parts (<base>.partN.jsonl, observability/runlog.py
+# max_bytes rolling) merge back onto their base file's process track
+_PART_RE = re.compile(r"\.part\d+(\.jsonl)?$")
+
+
+def _base_file(path):
+    if path.endswith(".jsonl"):
+        return _PART_RE.sub(r"\1", path)
+    return _PART_RE.sub("", path)
 
 
 def load_events(paths):
     """Read run-log files into a flat event list; each event is tagged
-    ``_file`` (source path) and ``_offset_ns`` (monotonic->wall clock
-    offset from its file's manifest, 0 when absent). Unparseable lines
-    (the torn last line of a crashed writer) are skipped, counted in
-    the returned ``(events, n_bad)``."""
+    ``_file`` (source path, with rotation parts folded onto their base
+    file so a rolled log stays ONE process track) and ``_offset_ns``
+    (monotonic->wall clock offset from its file's manifest, 0 when
+    absent). Unparseable lines (the torn last line of a crashed writer)
+    are skipped, counted in the returned ``(events, n_bad)``."""
     events, n_bad = [], 0
     for path in paths:
         offset = 0
+        tag = _base_file(path)
         with open(path) as f:
             for line in f:
                 line = line.strip()
@@ -62,7 +75,7 @@ def load_events(paths):
                         offset = int(rec["time"] * 1e9) - int(rec["mono_ns"])
                     except (KeyError, TypeError):
                         offset = 0
-                rec["_file"] = path
+                rec["_file"] = tag
                 rec["_offset_ns"] = offset
                 events.append(rec)
     return events, n_bad
