@@ -33,6 +33,15 @@ Two surfaces:
     and dropped (or assigned and never entered) silently leaks: it never
     records, and any context the caller expected to propagate is absent.
     Scanned by default over the instrumented modules (``SPAN_PATHS``).
+  * ``barrier-without-timeout``: a bare ``barrier(...)`` call in a
+    multi-process path with no deadline evidence (no ``timeout=``-style
+    kwarg, no timeout/deadline-named argument). A collective barrier
+    with no deadline turns ONE hung or dead rank into a whole-pod
+    deadlock that no metric ever surfaces — every barrier in a
+    multi-process path must fail loudly instead
+    (``distributed.pod.PodRuntime.barrier`` raises
+    ``BarrierTimeoutError`` naming the absent ranks). Scanned by
+    default over ``distributed/`` (``BARRIER_PATHS``).
 """
 import ast
 import os
@@ -40,7 +49,7 @@ import os
 from .findings import ERROR, WARNING, Finding
 
 __all__ = ["lint_program", "lint_source", "HOT_PATHS", "RPC_PATHS",
-           "SPAN_PATHS"]
+           "SPAN_PATHS", "BARRIER_PATHS"]
 
 # host-callback op names: each is a device->host round-trip inside the
 # compiled program (stalls the TPU pipeline every step)
@@ -70,6 +79,7 @@ RPC_PATHS = (
     os.path.join("paddle_tpu", "distributed", "ps", "communicator.py"),
     os.path.join("paddle_tpu", "distributed", "ps", "graph.py"),
     os.path.join("paddle_tpu", "distributed", "fleet", "elastic.py"),
+    os.path.join("paddle_tpu", "distributed", "pod.py"),
 )
 
 # files holding span-instrumented runtime code: scanned by default for
@@ -87,6 +97,19 @@ SPAN_PATHS = (
     os.path.join("paddle_tpu", "io", "dataloader.py"),
     os.path.join("paddle_tpu", "hapi", "model.py"),
 )
+
+# multi-process paths scanned by default for barrier-without-timeout:
+# directories expand recursively to every .py file at scan time
+BARRIER_PATHS = (
+    os.path.join("paddle_tpu", "distributed"),
+    os.path.join("paddle_tpu", "testing", "virtual_pod.py"),
+)
+
+# kwarg names / identifier fragments accepted as deadline evidence on a
+# barrier call
+_BARRIER_TIMEOUT_KWARGS = frozenset({"timeout", "deadline", "timeout_s",
+                                     "io_timeout", "deadline_s"})
+_BARRIER_TIMEOUT_HINTS = ("timeout", "deadline")
 
 # call names that mark a statement as an RPC/socket round-trip
 _RPC_CALL_HINTS = frozenset({
@@ -313,6 +336,47 @@ class _RetryLoopChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _BarrierChecker(ast.NodeVisitor):
+    """Flags ``barrier(...)`` calls with no deadline evidence.
+
+    Evidence: a timeout/deadline-named keyword, or any argument whose
+    identifier chain mentions timeout/deadline (a variable carrying the
+    deadline counts — the rule checks that SOME bound exists, not its
+    value). Definitions are not calls; non-barrier ops that merely
+    mention the word are untouched."""
+
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+
+    @staticmethod
+    def _has_deadline_evidence(node):
+        for kw in node.keywords:
+            if kw.arg and kw.arg.lower() in _BARRIER_TIMEOUT_KWARGS:
+                return True
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(a):
+                if isinstance(sub, (ast.Name, ast.Attribute)):
+                    ident = (sub.id if isinstance(sub, ast.Name)
+                             else sub.attr).lower()
+                    if any(h in ident for h in _BARRIER_TIMEOUT_HINTS):
+                        return True
+        return False
+
+    def visit_Call(self, node):
+        chain = _attr_chain(node.func) or ""
+        if chain.split(".")[-1] == "barrier" \
+                and not self._has_deadline_evidence(node):
+            self.findings.append(Finding(
+                "barrier-without-timeout", WARNING,
+                f"bare {chain}(...) with no deadline evidence — one hung "
+                "or dead rank deadlocks every participant forever; pass "
+                "timeout= (PodRuntime.barrier raises naming the absent "
+                "ranks) or route a deadline variable through the call",
+                loc=f"{self.path}:{node.lineno}"))
+        self.generic_visit(node)
+
+
 class _SpanLeakChecker(ast.NodeVisitor):
     """Flags ``trace_span(...)`` results that never enter a ``with``.
 
@@ -391,21 +455,47 @@ class _SpanLeakChecker(ast.NodeVisitor):
     visit_FunctionDef = visit_AsyncFunctionDef = _visit_fn
 
 
+def _expand_py(entries, repo_root):
+    """Expand path entries (files or directories, repo-relative or
+    absolute) to .py files; directories recurse."""
+    out = []
+    for p in entries:
+        full = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        if os.path.isdir(full):
+            for dirpath, _dirs, files in os.walk(full):
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(files) if f.endswith(".py"))
+        else:
+            out.append(full)
+    return out
+
+
 def lint_source(paths=None, repo_root=None):
-    """AST-lint python sources. Default: the registered hot-path files
-    plus the RPC client paths; or every file in ``paths``. Returns
-    findings; files that fail to parse are reported, not raised."""
+    """AST-lint python sources. Default: the registered hot-path files,
+    the RPC client paths, the span-instrumented modules, and — for the
+    barrier rule only — every file under ``BARRIER_PATHS``; or every
+    file in ``paths`` (all rules). Returns findings; files that fail to
+    parse are reported, not raised."""
     if repo_root is None:
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
     findings = []
     targets = []
+    barrier_only = set()
     if paths:
         targets.extend(paths)
     else:
         targets.extend(os.path.join(repo_root, p) for p in HOT_PATHS)
         targets.extend(os.path.join(repo_root, p) for p in RPC_PATHS)
         targets.extend(os.path.join(repo_root, p) for p in SPAN_PATHS)
+        full_rule_files = {os.path.abspath(p) for p in targets}
+        barrier_files = _expand_py(BARRIER_PATHS, repo_root)
+        # files reached ONLY through BARRIER_PATHS get just the barrier
+        # rule — widening the default sweep to a whole package must not
+        # retroactively subject every file in it to every rule
+        barrier_only = {os.path.abspath(p) for p in barrier_files
+                        if os.path.abspath(p) not in full_rule_files}
+        targets.extend(barrier_files)
     seen = set()
     for path in targets:
         path = os.path.abspath(path)
@@ -419,6 +509,9 @@ def lint_source(paths=None, repo_root=None):
         except SyntaxError as e:
             findings.append(Finding(
                 "syntax-error", ERROR, str(e), loc=f"{rel}:{e.lineno}"))
+            continue
+        _BarrierChecker(rel, findings).visit(tree)
+        if path in barrier_only:
             continue
         _TracedFnChecker(rel, findings).visit(tree)
         _RetryLoopChecker(rel, findings).visit(tree)

@@ -36,10 +36,16 @@ from .core import (CheckpointCorruptError, CheckpointError,  # noqa: F401
                    gc_checkpoints, latest_step, read_checkpoint,
                    valid_steps, write_checkpoint)
 from .state import StateMismatchError  # noqa: F401
+from . import multihost  # noqa: F401
+from .multihost import (PodCheckpointError,  # noqa: F401
+                        PodCheckpointManager, read_pod_checkpoint,
+                        write_pod_checkpoint)
 
 __all__ = ["CheckpointManager", "CheckpointError", "CheckpointCorruptError",
            "StateMismatchError", "write_checkpoint", "read_checkpoint",
-           "valid_steps", "latest_step", "gc_checkpoints", "core", "state"]
+           "valid_steps", "latest_step", "gc_checkpoints", "core", "state",
+           "multihost", "PodCheckpointManager", "PodCheckpointError",
+           "write_pod_checkpoint", "read_pod_checkpoint"]
 
 
 class CheckpointManager:

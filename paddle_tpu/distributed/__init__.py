@@ -19,3 +19,8 @@ from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
+from . import pod  # noqa: F401
+from .pod import (  # noqa: F401
+    PodRuntime, PodCoordinator, start_coordinator, PodError,
+    RankFailedError, BarrierTimeoutError, StaleGenerationError,
+)

@@ -58,7 +58,8 @@ def is_worker():
 
 def barrier_worker():
     if _ps_runtime is not None and _ps_runtime.client is not None:
-        _ps_runtime.client.barrier(_role_maker.worker_num())
+        _ps_runtime.client.barrier(_role_maker.worker_num(),
+                                   timeout=600.0)
     # collective single-controller: no-op
 
 

@@ -110,35 +110,65 @@ def start_local_trainers(cluster, pod, training_script, training_script_args,
     return procs
 
 
-def watch_local_trainers(procs, nranks=None):
+def signal_name(exitcode):
+    """Signal name for a by-signal child exit (``exitcode < 0``), else
+    None. The one place this PR spells ``signal.Signals(-ec).name``
+    (spawn's join and the virtual pod's RankExit reuse it)."""
+    if exitcode is None or exitcode >= 0:
+        return None
+    try:
+        return signal.Signals(-exitcode).name
+    except ValueError:
+        return f"signal {-exitcode}"
+
+
+def _death_desc(ret):
+    """Human description of a child exit code — names the signal for a
+    signal death so a SIGKILLed (OOM-killed, preempted) trainer reads
+    differently from a traceback exit."""
+    sig = signal_name(ret)
+    if sig is not None:
+        return f"died by signal {sig}"
+    return f"failed with exit code {ret}"
+
+
+def watch_local_trainers(procs, nranks=None, grace_s=5.0):
     """Poll children; on any failure terminate the rest and raise
     (reference: launch_utils.py watch_local_trainers:565 — abort-all on
-    first failure). Returns the list of still-alive procs; [] when all
-    exited cleanly."""
+    first failure). Teardown is graceful — SIGTERM, wait up to
+    ``grace_s``, then SIGKILL — so each survivor's flight-recorder
+    SIGTERM hook gets to dump its span ring before the pod disappears.
+    Returns the list of still-alive procs; [] when all exited
+    cleanly."""
     alive = []
     for tp in procs:
         ret = tp.proc.poll()
         if ret is None:
             alive.append(tp)
         elif ret != 0:
-            terminate_local_procs(procs)
+            terminate_local_procs(procs, grace_s=grace_s)
             raise RuntimeError(
-                f"trainer rank {tp.rank} failed with exit code {ret}; "
-                f"aborted remaining trainers")
+                f"trainer rank {tp.rank} {_death_desc(ret)}; remaining "
+                f"trainers were terminated (SIGTERM, {grace_s:.0f}s "
+                "grace, then SIGKILL — flight dumps, if armed, are in "
+                "PADDLE_TPU_FLIGHT_DIR)")
         else:
             if tp.log_f:
                 tp.log_f.close()
     return alive
 
 
-def terminate_local_procs(procs):
+def terminate_local_procs(procs, grace_s=5.0):
+    """SIGTERM every live child, wait up to ``grace_s`` for the flight
+    recorder's SIGTERM hook (and any atexit flushing) to run, then
+    SIGKILL stragglers."""
     for tp in procs:
         if tp.proc.poll() is None:
             try:
                 tp.proc.terminate()
             except OSError:
                 pass
-    deadline = time.time() + 5
+    deadline = time.time() + max(0.0, grace_s)
     for tp in procs:
         try:
             tp.proc.wait(timeout=max(0.1, deadline - time.time()))

@@ -1,0 +1,858 @@
+"""Multi-host virtual pod runtime: rendezvous, failure detection, elastic
+re-formation.
+
+Reference analog: the raw-TCP NCCL ``uniqueId`` exchange of
+``gen_comm_id_helper.cc`` plus the launcher watchdog of
+``fleet/launch_utils.py watch_local_trainers:565`` — but where the
+reference restarts dead trainers from scratch, this runtime makes rank
+death a *detected, recoverable* event for the survivors:
+
+- **Rendezvous** (:class:`PodCoordinator` + :meth:`PodRuntime.init`): a
+  JSON-lines TCP service (normally hosted by the launcher/supervisor, so
+  it outlives any rank — see ``testing/virtual_pod.py``) admits
+  ``num_processes`` ranks and hands each the same minted pod ``uid``
+  (the uniqueId exchange), the generation number, and the roster.
+- **Failure detection**: every rank's heartbeat thread stamps a lease at
+  the coordinator; a lease older than ``lease_ttl`` marks the rank
+  failed (the *bounded detection window*), and a supervisor that reaps a
+  dead child can :meth:`PodCoordinator.mark_failed` it immediately.
+  Failures piggyback on heartbeat replies, so every survivor learns of a
+  dead peer within one heartbeat interval; blocked barriers/collectives
+  fail the instant the mark lands. Surfaced as :class:`RankFailedError`
+  naming the dead rank(s).
+- **Barrier with timeout** (:meth:`PodRuntime.barrier`): a hung or dead
+  rank fails the barrier loudly — :class:`BarrierTimeoutError` lists who
+  never arrived — instead of deadlocking the pod (the lint rule
+  ``barrier-without-timeout`` exists because of exactly this).
+- **Host collectives** (:meth:`PodRuntime.allreduce`): gather-sum-
+  broadcast through the coordinator in float64 with a deterministic
+  (rank-sorted) reduction order. This is the cross-process data-parallel
+  gradient path on backends whose XLA build has no cross-process
+  collectives (jaxlib < 0.5 CPU — the virtual-pod CI reality); on real
+  multi-host TPU the same runtime layers *under*
+  ``jax.distributed.initialize`` (``jax_init="auto"``) and XLA carries
+  the tensor traffic while the pod carries liveness + control.
+- **Elastic re-formation** (:meth:`PodRuntime.reform`): after a failure
+  the survivors re-form at the smaller world size — dense re-rank, new
+  generation, fresh leases — and drive the PR-7 elastic restore path
+  (``checkpoint.multihost``) to continue from the last
+  rank-0-committed multi-process checkpoint.
+
+Env contract (:meth:`PodRuntime.from_env`):
+``PADDLE_POD_COORDINATOR`` (host:port), ``PADDLE_TRAINERS_NUM``,
+``PADDLE_TRAINER_ID``, and the knobs ``PADDLE_POD_LEASE_TTL`` /
+``PADDLE_POD_HEARTBEAT_S`` / ``PADDLE_POD_BARRIER_TIMEOUT``.
+"""
+import base64
+import json
+import os
+import secrets
+import socket
+import socketserver
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["PodRuntime", "PodCoordinator", "start_coordinator",
+           "PodError", "RankFailedError", "BarrierTimeoutError",
+           "StaleGenerationError"]
+
+
+class PodError(RuntimeError):
+    """Base class for pod runtime failures."""
+
+
+class RankFailedError(PodError):
+    """One or more pod ranks died (missed lease / reaped by the
+    supervisor). ``ranks`` holds the ORIGIN trainer ids (stable across
+    re-formations); ``details`` the per-rank reason strings."""
+
+    def __init__(self, details):
+        self.details = list(details)
+        self.ranks = sorted({d.get("origin", d.get("rank"))
+                             for d in self.details})
+        msg = "; ".join(
+            f"rank {d.get('origin', d.get('rank'))}: {d.get('reason')}"
+            for d in self.details)
+        super().__init__(f"pod rank(s) {self.ranks} failed — {msg}")
+
+
+class BarrierTimeoutError(PodError):
+    """A barrier deadline expired before every live rank arrived."""
+
+    def __init__(self, name, waiting, timeout):
+        self.name = name
+        self.waiting = sorted(waiting)
+        super().__init__(
+            f"barrier {name!r} timed out after {timeout:.1f}s waiting for "
+            f"rank(s) {self.waiting} — a hung rank fails loudly instead "
+            "of deadlocking the pod")
+
+
+class StaleGenerationError(PodError):
+    """An op was issued against a generation the pod has re-formed past
+    (the caller missed a reform — re-sync before retrying)."""
+
+
+# -- coordinator (server side) ---------------------------------------------
+
+class PodCoordinator(socketserver.ThreadingTCPServer):
+    """The pod's rendezvous + liveness service.
+
+    Normally hosted by the process that SUPERVISES the ranks (the
+    launcher, ``testing.virtual_pod.VirtualPod``, or a dedicated
+    scheduler sidecar) so that no rank's death takes the coordinator
+    with it. All state lives under one condition variable; barrier /
+    allreduce / join / reform handlers block their connection thread
+    until the op completes, a participant fails, or the deadline passes.
+    """
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr=("127.0.0.1", 0), expected=None,
+                 lease_ttl=3.0, monitor_interval=None):
+        self.expected = expected
+        self.lease_ttl = float(lease_ttl)
+        self.uid = secrets.token_hex(16)  # the "uniqueId" every rank gets
+        self.gen = 0
+        self._members = {}   # rank -> {"origin", "pid", "endpoint"}
+        self._leases = {}    # rank -> last heartbeat time
+        self._failed = {}    # rank -> {"rank","origin","reason","t"}
+        self._failure_log = []
+        self._barriers = {}  # (gen, name) -> {"arrived": set, "done": set}
+        self._colls = {}     # (gen, name) -> {"parts", "result", "done"}
+        self._reforms = {}   # gen -> set(ranks)
+        self._reform_result = {}  # old gen -> {"gen", "map"}
+        self._cond = threading.Condition()
+        self._closed = False
+        super().__init__(addr, _PodHandler)
+        interval = (monitor_interval if monitor_interval is not None
+                    else max(0.05, self.lease_ttl / 4.0))
+        self._monitor = threading.Thread(
+            target=self._monitor_leases, args=(interval,), daemon=True)
+        self._monitor.start()
+
+    # -- public (in-process supervisor surface) ----------------------------
+    @property
+    def endpoint(self):
+        host, port = self.server_address[:2]
+        return f"{host}:{port}"
+
+    def mark_failed(self, origin, reason):
+        """Mark the member with ORIGIN trainer id failed (the supervisor
+        fast path: a reaped child is dead *now*, no need to wait out the
+        lease)."""
+        with self._cond:
+            for rank, info in self._members.items():
+                if info["origin"] == origin:
+                    self._mark_failed_locked(rank, reason)
+                    return True
+            self._failure_log.append(
+                {"origin": origin, "reason": reason, "t": time.time(),
+                 "member": False})
+        return False
+
+    def state(self):
+        with self._cond:
+            return {
+                "gen": self.gen, "uid": self.uid,
+                "members": {r: dict(m) for r, m in self._members.items()},
+                "failed": {r: dict(f) for r, f in self._failed.items()},
+                "failure_log": list(self._failure_log),
+                "lease_ttl": self.lease_ttl,
+            }
+
+    def close(self):
+        self._closed = True
+        self.shutdown()
+        self.server_close()
+
+    # -- internals ----------------------------------------------------------
+    def _mark_failed_locked(self, rank, reason):
+        if rank in self._failed:
+            return
+        rec = {"rank": rank,
+               "origin": self._members.get(rank, {}).get("origin", rank),
+               "reason": reason, "t": time.time(), "gen": self.gen}
+        self._failed[rank] = rec
+        self._failure_log.append(dict(rec))
+        self._leases.pop(rank, None)
+        self._cond.notify_all()
+
+    def _monitor_leases(self, interval):
+        while not self._closed:
+            time.sleep(interval)
+            now = time.time()
+            with self._cond:
+                # leases only bind once the pod has FORMED: during
+                # rendezvous a joined rank's heartbeat hasn't started
+                # (init() returns after join), so join skew longer than
+                # the ttl must not falsely kill the early joiners —
+                # formation re-stamps every lease (_op_join) and
+                # enforcement begins from there
+                if self.expected is None \
+                        or len(self._members) < self.expected:
+                    continue
+                for rank in list(self._members):
+                    if rank in self._failed:
+                        continue
+                    lease = self._leases.get(rank)
+                    if lease is not None and now - lease > self.lease_ttl:
+                        self._mark_failed_locked(
+                            rank, f"lease expired ({now - lease:.2f}s > "
+                                  f"ttl {self.lease_ttl:.2f}s without a "
+                                  "heartbeat)")
+
+    def _failed_snapshot_locked(self):
+        return [dict(f) for f in self._failed.values()]
+
+    # -- request handlers (each runs on its connection's thread) -----------
+    def handle_req(self, req):
+        op = req.get("op")
+        fn = getattr(self, f"_op_{op}", None)
+        if fn is None:
+            return {"ok": False, "error": "bad_op", "op": op}
+        try:
+            return fn(req)
+        except Exception as e:  # never kill the handler thread
+            return {"ok": False, "error": "internal",
+                    "detail": f"{type(e).__name__}: {e}"}
+
+    def _op_join(self, req):
+        rank = int(req["rank"])
+        nprocs = int(req["nprocs"])
+        deadline = time.time() + float(req.get("timeout", 60.0))
+        with self._cond:
+            if self.expected is None:
+                self.expected = nprocs
+            if nprocs != self.expected:
+                return {"ok": False, "error": "world_mismatch",
+                        "expected": self.expected}
+            if self.gen != 0:
+                return {"ok": False, "error": "stale_gen", "gen": self.gen}
+            self._members[rank] = {"origin": int(req.get("origin", rank)),
+                                   "pid": req.get("pid"),
+                                   "endpoint": req.get("endpoint")}
+            self._leases[rank] = time.time()
+            if len(self._members) >= self.expected:
+                # formation instant: re-stamp EVERY lease so detection
+                # windows start now, not at each rank's (skewed) join
+                now = time.time()
+                for r in self._members:
+                    self._leases[r] = now
+            self._cond.notify_all()
+            while len(self._members) < self.expected:
+                if self._failed:
+                    return {"ok": False, "error": "rank_failed",
+                            "failed": self._failed_snapshot_locked()}
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    missing = self.expected - len(self._members)
+                    return {"ok": False, "error": "join_timeout",
+                            "missing": missing}
+                self._cond.wait(remaining)
+            if self._failed:
+                # the roster filled, but a peer was already marked dead
+                # (supervisor fast path) — admitting this rank into a
+                # half-dead pod would just defer the error to the first
+                # barrier
+                return {"ok": False, "error": "rank_failed",
+                        "failed": self._failed_snapshot_locked()}
+            return {"ok": True, "gen": self.gen, "rank": rank,
+                    "world": sorted(self._members), "uid": self.uid,
+                    "lease_ttl": self.lease_ttl}
+
+    def _op_heartbeat(self, req):
+        origin = int(req["origin"])
+        with self._cond:
+            for rank, info in self._members.items():
+                if info["origin"] == origin and rank not in self._failed:
+                    self._leases[rank] = time.time()
+                    break
+            return {"ok": True, "gen": self.gen,
+                    "failed": self._failed_snapshot_locked()}
+
+    def _op_mark_failed(self, req):
+        ok = self.mark_failed(int(req["origin"]),
+                              req.get("reason", "marked by supervisor"))
+        return {"ok": True, "member": ok}
+
+    def _op_leave(self, req):
+        rank = int(req["rank"])
+        with self._cond:
+            self._members.pop(rank, None)
+            self._leases.pop(rank, None)
+            self._cond.notify_all()
+        return {"ok": True}
+
+    def _op_state(self, req):
+        return {"ok": True, "state": self.state()}
+
+    def _gen_guard_locked(self, req):
+        """None when the request's generation is current, else the error
+        reply (stale ops must not deadlock against a re-formed pod)."""
+        if int(req.get("gen", -1)) != self.gen:
+            return {"ok": False, "error": "stale_gen", "gen": self.gen}
+        return None
+
+    def _op_barrier(self, req):
+        rank = int(req["rank"])
+        name = str(req["name"])
+        timeout = float(req.get("timeout", 60.0))
+        deadline = time.time() + timeout
+        with self._cond:
+            stale = self._gen_guard_locked(req)
+            if stale:
+                return stale
+            gen = self.gen
+            key = (gen, name)
+            b = self._barriers.setdefault(key, {"arrived": set(),
+                                                "done": set()})
+            b["arrived"].add(rank)
+            self._cond.notify_all()
+            while True:
+                if self.gen != gen:
+                    return {"ok": False, "error": "stale_gen",
+                            "gen": self.gen}
+                if self._failed:
+                    return {"ok": False, "error": "rank_failed",
+                            "failed": self._failed_snapshot_locked()}
+                live = set(self._members)
+                if live <= b["arrived"]:
+                    b["done"].add(rank)
+                    if b["done"] >= live:
+                        self._barriers.pop(key, None)
+                    return {"ok": True, "gen": gen}
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return {"ok": False, "error": "barrier_timeout",
+                            "waiting": sorted(
+                                self._members[r]["origin"]
+                                for r in live - b["arrived"])}
+                self._cond.wait(min(remaining, 0.25))
+
+    def _op_allreduce(self, req):
+        rank = int(req["rank"])
+        name = str(req["name"])
+        timeout = float(req.get("timeout", 60.0))
+        deadline = time.time() + timeout
+        arr = _decode_array(req)
+        with self._cond:
+            stale = self._gen_guard_locked(req)
+            if stale:
+                return stale
+            gen = self.gen
+            key = (gen, name)
+            c = self._colls.setdefault(
+                key, {"parts": {}, "result": None, "done": set()})
+            c["parts"][rank] = arr
+            self._cond.notify_all()
+            while True:
+                if self.gen != gen:
+                    return {"ok": False, "error": "stale_gen",
+                            "gen": self.gen}
+                if self._failed:
+                    return {"ok": False, "error": "rank_failed",
+                            "failed": self._failed_snapshot_locked()}
+                live = set(self._members)
+                if c["result"] is None and live <= set(c["parts"]):
+                    # deterministic reduction: rank-sorted float64 sum
+                    total = None
+                    for r in sorted(c["parts"]):
+                        if r not in live:
+                            continue
+                        p = c["parts"][r]
+                        total = p.copy() if total is None else total + p
+                    c["result"] = total
+                    self._cond.notify_all()
+                if c["result"] is not None:
+                    c["done"].add(rank)
+                    result = c["result"]
+                    if c["done"] >= live:
+                        self._colls.pop(key, None)
+                    return {"ok": True, "gen": gen,
+                            **_encode_array(result)}
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return {"ok": False, "error": "barrier_timeout",
+                            "waiting": sorted(
+                                self._members[r]["origin"]
+                                for r in live - set(c["parts"]))}
+                self._cond.wait(min(remaining, 0.25))
+
+    def _op_reform(self, req):
+        rank = int(req["rank"])
+        timeout = float(req.get("timeout", 60.0))
+        deadline = time.time() + timeout
+        with self._cond:
+            old_gen = int(req.get("gen", self.gen))
+            if old_gen != self.gen and old_gen not in self._reform_result:
+                return {"ok": False, "error": "stale_gen", "gen": self.gen}
+            if old_gen == self.gen:
+                if rank in self._failed:
+                    return {"ok": False, "error": "rank_failed",
+                            "failed": self._failed_snapshot_locked()}
+                waiters = self._reforms.setdefault(old_gen, set())
+                waiters.add(rank)
+                self._cond.notify_all()
+                while old_gen not in self._reform_result:
+                    survivors = set(self._members) - set(self._failed)
+                    if rank in self._failed:
+                        return {"ok": False, "error": "rank_failed",
+                                "failed": self._failed_snapshot_locked()}
+                    if survivors and survivors <= waiters:
+                        self._do_reform_locked(old_gen, survivors)
+                        break
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return {"ok": False, "error": "barrier_timeout",
+                                "waiting": sorted(
+                                    self._members[r]["origin"]
+                                    for r in survivors - waiters)}
+                    self._cond.wait(min(remaining, 0.25))
+            res = self._reform_result[old_gen]
+            new_rank = res["map"].get(rank)
+            if new_rank is None:
+                return {"ok": False, "error": "rank_failed",
+                        "failed": self._failed_snapshot_locked()}
+            return {"ok": True, "gen": res["gen"], "rank": new_rank,
+                    "world": res["world"], "uid": self.uid}
+
+    def _do_reform_locked(self, old_gen, survivors):
+        """Shrink to the survivors: dense re-rank (sorted by old rank),
+        new generation, fresh leases, failure set cleared (the log
+        keeps history). Pending old-gen barriers/collectives wake with
+        ``stale_gen``."""
+        mapping = {old: new for new, old in enumerate(sorted(survivors))}
+        now = time.time()
+        self._members = {mapping[old]: self._members[old]
+                         for old in sorted(survivors)}
+        self._leases = {mapping[old]: now for old in sorted(survivors)}
+        # the re-formed pod IS fully formed at the smaller size: shrink
+        # `expected` or the monitor's formation gate would skip lease
+        # enforcement forever after the first reform
+        self.expected = len(self._members)
+        self.gen = old_gen + 1
+        self._failed = {}
+        self._barriers.clear()
+        self._colls.clear()
+        self._reforms.pop(old_gen, None)
+        self._reform_result[old_gen] = {
+            "gen": self.gen, "map": mapping,
+            "world": sorted(mapping.values())}
+        self._cond.notify_all()
+
+
+class _PodHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                resp = self.server.handle_req(json.loads(line))
+            except ValueError as e:
+                resp = {"ok": False, "error": "bad_request",
+                        "detail": str(e)}
+            try:
+                self.wfile.write((json.dumps(resp) + "\n").encode())
+                self.wfile.flush()
+            except OSError:
+                return  # client gone mid-reply (killed rank)
+
+
+def start_coordinator(port=0, host="127.0.0.1", expected=None,
+                      lease_ttl=3.0):
+    """Start a :class:`PodCoordinator` on a daemon thread; returns
+    ``(coordinator, endpoint)``."""
+    coord = PodCoordinator((host, port), expected=expected,
+                           lease_ttl=lease_ttl)
+    t = threading.Thread(target=coord.serve_forever, daemon=True)
+    t.start()
+    return coord, coord.endpoint
+
+
+# -- wire helpers -----------------------------------------------------------
+
+def _encode_array(arr):
+    arr = np.ascontiguousarray(np.asarray(arr, dtype=np.float64))
+    return {"dtype": "float64", "shape": list(arr.shape),
+            "data": base64.b64encode(arr.tobytes()).decode("ascii")}
+
+
+def _decode_array(rec):
+    raw = base64.b64decode(rec["data"])
+    return np.frombuffer(raw, dtype=np.float64).reshape(
+        rec["shape"]).copy()
+
+
+class _Conn:
+    """One persistent JSON-lines connection (lock-serialized). The pod
+    client holds TWO: the heartbeat thread's and the main thread's —
+    a blocking barrier on one must never starve liveness on the other."""
+
+    def __init__(self, endpoint, connect_timeout=10.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.connect_timeout = connect_timeout
+        self._sock = None
+        self._f = None
+        self._mu = threading.Lock()
+
+    def call(self, io_timeout, **req):
+        with self._mu:
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(
+                        self.addr, timeout=self.connect_timeout)
+                    self._f = self._sock.makefile("rwb")
+                self._sock.settimeout(io_timeout)
+                self._f.write((json.dumps(req) + "\n").encode())
+                self._f.flush()
+                line = self._f.readline()
+                if not line:
+                    raise ConnectionError(
+                        "pod coordinator closed the connection")
+                return json.loads(line)
+            except (OSError, ValueError):
+                self._drop_locked()
+                raise
+
+    def _drop_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._f = None
+
+    def close(self):
+        with self._mu:
+            self._drop_locked()
+
+
+# -- runtime (client side) --------------------------------------------------
+
+class PodRuntime:
+    """One rank's handle on the pod (see module docstring).
+
+    Lifecycle::
+
+        pod = PodRuntime.from_env()      # or explicit args
+        pod.init()                       # rendezvous: blocks for the pod
+        ...
+        pod.barrier("step0", timeout=30)
+        g = pod.allreduce(local_grads)   # float64, rank-sorted sum
+        ...
+        except RankFailedError:
+            view = pod.reform(timeout=30)   # survivors re-form smaller
+            ...restore from the last pod checkpoint, continue...
+        pod.shutdown()
+    """
+
+    def __init__(self, coordinator, num_processes, process_id, *,
+                 heartbeat_interval=0.5, lease_ttl=None,
+                 barrier_timeout=60.0, join_timeout=60.0,
+                 jax_init="auto"):
+        self.coordinator = coordinator
+        self.num_processes = int(num_processes)
+        self.origin = int(process_id)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.lease_ttl = lease_ttl  # served back by the coordinator
+        self.barrier_timeout = float(barrier_timeout)
+        self.join_timeout = float(join_timeout)
+        self.jax_init = jax_init
+        self.uid = None
+        self._lock = threading.RLock()
+        self._rank = int(process_id)
+        self._world = list(range(self.num_processes))
+        self._gen = 0
+        self._failed = {}      # origin -> failure record
+        self._raised = set()   # origins already surfaced via an exception
+        self._seq = 0
+        self._ops = _Conn(coordinator)
+        self._hb_conn = _Conn(coordinator)
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        self._initialized = False
+        self._jax_distributed = False
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_env(cls, **overrides):
+        """Build from the launcher env contract (see module docstring)."""
+        coord = os.environ.get("PADDLE_POD_COORDINATOR")
+        if not coord:
+            raise PodError("PADDLE_POD_COORDINATOR is not set — launch "
+                           "through testing.virtual_pod.VirtualPod or "
+                           "export the coordinator endpoint")
+        kw = dict(
+            coordinator=coord,
+            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+        for env, key, cast in (
+                ("PADDLE_POD_HEARTBEAT_S", "heartbeat_interval", float),
+                ("PADDLE_POD_BARRIER_TIMEOUT", "barrier_timeout", float),
+                # seeds the client's expectation only — the
+                # coordinator's configured ttl is authoritative and is
+                # served back at join
+                ("PADDLE_POD_LEASE_TTL", "lease_ttl", float)):
+            raw = os.environ.get(env)
+            if raw:
+                kw[key] = cast(raw)
+        kw.update(overrides)
+        return cls(**kw)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return len(self._world)
+
+    @property
+    def gen(self):
+        return self._gen
+
+    def shard_range(self, n):
+        """This rank's contiguous ``[lo, hi)`` slice of ``n`` items under
+        the CURRENT world size (re-shards automatically after a
+        reform)."""
+        w, r = self.world_size, self._rank
+        base, rem = divmod(int(n), w)
+        lo = r * base + min(r, rem)
+        return lo, lo + base + (1 if r < rem else 0)
+
+    def failed_ranks(self):
+        """Origin ids of every rank known dead in the current
+        generation."""
+        with self._lock:
+            return sorted(self._failed)
+
+    # -- lifecycle -----------------------------------------------------------
+    def init(self):
+        """Rendezvous: join the pod (the uniqueId exchange), start the
+        heartbeat lease, optionally bring up ``jax.distributed``."""
+        resp = self._call(self.join_timeout + 5.0, op="join",
+                          rank=self.origin, origin=self.origin,
+                          nprocs=self.num_processes, pid=os.getpid(),
+                          timeout=self.join_timeout)
+        if not resp.get("ok"):
+            self._collective_reply(resp, "join", self.join_timeout)
+        self.uid = resp["uid"]
+        self.lease_ttl = resp.get("lease_ttl", self.lease_ttl)
+        with self._lock:
+            self._gen = resp["gen"]
+            self._rank = resp["rank"]
+            self._world = list(resp["world"])
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+        self._hb_thread.start()
+        self._maybe_init_jax()
+        self._initialized = True
+        self._runlog_event("pod_join", rank=self._rank,
+                           world=self.world_size, gen=self._gen,
+                           uid=self.uid)
+        return self
+
+    def _maybe_init_jax(self):
+        """Layer ``jax.distributed.initialize`` under the pod when the
+        backend can actually carry cross-process collectives.
+        ``jax_init``: "auto" (skip on pre-0.5 CPU — the known jaxlib
+        gap), "always", or "never"."""
+        if self.jax_init == "never" or self.num_processes < 2:
+            return
+        if self.jax_init == "auto" and not _jax_cross_process_capable():
+            return
+        addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if not addr:
+            # the pod coordinator endpoint is NOT a fallback: that port
+            # already serves the JSON-lines rendezvous protocol, and
+            # jax's gRPC coordination service can neither bind nor speak
+            # it — fail with guidance instead of a confusing hang
+            raise PodError(
+                "jax.distributed.initialize needs JAX_COORDINATOR_ADDRESS"
+                " (a port DISTINCT from the pod coordinator's JSON-lines "
+                "service); launch through distributed.launch / "
+                "testing.virtual_pod — start_local_trainers exports it — "
+                "or set jax_init='never'")
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=self.num_processes, process_id=self.origin)
+        self._jax_distributed = True
+
+    def shutdown(self):
+        """Leave the pod cleanly (no failure mark) and stop the
+        heartbeat."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=self.heartbeat_interval + 1.0)
+        if self._initialized:
+            try:
+                self._call(5.0, op="leave", rank=self._rank,
+                           gen=self._gen)
+            except PodError:
+                # _call wraps transport errors into PodError; a clean
+                # shutdown must not die (and read as a rank failure to
+                # the watchdog) just because the coordinator is already
+                # gone in a teardown race
+                pass
+        if self._jax_distributed:
+            try:
+                import jax
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            self._jax_distributed = False
+        self._ops.close()
+        self._hb_conn.close()
+        self._initialized = False
+
+    # -- liveness ------------------------------------------------------------
+    def _heartbeat_loop(self):
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            try:
+                resp = self._hb_conn.call(
+                    max(5.0, self.heartbeat_interval * 4), op="heartbeat",
+                    origin=self.origin)
+            except (OSError, ConnectionError, ValueError):
+                # transient coordinator loss: keep beating — the lease
+                # only expires after ttl, and dying here would turn a
+                # network blip into a false rank death
+                continue
+            self._absorb_failures(resp.get("failed") or ())
+
+    def _absorb_failures(self, failed):
+        with self._lock:
+            for rec in failed:
+                self._failed.setdefault(rec.get("origin"), rec)
+
+    def check_failures(self):
+        """Raise :class:`RankFailedError` for failures not yet surfaced
+        to the caller (each dead rank is raised once; a recovery path
+        that caught it won't see it again)."""
+        with self._lock:
+            fresh = [rec for o, rec in sorted(self._failed.items())
+                     if o not in self._raised]
+            if not fresh:
+                return
+            self._raised.update(rec.get("origin") for rec in fresh)
+        raise RankFailedError(fresh)
+
+    # -- collectives ---------------------------------------------------------
+    def _call(self, io_timeout, **req):
+        try:
+            return self._ops.call(io_timeout, **req)
+        except socket.timeout as e:
+            raise BarrierTimeoutError(
+                req.get("name", req.get("op")), ["<coordinator>"],
+                io_timeout) from e
+        except (OSError, ConnectionError, ValueError) as e:
+            raise PodError(
+                f"pod coordinator {self.coordinator} unreachable during "
+                f"{req.get('op')!r}: {type(e).__name__}: {e}") from e
+
+    def _collective_reply(self, resp, name, timeout):
+        if resp.get("ok"):
+            return resp
+        err = resp.get("error")
+        if err == "rank_failed":
+            self._absorb_failures(resp.get("failed") or ())
+            with self._lock:
+                for rec in resp.get("failed") or ():
+                    self._raised.add(rec.get("origin"))
+            raise RankFailedError(resp.get("failed") or
+                                  [{"origin": None, "reason": "unknown"}])
+        if err == "barrier_timeout":
+            raise BarrierTimeoutError(name, resp.get("waiting", ()),
+                                      timeout)
+        if err == "stale_gen":
+            raise StaleGenerationError(
+                f"op {name!r} used generation {self._gen}, pod is at "
+                f"{resp.get('gen')} — re-sync (reform) before retrying")
+        raise PodError(f"pod op {name!r} failed: {resp}")
+
+    def barrier(self, name, timeout=None):
+        """Block until every live rank arrives at ``name`` — or fail
+        loudly: :class:`RankFailedError` when a member died,
+        :class:`BarrierTimeoutError` (naming who is absent) at the
+        deadline. There is deliberately no infinite-wait mode."""
+        timeout = self.barrier_timeout if timeout is None else float(timeout)
+        resp = self._call(timeout + 15.0, op="barrier", rank=self._rank,
+                          gen=self._gen, name=str(name), timeout=timeout)
+        self._collective_reply(resp, str(name), timeout)
+
+    def allreduce(self, value, name=None, timeout=None):
+        """Sum ``value`` (any array-like; float64 on the wire, reduction
+        rank-sorted so every world size reduces in one deterministic
+        order) across all live ranks. All ranks must issue collectives
+        in the same order; ``name`` overrides the auto sequence id."""
+        timeout = self.barrier_timeout if timeout is None else float(timeout)
+        arr = np.asarray(value, dtype=np.float64)
+        with self._lock:
+            if name is None:
+                name = f"ar{self._seq}"
+                self._seq += 1
+        resp = self._call(timeout + 15.0, op="allreduce", rank=self._rank,
+                          gen=self._gen, name=str(name), timeout=timeout,
+                          **_encode_array(arr))
+        self._collective_reply(resp, str(name), timeout)
+        return _decode_array(resp)
+
+    def allreduce_mean(self, value, name=None, timeout=None):
+        return self.allreduce(value, name=name,
+                              timeout=timeout) / self.world_size
+
+    # -- elastic re-formation ------------------------------------------------
+    def reform(self, timeout=None):
+        """After a failure, re-form the pod with the survivors at the
+        smaller world size: dense re-rank, generation + 1, failure set
+        cleared. Returns ``{"gen", "rank", "world_size"}``. Every
+        survivor must call this (it is itself a barrier among the
+        living)."""
+        timeout = self.barrier_timeout if timeout is None else float(timeout)
+        resp = self._call(timeout + 15.0, op="reform", rank=self._rank,
+                          gen=self._gen, timeout=timeout)
+        self._collective_reply(resp, "reform", timeout)
+        with self._lock:
+            self._gen = resp["gen"]
+            self._rank = resp["rank"]
+            self._world = list(resp["world"])
+            self._failed = {}
+            self._raised = set()
+            self._seq = 0
+        self._runlog_event("pod_reform", rank=self._rank,
+                           world=self.world_size, gen=self._gen)
+        return {"gen": self._gen, "rank": self._rank,
+                "world_size": self.world_size}
+
+    @staticmethod
+    def _runlog_event(what, **fields):
+        try:
+            from ..observability import runlog
+            runlog.event(what, **fields)
+        except Exception:
+            pass
+
+
+def _jax_cross_process_capable():
+    """Can THIS jax build run cross-process collectives on the selected
+    backend? jaxlib < 0.5 cannot on CPU (the documented container gap);
+    any non-CPU platform is assumed capable."""
+    try:
+        import jax
+        ver = tuple(int(x) for x in jax.__version__.split(".")[:2])
+    except Exception:
+        return False
+    platform = (os.environ.get("JAX_PLATFORMS")
+                or os.environ.get("JAX_PLATFORM_NAME") or "")
+    if platform and platform != "cpu":
+        return True
+    return ver >= (0, 5)

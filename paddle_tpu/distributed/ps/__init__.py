@@ -143,7 +143,7 @@ class PsRuntime:
         # step-0 grads before all workers adopted the initial params (keeps
         # barrier generations aligned — each worker makes the same sequence
         # of barrier calls)
-        self.client.barrier(n)
+        self.client.barrier(n, timeout=600.0)
         return self.communicator
 
     def step(self, optimizer=None):

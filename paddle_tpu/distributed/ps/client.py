@@ -156,9 +156,11 @@ class PsClient:
             except OSError:
                 pass
 
-    def _call(self, server, op, table, n, payload=b"", idempotent=False):
+    def _call(self, server, op, table, n, payload=b"", idempotent=False,
+              io_timeout=None):
         if not _obs.enabled("ps"):
-            return self._call_impl(server, op, table, n, payload, idempotent)
+            return self._call_impl(server, op, table, n, payload,
+                                   idempotent, io_timeout)
         # RPC telemetry: per-op round-trips + payload bytes both ways
         # (the brpc-side latency/qps vars of the reference's PSClient)
         op_name = _OP_NAMES.get(op, str(op))
@@ -166,7 +168,7 @@ class PsClient:
         with _obs.trace_span(f"ps/{op_name}", cat="ps", table=table,
                              server=server, bytes_out=len(payload)):
             reply = self._call_impl(server, op, table, n, payload,
-                                    idempotent)
+                                    idempotent, io_timeout)
         _obs.count("ps_client_calls")
         _obs.count(f"ps_client_{op_name}_calls")
         _obs.count("ps_client_bytes_out", len(payload) + 21)  # hdr+frame
@@ -175,7 +177,7 @@ class PsClient:
         return reply
 
     def _call_impl(self, server, op, table, n, payload=b"",
-                   idempotent=False):
+                   idempotent=False, io_timeout=None):
         op_name = _OP_NAMES.get(op, str(op))
 
         def build_msg():
@@ -199,9 +201,11 @@ class PsClient:
         # transport timeout — a barrier legitimately blocks until the
         # slowest worker arrives (first-step compile, data skew) and
         # timing it out at the retry deadline would strand its
-        # already-counted arrival
-        io_timeout = (min(120.0, max(self.retry_policy.deadline_s, 0.1))
-                      if idempotent else 120.0)
+        # already-counted arrival. An explicit io_timeout (the
+        # barrier(timeout=) deadline) wins over both.
+        if io_timeout is None:
+            io_timeout = (min(120.0, max(self.retry_policy.deadline_s, 0.1))
+                          if idempotent else 120.0)
 
         def attempt():
             # per-attempt span: the wire context minted inside it makes
@@ -364,9 +368,13 @@ class PsClient:
                 yield i, idx
 
     # -- control ----------------------------------------------------------
-    def barrier(self, n_workers):
-        """Global worker barrier via server 0 (reference: fetch_barrier)."""
-        self._call(0, OP_BARRIER, 0, n_workers)
+    def barrier(self, n_workers, timeout=None):
+        """Global worker barrier via server 0 (reference: fetch_barrier).
+        ``timeout`` bounds the wait (socket deadline): a worker that
+        never arrives surfaces as a ConnectionError here instead of a
+        silent 120 s hang — pass one in every multi-process path (the
+        ``barrier-without-timeout`` lint rule checks call sites)."""
+        self._call(0, OP_BARRIER, 0, n_workers, io_timeout=timeout)
 
     def save(self, path_prefix):
         # single-shot: a timed-out save retried while the original is

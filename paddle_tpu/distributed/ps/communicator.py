@@ -78,12 +78,13 @@ class SyncCommunicator(_Base):
                 g = np.asarray(p._grad, np.float32).ravel()
                 self.client.push_dense_grad(table_id, g / self.n_workers)
                 p._grad = None
-        self.client.barrier(self.n_workers)  # all pushes applied ...
+        self.client.barrier(self.n_workers,
+                            timeout=600.0)  # all pushes applied ...
         self.pull_dense()
         # ... and nobody starts the next step's pushes until every worker
         # finished pulling (otherwise a fast worker's step-N+1 push lands
         # in a slow worker's step-N pull: mixed-version params)
-        self.client.barrier(self.n_workers)
+        self.client.barrier(self.n_workers, timeout=600.0)
 
 
 class AsyncCommunicator(_Base):

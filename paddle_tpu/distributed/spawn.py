@@ -11,6 +11,7 @@ mesh, not processes.
 import multiprocessing
 import os
 import socket
+import time
 import traceback
 
 
@@ -88,6 +89,11 @@ class _Context:
         self._queue = queue
         self._timeout = timeout
 
+    @staticmethod
+    def _signal_name(exitcode):
+        from .launch import signal_name
+        return signal_name(exitcode)
+
     def join(self):
         import queue as _queue_mod
 
@@ -95,29 +101,62 @@ class _Context:
             return True
         out = {}
         died = None
+        signal_deaths = {}
+        deadline = time.time() + self._timeout
         try:
-            for _ in self._procs:
+            while len(out) + len(signal_deaths) < len(self._procs):
                 try:
-                    rank, status, payload = self._queue.get(
-                        timeout=self._timeout)
+                    rank, status, payload = self._queue.get(timeout=0.2)
+                    out[rank] = (rank, status, payload)
+                    continue
                 except _queue_mod.Empty:
-                    # a child failed to report in time: distinguish crashed
-                    # (non-zero exit), still-running (hang/deadlock), and
-                    # clean-exit-without-result, instead of raising a bare
+                    pass
+                # reap-and-raise: a child killed by a signal (SIGKILL by
+                # the OOM killer, SIGSEGV in native code) never posts a
+                # result — without this check the join blocks the full
+                # timeout while its peers deadlock on the dead rank's
+                # collectives
+                for i, p in enumerate(self._procs):
+                    if i in out or i in signal_deaths:
+                        continue
+                    ec = p.exitcode
+                    if ec is not None and ec < 0:
+                        signal_deaths[i] = self._signal_name(ec)
+                if signal_deaths:
+                    break
+                if time.time() > deadline:
+                    # no signal death: distinguish crashed (non-zero
+                    # exit), still-running (hang/deadlock), and clean-
+                    # exit-without-result, instead of raising a bare
                     # Empty that hides everything we did learn
                     died = [(i, ("alive/hung" if p.is_alive()
                                  else f"exit {p.exitcode}"))
                             for i, p in enumerate(self._procs)]
                     break
-                out[rank] = (rank, status, payload)
+            if signal_deaths:
+                # drain any results already posted before the death
+                while True:
+                    try:
+                        rank, status, payload = self._queue.get_nowait()
+                        out[rank] = (rank, status, payload)
+                    except _queue_mod.Empty:
+                        break
         finally:
+            # signal deaths strand the survivors on dead collectives:
+            # reap everyone instead of joining the full timeout
+            join_s = 2.0 if signal_deaths else self._timeout
             for p in self._procs:
-                p.join(self._timeout)
+                p.join(join_s)
                 if p.is_alive():
                     p.terminate()
         errors = [f"rank {r} failed:\n{payload}"
                   for r, (_, status, payload) in sorted(out.items())
                   if status == "error"]
+        for i, sig in sorted(signal_deaths.items()):
+            errors.append(
+                f"rank {i} died by {sig} without reporting a result — "
+                "an external kill (OOM killer, preemption) or a native "
+                "crash; surviving ranks were terminated")
         if died is not None:
             missing = sorted(set(range(len(self._procs))) - set(out))
             states = {i: s for i, s in died}

@@ -283,16 +283,36 @@ def state_ledger():
             "total_bytes": total, "total_global_bytes": total_global}
 
 
-def export_state_ledger(ledger=None):
+def export_state_ledger(ledger=None, rank=None):
     """Export the ledger as ``state_resident_bytes{category=}`` gauges
-    plus ``state_resident_bytes_total``; returns the ledger."""
+    plus ``state_resident_bytes_total``; returns the ledger.
+
+    ``rank`` adds a ``rank`` label to every gauge — the multi-host
+    story: each pod process exports its OWN residency, a scrape across
+    ranks (or ``tools/trace_view.py --stats`` over the merged run-logs)
+    sums them. Defaults to ``PADDLE_TRAINER_ID`` when that is set (a
+    launched rank), else unlabeled (single-process, the PR-10
+    behavior)."""
+    import os as _os
+
     from . import export
     ledger = ledger if ledger is not None else state_ledger()
+    if rank is None:
+        rank = _os.environ.get("PADDLE_TRAINER_ID")
+    labels = {} if rank is None else {"rank": str(rank)}
     for cat, slot in ledger["categories"].items():
         export.set_gauge(
             "state_resident_bytes" + export.format_labels(
-                "state_resident_bytes", category=cat), slot["bytes"])
-    export.set_gauge("state_resident_bytes_total", ledger["total_bytes"])
+                "state_resident_bytes", category=cat, **labels),
+            slot["bytes"])
+    if labels:
+        export.set_gauge(
+            "state_resident_bytes_total" + export.format_labels(
+                "state_resident_bytes_total", **labels),
+            ledger["total_bytes"])
+    else:
+        export.set_gauge("state_resident_bytes_total",
+                         ledger["total_bytes"])
     return ledger
 
 
@@ -316,14 +336,29 @@ def snapshot(top_n=8):
     }
 
 
-def runlog_snapshot():
+def runlog_snapshot(rank=None, export=False):
     """Emit a ``memory_snapshot`` event into the active run-log (no-op
-    when none is active); returns the snapshot or None."""
+    when none is active); returns the snapshot or None. The event is
+    rank-tagged when a rank is known (explicit ``rank`` or
+    ``PADDLE_TRAINER_ID``) so ``tools/trace_view.py --stats`` can sum
+    per-rank residency across a pod's merged logs; ``export=True`` also
+    publishes the ``state_resident_bytes`` gauges
+    (:func:`export_state_ledger`) — rank-labeled only when a rank is
+    known, so single-process callers keep the PR-10 unlabeled series."""
+    import os as _os
+
     from . import runlog
     if runlog.active() is None:
         return None
+    if rank is None:
+        rank = _os.environ.get("PADDLE_TRAINER_ID")
     snap = snapshot()
-    runlog.event("memory_snapshot", **snap)
+    if rank is None:
+        runlog.event("memory_snapshot", **snap)
+    else:
+        runlog.event("memory_snapshot", rank=str(rank), **snap)
+    if export:
+        export_state_ledger(rank=rank)
     return snap
 
 

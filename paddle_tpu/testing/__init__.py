@@ -6,7 +6,14 @@ checkpoint writer, the PS RPC client, and the serving batcher fire
 injected exceptions/latency on demand (reference analog: the fault
 tables the reference's fleet elastic tests script against etcd — here
 the faults are in-process and fully deterministic).
+
+``paddle_tpu.testing.virtual_pod`` launches N REAL localhost processes
+as a pod (parent-hosted coordinator + watchdog) so rank-death semantics
+— detection, elastic re-formation, multi-process checkpoints — are
+provable with actual SIGKILLs and no TPU.
 """
 from . import faults  # noqa: F401
+from . import virtual_pod  # noqa: F401
+from .virtual_pod import VirtualPod  # noqa: F401
 
-__all__ = ["faults"]
+__all__ = ["faults", "virtual_pod", "VirtualPod"]

@@ -19,12 +19,29 @@ Instrumented points (grep for ``kill_point(`` to enumerate):
 - ``jit/step``       — each compiled-step execution (inject a
   ``RESOURCE_EXHAUSTED``-message exception to exercise the flight
   recorder's OOM classification)
+- ``pod/*`` and ``checkpoint/pod_*`` — the virtual-pod training loop
+  and multi-process checkpoint stages (``testing.virtual_pod``)
+
+**Process-level kill-points** (the cross-process analog of
+:func:`inject`): arming a point with :func:`arm_process_kill` — or via
+the ``PADDLE_TPU_PROCESS_KILL`` env var, ``"<point>@<rank>[#<nth>]"``
+(comma-separated; ``rank`` matches this process's
+``PADDLE_TRAINER_ID``) — makes the matching rank **SIGKILL itself** at
+the nth hit of that point. Unlike an injected exception, SIGKILL is
+uncatchable: no handler runs, no flight dump fires — the process is
+simply gone, exactly like an OOM-killer or preemption, which is what
+the virtual-pod failure-detection tests must prove against. The only
+evidence left is a ``process_kill`` run-log event flushed immediately
+before the signal.
 """
+import os
+import signal
 import threading
 import time
 
 __all__ = ["FaultInjected", "inject", "clear", "kill_point", "hits",
-           "fired", "armed", "reset", "scoped", "snapshot"]
+           "fired", "armed", "reset", "scoped", "snapshot",
+           "arm_process_kill", "process_kills"]
 
 
 class FaultInjected(Exception):
@@ -49,6 +66,68 @@ _lock = threading.RLock()
 _armed = {}   # point -> _Fault
 _hits = {}    # point -> kill_point passes (armed or not)
 _fired = {}   # point -> injections actually raised/slept
+_proc_kills = None  # point -> nth hit that SIGKILLs THIS process
+                    # (None = env not parsed yet; {} = none armed)
+
+
+def _load_process_kills():
+    """Parse ``PADDLE_TPU_PROCESS_KILL`` ("<point>@<rank>[#<nth>]",
+    comma-separated) keeping only specs whose rank matches this
+    process's ``PADDLE_TRAINER_ID``. Parsed once; :func:`reset`
+    re-reads (tests adjusting the env must reset)."""
+    global _proc_kills
+    out = {}
+    my_rank = os.environ.get("PADDLE_TRAINER_ID")
+    for part in os.environ.get("PADDLE_TPU_PROCESS_KILL", "").split(","):
+        part = part.strip()
+        if not part or "@" not in part:
+            continue
+        point, _, rest = part.partition("@")
+        rank_s, _, nth_s = rest.partition("#")
+        try:
+            nth = int(nth_s) if nth_s else 1
+        except ValueError:
+            continue
+        if my_rank is not None and rank_s.strip() == my_rank:
+            out[point.strip()] = max(1, nth)
+    _proc_kills = out
+    return out
+
+
+def arm_process_kill(point, nth=1):
+    """Arm a process-level kill: the ``nth`` hit of ``point`` SIGKILLs
+    THIS process (no unwind, no handler — a real rank death)."""
+    global _proc_kills
+    with _lock:
+        kills = _proc_kills if _proc_kills is not None \
+            else _load_process_kills()
+        kills[point] = max(1, int(nth))
+        _proc_kills = kills
+    return point
+
+
+def process_kills():
+    """The armed process-kill table for this process (parses the env on
+    first use)."""
+    with _lock:
+        kills = _proc_kills if _proc_kills is not None \
+            else _load_process_kills()
+        return dict(kills)
+
+
+def _suicide(point):
+    """Leave a flushed run-log trace, then SIGKILL ourselves. SIGKILL
+    cannot be caught or blocked: the flight recorder's hooks never run,
+    the pod's heartbeat simply stops — the honest process-death the
+    virtual-pod tests exist to detect."""
+    try:
+        from ..observability import runlog
+        runlog.event("process_kill", point=point, pid=os.getpid(),
+                     rank=os.environ.get("PADDLE_TRAINER_ID"),
+                     signal="SIGKILL")
+    except Exception:
+        pass
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def inject(point, exc=FaultInjected, times=1, skip=0, latency_s=0.0):
@@ -71,11 +150,14 @@ def clear(point=None):
 
 
 def reset():
-    """Disarm everything and zero the hit/fired counters."""
+    """Disarm everything (process kills re-read the env on next use)
+    and zero the hit/fired counters."""
+    global _proc_kills
     with _lock:
         _armed.clear()
         _hits.clear()
         _fired.clear()
+        _proc_kills = None
 
 
 def hits(point):
@@ -107,6 +189,7 @@ def snapshot():
                       for p, f in _armed.items()},
             "hits": dict(_hits),
             "fired": dict(_fired),
+            "process_kills": dict(_proc_kills or {}),
         }
 
 
@@ -123,8 +206,10 @@ def _make_exc(exc, point):
 
 def kill_point(point):
     """Mark a failure-prone stage. No-op (one dict increment) unless a
-    test armed this point with :func:`inject`."""
-    if not _armed:
+    test armed this point with :func:`inject` or a process-level kill
+    is armed for this rank."""
+    kills = _proc_kills if _proc_kills is not None else _load_process_kills()
+    if not _armed and not kills:
         # fast path: nothing armed anywhere in the process. Count the
         # pass WITHOUT the global lock — `jit/step` runs through here
         # on every compiled-step execution, and serializing all
@@ -135,6 +220,10 @@ def kill_point(point):
         return
     with _lock:
         _hits[point] = _hits.get(point, 0) + 1
+        n = kills.get(point)
+        if n is not None and _hits[point] >= n:
+            _fired[point] = _fired.get(point, 0) + 1
+            _suicide(point)  # does not return
         f = _armed.get(point)
         if f is None:
             return

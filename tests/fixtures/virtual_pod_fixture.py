@@ -1,0 +1,168 @@
+"""Virtual-pod dp training fixture (run under testing.virtual_pod).
+
+One rank of a data-parallel pod: deterministic per-step batches are
+sharded over the CURRENT pod world, gradients (and the loss) cross the
+process boundary through the coordinator's float64 allreduce, and the
+model/optimizer state checkpoints through the rank-0-committed
+multi-process checkpoint. On a peer's death: detect (RankFailedError /
+a failed pod save), re-form at the smaller world size, elastically
+restore from the last pod checkpoint, and continue — losses must stay
+within 1e-6 of a single-process control run of the same fixture.
+
+The forward/backward math is hand-written numpy float64 against the
+framework-held float32 params: the mean-of-shard-means the pod computes
+and the full-batch mean the control computes then agree to ~1e-15
+before the float32 grad cast, so "within 1e-6 of control" is a real
+invariant, not tolerance slack absorbing reduction-order noise. The
+UPDATE itself (Momentum) runs through the real optimizer, and the
+checkpoint round-trips the real framework state.
+
+Stdout protocol (the test parses these):
+  POD_READY rank=R world=W gen=G
+  PS_OK rank=R n=N                     (optional PS client demo)
+  LOSS <step> <loss>
+  CKPT <step>
+  FAILURE_DETECTED t=<wall> failed=[..] err=<ExcType>
+  REFORMED rank=R world=W gen=G
+  RESUME_FROM <step>
+  DONE rank=R world=W
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.observability as obs  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu.checkpoint.multihost import PodCheckpointManager  # noqa: E402
+from paddle_tpu.distributed.pod import (BarrierTimeoutError,  # noqa: E402
+                                        PodRuntime, RankFailedError)
+from paddle_tpu.checkpoint.multihost import PodCheckpointError  # noqa: E402
+from paddle_tpu.testing import faults  # noqa: E402
+
+STEPS = int(os.environ.get("POD_FIX_STEPS", "8"))
+CKPT_EVERY = int(os.environ.get("POD_FIX_CKPT_EVERY", "3"))
+BATCH = int(os.environ.get("POD_FIX_BATCH", "8"))
+ROOT = os.environ["POD_FIX_CKPT_ROOT"]
+IN_DIM, HID = 8, 16
+
+
+def _data(step):
+    rng = np.random.RandomState(1000 + step)
+    return (rng.rand(BATCH, IN_DIM),          # float64
+            rng.rand(BATCH, 1))
+
+
+def _forward_backward(params, x, y):
+    """Hand float64 MLP (Linear-ReLU-Linear, MSE): per-shard SUMS —
+    squared-error sum and sum-gradients in the params' order
+    [W1, b1, W2, b2]. The caller allreduces the sums and scales by the
+    GLOBAL batch, so the pod result equals the full-batch mean exactly
+    (to float64 addition order, ~1e-16) for ANY sharding — equal or
+    ragged — and any world size."""
+    W1, b1, W2, b2 = [np.asarray(p, dtype=np.float64) for p in params]
+    h = x @ W1 + b1
+    hr = np.maximum(h, 0.0)
+    out = hr @ W2 + b2
+    d = out - y
+    sq = float(np.sum(d * d))
+    dout = 2.0 * d  # unscaled: the global 1/N applies after allreduce
+    gW2 = hr.T @ dout
+    gb2 = dout.sum(axis=0)
+    dhr = dout @ W2.T
+    dh = dhr * (h > 0.0)
+    gW1 = x.T @ dh
+    gb1 = dh.sum(axis=0)
+    return sq, [gW1, gb1, gW2, gb2]
+
+
+def main():
+    obs.enable()  # runlog + flight recorder arm from the pod env
+    pod = PodRuntime.from_env()
+    pod.init()
+    print(f"POD_READY rank={pod.rank} world={pod.world_size} "
+          f"gen={pod.gen}", flush=True)
+
+    ps_ep = os.environ.get("POD_FIX_PS_ENDPOINT")
+    if ps_ep:
+        # the cross-process client demo: every pod rank pulls from the
+        # (parent-hosted) PS over the real wire before training
+        from paddle_tpu.distributed.ps.client import PsClient
+        cli = PsClient([ps_ep])
+        cli.register_dense(0, 4)
+        vals = cli.pull_dense_init(0, np.zeros(4, np.float32))
+        print(f"PS_OK rank={pod.rank} n={int(np.asarray(vals).size)}",
+              flush=True)
+
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(IN_DIM, HID), nn.ReLU(),
+                          nn.Linear(HID, 1))
+    opt = paddle.optimizer.Momentum(parameters=model.parameters(),
+                                    learning_rate=0.05, momentum=0.9)
+    mgr = PodCheckpointManager(ROOT, pod=pod, timeout=60.0)
+    mgr.add_model(model).add_optimizer(opt)
+    params = list(model.parameters())
+
+    meta = mgr.restore()
+    step = (int(meta["step"]) + 1) if meta else 0
+    if meta:
+        print(f"RESUME_FROM {step}", flush=True)
+
+    while step < STEPS:
+        try:
+            faults.kill_point("pod/before_barrier")
+            pod.barrier(f"step{step}.g{pod.gen}", timeout=30.0)
+            x, y = _data(step)
+            lo, hi = pod.shard_range(BATCH)
+            host = [np.asarray(p._value) for p in params]
+            sq, grads = _forward_backward(host, x[lo:hi], y[lo:hi])
+            faults.kill_point("pod/mid_step")
+            flat = np.concatenate([g.ravel() for g in grads]
+                                  + [np.array([sq])])
+            # allreduce SUMS, then scale by the GLOBAL batch: exact for
+            # ragged shards too (a 3-survivor world splits 8 as 3/3/2)
+            mean = pod.allreduce(flat, name=f"grads{step}.g{pod.gen}",
+                                 timeout=30.0) / float(BATCH)
+            print(f"LOSS {step} {mean[-1]:.12e}", flush=True)
+            off = 0
+            for p, g in zip(params, grads):
+                n = g.size
+                p._grad = jnp.asarray(
+                    mean[off:off + n].reshape(g.shape).astype(np.float32))
+                off += n
+            opt.step()
+            opt.clear_grad()
+            if (step + 1) % CKPT_EVERY == 0:
+                mgr.save(step)
+                obs.memory.runlog_snapshot(rank=pod.origin, export=True)
+                print(f"CKPT {step}", flush=True)
+            step += 1
+        except (RankFailedError, BarrierTimeoutError,
+                PodCheckpointError) as e:
+            print(f"FAILURE_DETECTED t={time.time():.3f} "
+                  f"failed={getattr(e, 'ranks', [])} "
+                  f"err={type(e).__name__}", flush=True)
+            pod.reform(timeout=30.0)
+            print(f"REFORMED rank={pod.rank} world={pod.world_size} "
+                  f"gen={pod.gen}", flush=True)
+            meta = mgr.restore()
+            step = (int(meta["step"]) + 1) if meta else 0
+            print(f"RESUME_FROM {step}", flush=True)
+
+    obs.memory.runlog_snapshot(rank=pod.origin, export=True)
+    print(f"DONE rank={pod.rank} world={pod.world_size}", flush=True)
+    pod.shutdown()
+    obs.stop_run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

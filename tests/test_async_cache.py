@@ -583,7 +583,10 @@ class TestCtrPipelineParity:
         assert r_off["overlap_efficiency"] == 0.0
         assert 0.0 <= r_on["overlap_efficiency"] <= 1.0
 
+    @pytest.mark.slow  # ~18 s (PR 11 budget); cached-vs-uncached parity
     def test_cached_pipeline_matches_uncached_ps_path(self):
+        # stays tier-1 at smaller scale via
+        # test_prefetch_on_equals_off_bitwise_and_learns above
         cached, _ = self._run_cached(prefetch=True)
         uncached = self._run_uncached_window()
         assert abs(cached[-1] - uncached[-1]) <= 1e-6

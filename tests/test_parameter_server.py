@@ -235,7 +235,10 @@ class TestPsCluster:
             assert len(ls) == 200
             assert np.mean(ls[-10:]) < 0.35 < np.mean(ls[:5])
 
+    @pytest.mark.slow  # ~23 s subprocess cluster (PR 11 budget); async
     def test_async_two_workers_train_and_save(self, tmp_path):
+        # wire + save coverage stays tier-1 via TestNativeTableService
+        # and the Downpour two-thread run
         snap = str(tmp_path / "ps_snap")
         outs = _run_cluster("async", 2, extra={"PS_SAVE": snap})
         for out in outs:
@@ -246,9 +249,12 @@ class TestPsCluster:
         m = re.search(r"SPARSE_SIZE (\d+)", outs[0])
         assert m and int(m.group(1)) > 0
 
+    @pytest.mark.slow  # ~26 s subprocess cluster (PR 11 budget); key
     def test_sync_two_workers_two_servers_sharded(self):
         """Sparse keys shard across 2 server processes (key % nservers);
-        training still converges and every server holds a partition."""
+        training still converges and every server holds a partition.
+        (Key-range sharding itself stays tier-1 via the async_cache
+        write-back range-split tests.)"""
         outs = _run_cluster("sync", 2, n_servers=2)
         for out in outs:
             ls = _losses(out)
@@ -349,7 +355,9 @@ class TestDownpourTrainer:
 
 
 class TestPsGeoMultiWorker:
-    def test_geo_two_workers_k4_converge(self):
+    @pytest.mark.slow  # ~24 s subprocess cluster (PR 11 budget); geo
+    def test_geo_two_workers_k4_converge(self):  # delta semantics stay
+        # tier-1 via the in-process geo wire/communicator unit tests
         """2 workers, geo delta sync every 4 local steps (the reference
         GeoCommunicator's actual operating point): both converge."""
         outs = _run_cluster("geo", 2, extra={"PS_K_STEPS": "4"})
@@ -365,7 +373,9 @@ class TestHeterPs:
     exchanges activations with a trainer process owning the dense stage;
     activation grads flow back and sparse grads land on the PS."""
 
-    def test_heter_worker_trainer_pipeline(self):
+    @pytest.mark.slow  # ~12 s two-subprocess pipeline (PR 11 budget);
+    def test_heter_worker_trainer_pipeline(self):  # the heter overlap
+        # story is tier-1-covered by the async_cache CTR pipeline
         import subprocess
         import sys as _s
         import textwrap

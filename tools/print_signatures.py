@@ -34,6 +34,7 @@ MODULES = [
     "paddle_tpu.models",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet",
+    "paddle_tpu.distributed.pod",
     "paddle_tpu.distributed.ps",
     "paddle_tpu.quantization",
     "paddle_tpu.sparsity",
@@ -42,8 +43,10 @@ MODULES = [
     "paddle_tpu.observability.memory",
     "paddle_tpu.serving",
     "paddle_tpu.checkpoint",
+    "paddle_tpu.checkpoint.multihost",
     "paddle_tpu.testing",
     "paddle_tpu.testing.faults",
+    "paddle_tpu.testing.virtual_pod",
     "paddle_tpu.onnx",
     "paddle_tpu.incubate",
     "paddle_tpu.text",

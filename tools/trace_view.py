@@ -212,6 +212,26 @@ def build_chrome_trace(events, trace_filter=None):
     return {"traceEvents": out}
 
 
+def state_residency(events):
+    """Pod-wide state residency from the LAST ``memory_snapshot`` event
+    of each process log: ``(per_category_bytes, n_ranks)``. Each rank's
+    ledger (``memory.runlog_snapshot``, rank-tagged) counts what THAT
+    process holds; summing the latest snapshot per rank is the
+    multi-host total a single-process scrape can't see."""
+    last = {}
+    for r in events:
+        if r.get("kind") == "event" and r.get("event") == "memory_snapshot":
+            key = (r["_file"], r.get("rank", r.get("process", "0")))
+            if key not in last or r.get("t", 0) >= last[key].get("t", 0):
+                last[key] = r
+    cats = collections.Counter()
+    for r in last.values():
+        for cat, slot in ((r.get("state") or {}).get("categories")
+                          or {}).items():
+            cats[cat] += int(slot.get("bytes", 0))
+    return dict(cats), len(last)
+
+
 def print_stats(events, n_bad, file=None):
     file = file if file is not None else sys.stdout
     spans = [r for r in events if r.get("kind") == "span"]
@@ -229,6 +249,15 @@ def print_stats(events, n_bad, file=None):
     if by_event:
         print("  events: " + ", ".join(f"{k}={v}" for k, v in
                                        sorted(by_event.items())),
+              file=file)
+    cats, n_ranks = state_residency(events)
+    if cats:
+        total = sum(cats.values())
+        print(f"  state residency (last snapshot per rank, summed over "
+              f"{n_ranks} rank(s), {total / 1e6:.3f} MB): "
+              + ", ".join(f"{c}={b / 1e6:.3f}MB"
+                          for c, b in sorted(cats.items(),
+                                             key=lambda kv: -kv[1])),
               file=file)
     top = traces.most_common(5)
     if top:
