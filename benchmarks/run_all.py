@@ -721,6 +721,61 @@ def bench_memory(n_virtual=8):
         parallel_env.set_mesh(None)
 
 
+def bench_pod_recovery():
+    """Elastic recovery wall time: a 2-process virtual pod, rank 1
+    SIGKILLed mid-step, supervised respawn under the shared
+    RestartPolicy — the row is seconds from the supervisor reaping the
+    kill to the HEALED world's resumed training (detect -> shrink
+    reform -> respawn -> lobby -> grow reform -> elastic restore ->
+    resume). The number that bounds how fast a preempted rank comes
+    back at full throughput."""
+    import re
+    import shutil
+    import tempfile
+
+    from paddle_tpu.testing.virtual_pod import RestartPolicy, VirtualPod
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fixture = os.path.join(repo, "tests", "fixtures",
+                           "virtual_pod_fixture.py")
+    wd = tempfile.mkdtemp(prefix="pt_pod_recovery_")
+    root = os.path.join(wd, "ck")
+    try:
+        pod = VirtualPod(
+            2, fixture, workdir=wd, kill=(1, "pod/mid_step", 5),
+            lease_ttl=2.0,
+            restart=RestartPolicy(max_restarts=2, base_delay=0.2, seed=0),
+            env={"POD_FIX_CKPT_ROOT": root, "POD_FIX_TARGET_WORLD": "2",
+                 "POD_FIX_HEAL_BY_STEP": "6"})
+        exits = pod.run(timeout=240)
+        kills = [e for e in pod.exit_history
+                 if e.rank == 1 and e.signal == "SIGKILL"]
+        log0 = pod.log(0)
+        grow = None
+        for m in re.finditer(r"REFORMED rank=\d+ world=(\d+) gen=(\d+) "
+                             r"dir=grow t=([\d.]+)", log0):
+            grow = m
+        resume = None
+        if grow is not None:
+            resume = re.search(r"RESUME_FROM \d+ t=([\d.]+)",
+                               log0[grow.end():])
+        if not kills or resume is None:
+            raise RuntimeError(
+                "pod recovery cycle did not complete: "
+                f"exits={exits} log0 tail: {log0[-800:]}")
+        recovery_s = float(resume.group(1)) - kills[0].t_reaped
+        healed_gen = int(grow.group(2))
+    finally:
+        shutil.rmtree(wd, ignore_errors=True)
+    return {"metric": "pod_recovery_s", "value": round(recovery_s, 2),
+            "unit": "s", "direction": "lower", "backend": "cpu",
+            "world": 2, "healed_gen": healed_gen,
+            "note": "SIGKILL reap -> shrink reform -> supervised "
+            "respawn (RestartPolicy backoff) -> lobby join -> grow "
+            "reform -> elastic restore -> first healed resume; "
+            "includes one full python+jax process boot (~2-4s of it)"}
+
+
 def bench_bert():
     """Config 3: the flagship BERT pretraining step — bench.py run as a
     subprocess (it owns program structure, OOM fallback and timing) with
@@ -737,7 +792,8 @@ BENCHES = {"resnet": bench_resnet50, "gpt": bench_gpt_sharding_pp,
            "hbm_cache": bench_hbm_cache, "ctr": bench_ctr,
            "serving": bench_serving, "checkpoint": bench_checkpoint,
            "tracing_overhead": bench_tracing_overhead,
-           "memory": bench_memory, "bert": bench_bert}
+           "memory": bench_memory, "pod_recovery": bench_pod_recovery,
+           "bert": bench_bert}
 
 
 def run_benches(configs):
@@ -772,7 +828,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="resnet,gpt,allreduce,detection,"
                     "hbm_cache,ctr,serving,checkpoint,tracing_overhead,"
-                    "memory,bert")
+                    "memory,pod_recovery,bert")
     ap.add_argument("--out", help="write the run's records as a JSON file")
     ap.add_argument("--results", help="gate a previously recorded results "
                     "JSON instead of running the ladder")

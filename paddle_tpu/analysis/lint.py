@@ -42,6 +42,18 @@ Two surfaces:
     (``distributed.pod.PodRuntime.barrier`` raises
     ``BarrierTimeoutError`` naming the absent ranks). Scanned by
     default over ``distributed/`` (``BARRIER_PATHS``).
+  * ``respawn-without-backoff``: a retry-shaped loop (``while`` or
+    ``for range(...)``) that spawns/relaunches a PROCESS with no
+    backoff/budget evidence — an ERROR. An unpaced respawn loop turns a
+    crash-looping rank into a machine-burning fork bomb (and a fleet of
+    supervisors restarting after a shared-cause outage into a
+    thundering herd); route every relaunch through
+    ``distributed.restart.RestartPolicy`` (bounded budget + exponential
+    backoff + seedable jitter — the pod supervisor and
+    ``fleet/elastic.py``'s relaunch path share it). Per-item fan-outs
+    (one spawn per trainer in a ``for t in trainers`` loop) are not
+    retry loops and are exempt. Scanned by default over
+    ``distributed/`` + ``fleet/elastic.py`` (``RESPAWN_PATHS``).
 """
 import ast
 import os
@@ -49,7 +61,7 @@ import os
 from .findings import ERROR, WARNING, Finding
 
 __all__ = ["lint_program", "lint_source", "HOT_PATHS", "RPC_PATHS",
-           "SPAN_PATHS", "BARRIER_PATHS"]
+           "SPAN_PATHS", "BARRIER_PATHS", "RESPAWN_PATHS"]
 
 # host-callback op names: each is a device->host round-trip inside the
 # compiled program (stalls the TPU pipeline every step)
@@ -110,6 +122,29 @@ BARRIER_PATHS = (
 _BARRIER_TIMEOUT_KWARGS = frozenset({"timeout", "deadline", "timeout_s",
                                      "io_timeout", "deadline_s"})
 _BARRIER_TIMEOUT_HINTS = ("timeout", "deadline")
+
+# multi-process paths scanned by default for respawn-without-backoff
+# (fleet/elastic.py lives under distributed/, named for emphasis: its
+# relaunch path is the reference's restart loop)
+RESPAWN_PATHS = (
+    os.path.join("paddle_tpu", "distributed"),
+    os.path.join("paddle_tpu", "distributed", "fleet", "elastic.py"),
+    os.path.join("paddle_tpu", "testing", "virtual_pod.py"),
+)
+
+# call names that mark a statement as spawning/relaunching a process
+_SPAWN_CALL_HINTS = frozenset({
+    "Popen", "spawn", "spawn_fn", "spawn_trainer", "start_local_trainers",
+    "relaunch", "respawn", "start_process", "_spawn_rank", "Process",
+})
+
+# evidence that a respawn loop paces itself / bounds its budget
+# (NOT "wait": proc.wait() is child-reaping, the signature move of the
+# very keep-alive loop this rule exists to flag)
+_RESPAWN_EVIDENCE_CALLS = frozenset({"sleep", "schedule",
+                                     "next_delay", "allow"})
+_RESPAWN_EVIDENCE_NAMES = ("backoff", "budget", "policy", "restart",
+                           "delay", "not_before", "deadline")
 
 # call names that mark a statement as an RPC/socket round-trip
 _RPC_CALL_HINTS = frozenset({
@@ -336,6 +371,72 @@ class _RetryLoopChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RespawnChecker(ast.NodeVisitor):
+    """Flags retry-shaped loops that spawn/relaunch a process with no
+    backoff/budget evidence (see module docstring). The loop-variable
+    heuristic from the retry rule exempts fan-outs: a spawn call whose
+    arguments consume the loop variable launches one process per item
+    (``for t in trainers: spawn_trainer(..., t, ...)``), it does not
+    RE-launch the same one."""
+
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+
+    @staticmethod
+    def _loop_facts(body_nodes, loop_vars):
+        has_spawn = has_evidence = False
+        for node in body_nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    chain = _attr_chain(sub.func) or ""
+                    leaf = chain.split(".")[-1]
+                    if leaf in _SPAWN_CALL_HINTS:
+                        arg_names = {
+                            n.id for a in list(sub.args)
+                            + [kw.value for kw in sub.keywords]
+                            for n in ast.walk(a)
+                            if isinstance(n, ast.Name)}
+                        if not (loop_vars & arg_names):
+                            has_spawn = True
+                    if leaf in _RESPAWN_EVIDENCE_CALLS:
+                        has_evidence = True
+                elif isinstance(sub, (ast.Name, ast.Attribute)):
+                    ident = (sub.id if isinstance(sub, ast.Name)
+                             else sub.attr).lower()
+                    if any(h in ident for h in _RESPAWN_EVIDENCE_NAMES):
+                        has_evidence = True
+        return has_spawn, has_evidence
+
+    def _check(self, node):
+        loop_vars = set()
+        target = getattr(node, "target", None)
+        if target is not None:
+            loop_vars = {n.id for n in ast.walk(target)
+                         if isinstance(n, ast.Name)}
+        has_spawn, has_evidence = self._loop_facts(node.body, loop_vars)
+        if has_spawn and not has_evidence:
+            self.findings.append(Finding(
+                "respawn-without-backoff", ERROR,
+                "loop spawns/relaunches a process with no backoff or "
+                "budget evidence — a crash-looping child gets relaunched "
+                "as fast as fork can fail; route the respawn through "
+                "distributed.restart.RestartPolicy (bounded budget + "
+                "exponential backoff with jitter)",
+                loc=f"{self.path}:{node.lineno}"))
+
+    def visit_While(self, node):
+        self._check(node)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        chain = _attr_chain(node.iter.func) if isinstance(node.iter,
+                                                         ast.Call) else None
+        if chain and chain.split(".")[-1] == "range":
+            self._check(node)
+        self.generic_visit(node)
+
+
 class _BarrierChecker(ast.NodeVisitor):
     """Flags ``barrier(...)`` calls with no deadline evidence.
 
@@ -473,9 +574,9 @@ def _expand_py(entries, repo_root):
 def lint_source(paths=None, repo_root=None):
     """AST-lint python sources. Default: the registered hot-path files,
     the RPC client paths, the span-instrumented modules, and — for the
-    barrier rule only — every file under ``BARRIER_PATHS``; or every
-    file in ``paths`` (all rules). Returns findings; files that fail to
-    parse are reported, not raised."""
+    barrier + respawn rules only — every file under ``BARRIER_PATHS`` /
+    ``RESPAWN_PATHS``; or every file in ``paths`` (all rules). Returns
+    findings; files that fail to parse are reported, not raised."""
     if repo_root is None:
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
@@ -489,10 +590,12 @@ def lint_source(paths=None, repo_root=None):
         targets.extend(os.path.join(repo_root, p) for p in RPC_PATHS)
         targets.extend(os.path.join(repo_root, p) for p in SPAN_PATHS)
         full_rule_files = {os.path.abspath(p) for p in targets}
-        barrier_files = _expand_py(BARRIER_PATHS, repo_root)
-        # files reached ONLY through BARRIER_PATHS get just the barrier
-        # rule — widening the default sweep to a whole package must not
-        # retroactively subject every file in it to every rule
+        barrier_files = _expand_py(BARRIER_PATHS + RESPAWN_PATHS,
+                                   repo_root)
+        # files reached ONLY through BARRIER_PATHS/RESPAWN_PATHS get
+        # just the multi-process rules — widening the default sweep to a
+        # whole package must not retroactively subject every file in it
+        # to every rule
         barrier_only = {os.path.abspath(p) for p in barrier_files
                         if os.path.abspath(p) not in full_rule_files}
         targets.extend(barrier_files)
@@ -511,6 +614,7 @@ def lint_source(paths=None, repo_root=None):
                 "syntax-error", ERROR, str(e), loc=f"{rel}:{e.lineno}"))
             continue
         _BarrierChecker(rel, findings).visit(tree)
+        _RespawnChecker(rel, findings).visit(tree)
         if path in barrier_only:
             continue
         _TracedFnChecker(rel, findings).visit(tree)

@@ -63,6 +63,10 @@ POD_KILL_POINTS = (
     "checkpoint/pod_before_commit",
     "checkpoint/pod_after_commit",
 )
+# read-side point (not part of the write-stage sweep): a rank killed
+# mid-RESTORE — e.g. a replacement dying during its own elastic restore
+# after a reform-up — leaves the published checkpoint untouched
+POD_RESTORE_KILL_POINT = "checkpoint/pod_restore"
 
 
 class PodCheckpointError(core.CheckpointError):
@@ -576,6 +580,10 @@ class PodCheckpointManager:
         found = read_pod_checkpoint(self.root, step=step, fs=self._fs)
         if found is None:
             return None
+        # a rank dying DURING its restore (the chaos tier kills a
+        # replacement here) must leave the checkpoint untouched on disk
+        # and the survivors free to re-form — restore only ever reads
+        _faults.kill_point(POD_RESTORE_KILL_POINT)
         got_step, by_rank, meta = found
         saved_ranks = sorted(by_rank)
         want = sorted((meta.get("pod") or {}).get(

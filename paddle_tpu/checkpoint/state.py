@@ -193,7 +193,11 @@ def _restore_store(store, brec, srec, mesh):
     """Write saved shard rows back into a live flat store, re-flattening
     for the live shard degree (elastic resume): concatenate the saved
     shards, trim the OLD degree's padding rows, re-pad to the live row
-    count, and place 1/degree on the live mesh."""
+    count, and place 1/degree on the live mesh. Works in BOTH
+    directions — shrink (fewer, larger shards) and GROW (the reform-up
+    path: live degree > saved degree, so the logical rows re-pad out to
+    MORE shards); the bucket layout below the padding is
+    degree-invariant either way."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding

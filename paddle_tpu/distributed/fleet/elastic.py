@@ -7,6 +7,13 @@ e.g. NFS/GCS-fuse) is the built-in backend — heartbeat files with mtime TTL
 replace etcd leases; an etcd-shaped client can be passed instead. Membership
 changes re-rank hosts deterministically (sorted endpoints) and invoke the
 relaunch callback, matching the reference's scale-in/scale-out semantics.
+
+The relaunch half (:meth:`ElasticManager.relaunch`) paces itself
+through the SAME :class:`~paddle_tpu.distributed.restart.RestartPolicy`
+the pod supervisor uses — bounded budget + exponential backoff with
+jitter — so a node-level elastic restart and a rank-level pod respawn
+obey one policy surface (and both satisfy the
+``respawn-without-backoff`` lint rule by construction).
 """
 import json
 import os
@@ -15,8 +22,10 @@ import socketserver
 import threading
 import time
 
+from ..restart import RestartPolicy
+
 __all__ = ["FileKVStore", "TcpKVStore", "KVServer", "start_kv_server",
-           "ElasticManager", "ElasticStatus"]
+           "ElasticManager", "ElasticStatus", "RestartPolicy"]
 
 
 class ElasticStatus:
@@ -298,6 +307,55 @@ class ElasticManager:
             i += 1
             if max_iter is not None and i >= max_iter:
                 return ElasticStatus.COMPLETED, cur
+
+    # -- relaunch (reference: watch -> launcher restart) --------------------
+    def relaunch(self, spawn_fn, policy=None, watch_interval=0.5,
+                 wait_ready_timeout=60.0):
+        """Run the local trainer under the watch→restart loop
+        (reference: ``elastic.py watch:316`` feeding the launcher's
+        restart): spawn via ``spawn_fn()`` (returns a process-like
+        object with ``poll()``/``terminate()``), then RELAUNCH it —
+        paced by the shared :class:`RestartPolicy` — whenever the child
+        dies abnormally or the live membership changes while the job
+        can still reach ``np`` nodes.
+
+        Returns ``(status, proc)``: ``COMPLETED`` (clean child exit
+        under stable membership, ``proc`` is the finished handle),
+        ``EXIT`` (restart budget exhausted — the KV-relaunch analog of
+        the pod supervisor's ``pod_respawn_denied``), or ``HOLD``
+        (membership fell below ``np`` and never recovered within
+        ``wait_ready_timeout``)."""
+        policy = policy if policy is not None else RestartPolicy()
+        proc = spawn_fn()
+        baseline = self.live_nodes()
+        while True:
+            time.sleep(watch_interval)
+            ret = proc.poll()
+            cur = self.live_nodes()
+            if ret is None and cur == baseline:
+                continue  # healthy child, stable membership
+            if ret == 0 and cur == baseline:
+                return ElasticStatus.COMPLETED, proc
+            # child died abnormally, or membership changed: tear the old
+            # child ALL the way down first — the replacement reuses its
+            # rendezvous port / KV lease / log files, so spawning while
+            # the predecessor still drains would dud the relaunch
+            if ret is None:
+                proc.terminate()
+                deadline = time.time() + 30.0
+                while proc.poll() is None and time.time() < deadline:
+                    time.sleep(min(watch_interval, 0.1))
+            if len(cur) < self.np and not self.wait_ready(
+                    timeout=wait_ready_timeout):
+                # not enough nodes to relaunch into — a membership dip
+                # is not a restart attempt, so the budget is untouched
+                return ElasticStatus.HOLD, None
+            delay = policy.schedule(self.endpoint)
+            if delay is None:
+                return ElasticStatus.EXIT, None
+            time.sleep(delay)
+            proc = spawn_fn()
+            baseline = self.live_nodes()
 
     def exit(self):
         self._stop.set()

@@ -18,7 +18,7 @@ import subprocess
 import sys
 import time
 
-__all__ = ["Cluster", "Pod", "Trainer", "get_cluster",
+__all__ = ["Cluster", "Pod", "Trainer", "get_cluster", "spawn_trainer",
            "start_local_trainers", "watch_local_trainers", "main"]
 
 
@@ -78,36 +78,46 @@ class TrainerProc:
         self.log_f = log_f
 
 
+def spawn_trainer(cluster, trainer, training_script, training_script_args,
+                  log_dir=None, envs=None, log_mode="w"):
+    """Spawn ONE trainer process with the cluster env contract —
+    ``start_local_trainers``' per-trainer body, exposed so a supervisor
+    (``distributed.pod.PodSupervisor``) can relaunch a single
+    REPLACEMENT rank without re-spawning the pod. ``log_mode="a"``
+    appends to the rank's existing ``workerlog.<rank>`` so an origin's
+    incarnations share one log."""
+    endpoints = cluster.trainers_endpoints()
+    env = dict(os.environ)
+    env.update(envs or {})
+    env.update({
+        "PADDLE_TRAINER_ID": str(trainer.rank),
+        "PADDLE_CURRENT_ENDPOINT": trainer.endpoint,
+        "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        # JAX coordination-service mapping (multi-host bring-up)
+        "JAX_COORDINATOR_ADDRESS": endpoints[0],
+        "JAX_NUM_PROCESSES": str(cluster.trainers_nranks()),
+        "JAX_PROCESS_ID": str(trainer.rank),
+    })
+    cmd = [sys.executable, "-u", training_script] + \
+        list(training_script_args)
+    log_f = None
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        log_f = open(os.path.join(log_dir, f"workerlog.{trainer.rank}"),
+                     log_mode)
+    proc = subprocess.Popen(cmd, env=env, stdout=log_f or None,
+                            stderr=subprocess.STDOUT if log_f else None)
+    return TrainerProc(proc, trainer.rank, log_f)
+
+
 def start_local_trainers(cluster, pod, training_script, training_script_args,
                          log_dir=None, envs=None):
     """Spawn one POSIX process per local trainer with the env contract
     (reference: launch_utils.py start_local_trainers:453)."""
-    procs = []
-    endpoints = cluster.trainers_endpoints()
-    coordinator = endpoints[0].rsplit(":", 1) if endpoints else None
-    for t in pod.trainers:
-        env = dict(os.environ)
-        env.update(envs or {})
-        env.update({
-            "PADDLE_TRAINER_ID": str(t.rank),
-            "PADDLE_CURRENT_ENDPOINT": t.endpoint,
-            "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-            # JAX coordination-service mapping (multi-host bring-up)
-            "JAX_COORDINATOR_ADDRESS": endpoints[0],
-            "JAX_NUM_PROCESSES": str(cluster.trainers_nranks()),
-            "JAX_PROCESS_ID": str(t.rank),
-        })
-        cmd = [sys.executable, "-u", training_script] + \
-            list(training_script_args)
-        log_f = None
-        if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            log_f = open(os.path.join(log_dir, f"workerlog.{t.rank}"), "w")
-        proc = subprocess.Popen(cmd, env=env, stdout=log_f or None,
-                                stderr=subprocess.STDOUT if log_f else None)
-        procs.append(TrainerProc(proc, t.rank, log_f))
-    return procs
+    return [spawn_trainer(cluster, t, training_script,
+                          training_script_args, log_dir=log_dir, envs=envs)
+            for t in pod.trainers]
 
 
 def signal_name(exitcode):

@@ -37,13 +37,41 @@ death a *detected, recoverable* event for the survivors:
   generation, fresh leases — and drive the PR-7 elastic restore path
   (``checkpoint.multihost``) to continue from the last
   rank-0-committed multi-process checkpoint.
+- **Elastic scale-UP** (the heal-and-grow half): the coordinator keeps
+  a **lobby** — a join arriving after formation (a supervised
+  replacement for a reaped rank, or a net-new rank scaling the job out)
+  is parked there *without disturbing the running generation*.
+  Survivors learn of parked joiners at window boundaries
+  (:meth:`PodRuntime.pending_joiners`) and the next :meth:`reform`
+  admits them: the world GROWS — survivors keep their dense re-rank
+  (the committer is always an incumbent while any survive), joiners
+  append in origin order, generation + 1, fresh leases, stale-gen ops
+  still rejected loudly — and every rank (incumbent and replacement
+  alike) restores from the latest rank-0-committed pod checkpoint at
+  the new dp degree through the elastic re-flattening, so the grown
+  world resumes from one consistent step. :class:`PodSupervisor` is the
+  production launcher for this loop: it hosts the coordinator, spawns
+  the ranks, marks reaped children failed (the fast detection path) and
+  **respawns replacements** under a shared
+  :class:`~paddle_tpu.distributed.restart.RestartPolicy` (bounded
+  budget + exponential backoff with jitter — the same policy object
+  ``fleet/elastic.py``'s relaunch path uses).
+- **Straggler detection**: the coordinator already timestamps every
+  lease; it also keeps per-rank heartbeat-gap histories, exported as
+  ``pod_rank_heartbeat_ms{rank=,q=}`` gauges, queryable via
+  :meth:`PodCoordinator.stragglers` / :meth:`PodRuntime.stragglers`,
+  and edge-triggered ``pod_straggler`` run-log events — a slow-but-
+  alive rank becomes visible *before* its lease expires and it becomes
+  a failure.
 
 Env contract (:meth:`PodRuntime.from_env`):
 ``PADDLE_POD_COORDINATOR`` (host:port), ``PADDLE_TRAINERS_NUM``,
 ``PADDLE_TRAINER_ID``, and the knobs ``PADDLE_POD_LEASE_TTL`` /
-``PADDLE_POD_HEARTBEAT_S`` / ``PADDLE_POD_BARRIER_TIMEOUT``.
+``PADDLE_POD_HEARTBEAT_S`` / ``PADDLE_POD_BARRIER_TIMEOUT`` /
+``PADDLE_POD_JOIN_TIMEOUT``.
 """
 import base64
+import collections
 import json
 import os
 import secrets
@@ -54,9 +82,21 @@ import time
 
 import numpy as np
 
-__all__ = ["PodRuntime", "PodCoordinator", "start_coordinator",
+from .restart import RestartPolicy
+
+__all__ = ["PodRuntime", "PodCoordinator", "PodSupervisor", "RankExit",
+           "RestartPolicy", "start_coordinator",
            "PodError", "RankFailedError", "BarrierTimeoutError",
            "StaleGenerationError"]
+
+
+def _runlog_event(what, **fields):
+    """Best-effort run-log event (coordinator AND runtime side)."""
+    try:
+        from ..observability import runlog
+        runlog.event(what, **fields)
+    except Exception:
+        pass
 
 
 class PodError(RuntimeError):
@@ -112,9 +152,15 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
     def __init__(self, addr=("127.0.0.1", 0), expected=None,
-                 lease_ttl=3.0, monitor_interval=None):
+                 lease_ttl=3.0, monitor_interval=None,
+                 straggler_threshold=None):
         self.expected = expected
         self.lease_ttl = float(lease_ttl)
+        # a rank whose heartbeat gap exceeds this (but not yet the ttl)
+        # is a STRAGGLER: visible before it becomes a failure
+        self.straggler_threshold = (self.lease_ttl / 2.0
+                                    if straggler_threshold is None
+                                    else float(straggler_threshold))
         self.uid = secrets.token_hex(16)  # the "uniqueId" every rank gets
         self.gen = 0
         self._members = {}   # rank -> {"origin", "pid", "endpoint"}
@@ -125,6 +171,10 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
         self._colls = {}     # (gen, name) -> {"parts", "result", "done"}
         self._reforms = {}   # gen -> set(ranks)
         self._reform_result = {}  # old gen -> {"gen", "map"}
+        self._lobby = {}     # origin -> joiner info, parked until reform
+        self._admitted = {}  # origin -> {"gen","rank","world"} (post-reform)
+        self._hb_gaps = {}   # origin -> deque of heartbeat gaps (seconds)
+        self._straggling = set()  # origins currently past the threshold
         self._cond = threading.Condition()
         self._closed = False
         super().__init__(addr, _PodHandler)
@@ -143,12 +193,21 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
     def mark_failed(self, origin, reason):
         """Mark the member with ORIGIN trainer id failed (the supervisor
         fast path: a reaped child is dead *now*, no need to wait out the
-        lease)."""
+        lease). A dead LOBBY joiner is swept out of the lobby instead —
+        admitting a corpse at the next reform would hang the grown
+        world's first barrier."""
         with self._cond:
             for rank, info in self._members.items():
                 if info["origin"] == origin:
                     self._mark_failed_locked(rank, reason)
                     return True
+            if origin in self._lobby:
+                self._lobby.pop(origin, None)
+                self._failure_log.append(
+                    {"origin": origin, "reason": reason, "t": time.time(),
+                     "member": False, "lobby": True})
+                self._cond.notify_all()  # wake its blocked join
+                return False
             self._failure_log.append(
                 {"origin": origin, "reason": reason, "t": time.time(),
                  "member": False})
@@ -161,8 +220,55 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
                 "members": {r: dict(m) for r, m in self._members.items()},
                 "failed": {r: dict(f) for r, f in self._failed.items()},
                 "failure_log": list(self._failure_log),
+                "lobby": {o: dict(j) for o, j in self._lobby.items()},
                 "lease_ttl": self.lease_ttl,
             }
+
+    def heartbeat_stats(self):
+        """Per-rank heartbeat-gap stats: ``{origin: {"last_ms", "p50_ms",
+        "p95_ms", "max_ms", "n"}}`` over the recent gap history (live
+        members only). ``last_ms`` is the CURRENT lease age — the number
+        that grows while a rank is wedged."""
+        with self._cond:
+            now = time.time()
+            out = {}
+            for rank, info in self._members.items():
+                if rank in self._failed:
+                    continue
+                origin = info["origin"]
+                lease = self._leases.get(rank)
+                rec = {"n": len(self._hb_gaps.get(origin, ()))}
+                if lease is not None:
+                    rec["last_ms"] = round((now - lease) * 1e3, 3)
+                gaps = sorted(self._hb_gaps.get(origin, ()))
+                if gaps:
+                    rec["p50_ms"] = round(gaps[len(gaps) // 2] * 1e3, 3)
+                    rec["p95_ms"] = round(
+                        gaps[min(len(gaps) - 1,
+                                 int(round((len(gaps) - 1) * 0.95)))]
+                        * 1e3, 3)
+                    rec["max_ms"] = round(gaps[-1] * 1e3, 3)
+                out[origin] = rec
+            return out
+
+    def stragglers(self, threshold=None):
+        """Origins of LIVE ranks whose current heartbeat gap exceeds
+        ``threshold`` seconds (default: the configured straggler
+        threshold) — slow but not yet lease-expired. The early-warning
+        query: these ranks are stretching every barrier today and are
+        the next lease expiries tomorrow."""
+        thr = (self.straggler_threshold if threshold is None
+               else float(threshold))
+        with self._cond:
+            now = time.time()
+            out = []
+            for rank, info in self._members.items():
+                if rank in self._failed:
+                    continue
+                lease = self._leases.get(rank)
+                if lease is not None and now - lease > thr:
+                    out.append(info["origin"])
+            return sorted(out)
 
     def close(self):
         self._closed = True
@@ -204,6 +310,52 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
                             rank, f"lease expired ({now - lease:.2f}s > "
                                   f"ttl {self.lease_ttl:.2f}s without a "
                                   "heartbeat)")
+                self._observe_stragglers_locked(now)
+
+    def _observe_stragglers_locked(self, now):
+        """One straggler sweep: edge-triggered ``pod_straggler`` run-log
+        events (re-armed once the rank recovers under threshold/2) and
+        per-rank ``pod_rank_heartbeat_ms{rank=,q=}`` gauges. Best-effort
+        — a metrics error must never take the lease monitor down."""
+        try:
+            thr = self.straggler_threshold
+            gaps_now = {}
+            for rank, info in self._members.items():
+                if rank in self._failed:
+                    continue
+                lease = self._leases.get(rank)
+                if lease is not None:
+                    gaps_now[info["origin"]] = now - lease
+            for origin, gap in gaps_now.items():
+                if gap > thr and gap <= self.lease_ttl \
+                        and origin not in self._straggling:
+                    self._straggling.add(origin)
+                    _runlog_event("pod_straggler", origin=origin,
+                                  gap_ms=round(gap * 1e3, 1),
+                                  threshold_ms=round(thr * 1e3, 1),
+                                  gen=self.gen)
+                    try:
+                        from .. import monitor
+                        monitor.stat_add("pod_stragglers_total", 1)
+                    except Exception:
+                        pass
+                elif gap <= thr / 2.0 and origin in self._straggling:
+                    self._straggling.discard(origin)
+            from ..observability import export
+            for origin, gap in gaps_now.items():
+                series = {"last": gap}
+                hist = sorted(self._hb_gaps.get(origin, ()))
+                if hist:
+                    series["p50"] = hist[len(hist) // 2]
+                    series["p95"] = hist[min(len(hist) - 1,
+                                             int(round((len(hist) - 1)
+                                                       * 0.95)))]
+                for q, v in series.items():
+                    name = "pod_rank_heartbeat_ms" + export.format_labels(
+                        "pod_rank_heartbeat_ms", rank=origin, q=q)
+                    export.set_gauge(name, round(v * 1e3, 3))
+        except Exception:
+            pass
 
     def _failed_snapshot_locked(self):
         return [dict(f) for f in self._failed.values()]
@@ -225,13 +377,21 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
         nprocs = int(req["nprocs"])
         deadline = time.time() + float(req.get("timeout", 60.0))
         with self._cond:
+            formed = (self.expected is not None
+                      and len(self._members) >= self.expected) \
+                or self.gen != 0
+            if formed:
+                # post-formation join: a replacement (or net-new) rank
+                # parks in the LOBBY until the next reform admits it —
+                # the running generation is not disturbed, and nprocs
+                # is irrelevant (the world may have shrunk since launch)
+                return self._lobby_join_locked(int(req.get("origin", rank)),
+                                               req, deadline)
             if self.expected is None:
                 self.expected = nprocs
             if nprocs != self.expected:
                 return {"ok": False, "error": "world_mismatch",
                         "expected": self.expected}
-            if self.gen != 0:
-                return {"ok": False, "error": "stale_gen", "gen": self.gen}
             self._members[rank] = {"origin": int(req.get("origin", rank)),
                                    "pid": req.get("pid"),
                                    "endpoint": req.get("endpoint")}
@@ -264,12 +424,75 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
                     "world": sorted(self._members), "uid": self.uid,
                     "lease_ttl": self.lease_ttl}
 
+    def _lobby_join_locked(self, origin, req, deadline):
+        """Park a post-formation joiner until a reform admits it. The
+        connection thread blocks here (the joiner's ``init()`` is
+        waiting on this reply); admission data lands in ``_admitted``
+        when the survivors' next :meth:`reform` grows the world."""
+        # a FAILED member no longer owns its origin: it stays in
+        # `_members` until the survivors' reform rebuilds the roster,
+        # and a fast supervisor respawn can land here before that —
+        # the replacement must PARK, not bounce (bouncing would burn a
+        # RestartPolicy attempt per incarnation until the budget dies)
+        if any(m["origin"] == origin for r, m in self._members.items()
+               if r not in self._failed):
+            return {"ok": False, "error": "duplicate_origin",
+                    "origin": origin,
+                    "detail": f"origin {origin} is already a live member "
+                              "— a replacement may only join after its "
+                              "predecessor was marked failed"}
+        self._lobby[origin] = {"origin": origin, "pid": req.get("pid"),
+                               "endpoint": req.get("endpoint"),
+                               "t": time.time()}
+        _runlog_event("pod_lobby_join", origin=origin, gen=self.gen,
+                      world=len(self._members))
+        self._cond.notify_all()
+        while origin not in self._admitted:
+            if origin not in self._lobby:
+                # swept by mark_failed while parked: the joiner process
+                # is dead (or was evicted) — tell whoever is listening
+                return {"ok": False, "error": "rank_failed",
+                        "failed": [{"origin": origin,
+                                    "reason": "removed from lobby before "
+                                              "admission"}]}
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                self._lobby.pop(origin, None)
+                return {"ok": False, "error": "join_timeout",
+                        "lobby": True,
+                        "detail": "no reform admitted this joiner within "
+                                  "the join timeout — survivors check "
+                                  "pending_joiners() at window boundaries"}
+            self._cond.wait(min(remaining, 0.25))
+        adm = self._admitted.pop(origin)
+        return {"ok": True, "gen": adm["gen"], "rank": adm["rank"],
+                "world": adm["world"], "uid": self.uid,
+                "lease_ttl": self.lease_ttl, "joined": "lobby"}
+
+    def _op_pending_joiners(self, req):
+        with self._cond:
+            return {"ok": True, "gen": self.gen,
+                    "joiners": [dict(self._lobby[o])
+                                for o in sorted(self._lobby)]}
+
+    def _op_stragglers(self, req):
+        thr = req.get("threshold")
+        return {"ok": True,
+                "stragglers": self.stragglers(
+                    None if thr is None else float(thr))}
+
     def _op_heartbeat(self, req):
         origin = int(req["origin"])
         with self._cond:
             for rank, info in self._members.items():
                 if info["origin"] == origin and rank not in self._failed:
-                    self._leases[rank] = time.time()
+                    now = time.time()
+                    prev = self._leases.get(rank)
+                    if prev is not None:
+                        self._hb_gaps.setdefault(
+                            origin, collections.deque(maxlen=128)).append(
+                            now - prev)
+                    self._leases[rank] = now
                     break
             return {"ok": True, "gen": self.gen,
                     "failed": self._failed_snapshot_locked()}
@@ -421,27 +644,43 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
                     "world": res["world"], "uid": self.uid}
 
     def _do_reform_locked(self, old_gen, survivors):
-        """Shrink to the survivors: dense re-rank (sorted by old rank),
-        new generation, fresh leases, failure set cleared (the log
-        keeps history). Pending old-gen barriers/collectives wake with
-        ``stale_gen``."""
+        """Re-form around the survivors AND the lobby: dense re-rank of
+        the survivors (sorted by old rank — the committer, rank 0, stays
+        an incumbent while any survive), lobby joiners appended in
+        origin order (the world GROWS when the lobby is non-empty), new
+        generation, fresh leases for everyone, failure set cleared (the
+        log keeps history). Pending old-gen barriers/collectives wake
+        with ``stale_gen``; each admitted joiner's blocked join returns
+        with its new rank."""
         mapping = {old: new for new, old in enumerate(sorted(survivors))}
         now = time.time()
-        self._members = {mapping[old]: self._members[old]
-                         for old in sorted(survivors)}
-        self._leases = {mapping[old]: now for old in sorted(survivors)}
-        # the re-formed pod IS fully formed at the smaller size: shrink
+        members = {mapping[old]: self._members[old]
+                   for old in sorted(survivors)}
+        admitted = sorted(self._lobby)
+        for origin in admitted:
+            rank = len(members)
+            info = self._lobby.pop(origin)
+            members[rank] = {"origin": origin, "pid": info.get("pid"),
+                             "endpoint": info.get("endpoint")}
+        self._members = members
+        self._leases = {r: now for r in members}
+        # the re-formed pod IS fully formed at the new size: track
         # `expected` or the monitor's formation gate would skip lease
         # enforcement forever after the first reform
         self.expected = len(self._members)
         self.gen = old_gen + 1
+        world = sorted(members)
+        for rank, info in members.items():
+            if info["origin"] in admitted:
+                self._admitted[info["origin"]] = {
+                    "gen": self.gen, "rank": rank, "world": world}
         self._failed = {}
+        self._straggling.clear()
         self._barriers.clear()
         self._colls.clear()
         self._reforms.pop(old_gen, None)
         self._reform_result[old_gen] = {
-            "gen": self.gen, "map": mapping,
-            "world": sorted(mapping.values())}
+            "gen": self.gen, "map": mapping, "world": world}
         self._cond.notify_all()
 
 
@@ -464,11 +703,12 @@ class _PodHandler(socketserver.StreamRequestHandler):
 
 
 def start_coordinator(port=0, host="127.0.0.1", expected=None,
-                      lease_ttl=3.0):
+                      lease_ttl=3.0, straggler_threshold=None):
     """Start a :class:`PodCoordinator` on a daemon thread; returns
     ``(coordinator, endpoint)``."""
     coord = PodCoordinator((host, port), expected=expected,
-                           lease_ttl=lease_ttl)
+                           lease_ttl=lease_ttl,
+                           straggler_threshold=straggler_threshold)
     t = threading.Thread(target=coord.serve_forever, daemon=True)
     t.start()
     return coord, coord.endpoint
@@ -597,6 +837,10 @@ class PodRuntime:
         for env, key, cast in (
                 ("PADDLE_POD_HEARTBEAT_S", "heartbeat_interval", float),
                 ("PADDLE_POD_BARRIER_TIMEOUT", "barrier_timeout", float),
+                # a replacement rank parks in the coordinator's lobby
+                # until the survivors' next reform admits it — its join
+                # deadline must cover a full training window
+                ("PADDLE_POD_JOIN_TIMEOUT", "join_timeout", float),
                 # seeds the client's expectation only — the
                 # coordinator's configured ttl is authoritative and is
                 # served back at join
@@ -656,9 +900,10 @@ class PodRuntime:
         self._hb_thread.start()
         self._maybe_init_jax()
         self._initialized = True
-        self._runlog_event("pod_join", rank=self._rank,
-                           world=self.world_size, gen=self._gen,
-                           uid=self.uid)
+        _runlog_event("pod_join", rank=self._rank,
+                      world=self.world_size, gen=self._gen,
+                      uid=self.uid,
+                      via=resp.get("joined", "rendezvous"))
         return self
 
     def _maybe_init_jax(self):
@@ -744,7 +989,31 @@ class PodRuntime:
             if not fresh:
                 return
             self._raised.update(rec.get("origin") for rec in fresh)
-        raise RankFailedError(fresh)
+        exc = RankFailedError(fresh)
+        self._flight_dump_failure(exc, op="check_failures")
+        raise exc
+
+    def _flight_dump_failure(self, exc, **fields):
+        """Pod failure forensics: an atomic flight dump
+        (``reason="pod_failure"``, absent/origin ranks in the payload)
+        BEFORE any reform — the post-mortem exists even when the
+        survivor recovers and keeps running. Best-effort: never masks
+        the failure being raised."""
+        try:
+            from ..observability import flight
+            if not flight.installed():
+                return
+            payload = {"gen": self._gen, "rank": self._rank,
+                       "origin": self.origin,
+                       "world_size": self.world_size, **fields}
+            if isinstance(exc, RankFailedError):
+                payload["failed_ranks"] = exc.ranks
+            if isinstance(exc, BarrierTimeoutError):
+                payload["absent_ranks"] = exc.waiting
+            flight.dump("pod_failure", exc=exc,
+                        extra={"pod_failure": payload})
+        except Exception:
+            pass
 
     # -- collectives ---------------------------------------------------------
     def _call(self, io_timeout, **req):
@@ -768,11 +1037,15 @@ class PodRuntime:
             with self._lock:
                 for rec in resp.get("failed") or ():
                     self._raised.add(rec.get("origin"))
-            raise RankFailedError(resp.get("failed") or
+            exc = RankFailedError(resp.get("failed") or
                                   [{"origin": None, "reason": "unknown"}])
+            self._flight_dump_failure(exc, op=name)
+            raise exc
         if err == "barrier_timeout":
-            raise BarrierTimeoutError(name, resp.get("waiting", ()),
+            exc = BarrierTimeoutError(name, resp.get("waiting", ()),
                                       timeout)
+            self._flight_dump_failure(exc, op=name)
+            raise exc
         if err == "stale_gen":
             raise StaleGenerationError(
                 f"op {name!r} used generation {self._gen}, pod is at "
@@ -811,13 +1084,41 @@ class PodRuntime:
                               timeout=timeout) / self.world_size
 
     # -- elastic re-formation ------------------------------------------------
+    def pending_joiners(self):
+        """Origins parked in the coordinator's lobby — replacement or
+        net-new ranks waiting for the next :meth:`reform` to admit
+        them. Poll at window boundaries; when non-empty (agree across
+        ranks first — e.g. allreduce the count — so every survivor
+        reforms together), checkpoint and :meth:`reform` to grow the
+        world back."""
+        resp = self._call(10.0, op="pending_joiners", gen=self._gen)
+        if not resp.get("ok"):
+            return []
+        return sorted(int(j["origin"]) for j in resp.get("joiners", ()))
+
+    def stragglers(self, threshold=None):
+        """Origins of live ranks whose current heartbeat gap exceeds
+        ``threshold`` seconds (default: the coordinator's configured
+        straggler threshold, lease_ttl/2) — slow-but-alive ranks,
+        visible before they become failures."""
+        resp = self._call(10.0, op="stragglers", gen=self._gen,
+                          threshold=threshold)
+        if not resp.get("ok"):
+            return []
+        return [int(o) for o in resp.get("stragglers", ())]
+
     def reform(self, timeout=None):
-        """After a failure, re-form the pod with the survivors at the
-        smaller world size: dense re-rank, generation + 1, failure set
-        cleared. Returns ``{"gen", "rank", "world_size"}``. Every
-        survivor must call this (it is itself a barrier among the
-        living)."""
+        """Re-form the pod: survivors re-rank densely and every lobby
+        joiner is admitted — the world SHRINKS after a failure, GROWS
+        when replacements (or net-new ranks) are waiting, generation + 1
+        either way, failure set cleared. Returns ``{"gen", "rank",
+        "world_size"}``. Every survivor must call this (it is itself a
+        barrier among the living); after it, restore from the latest
+        pod checkpoint so the new world resumes from one consistent
+        step."""
         timeout = self.barrier_timeout if timeout is None else float(timeout)
+        t0 = time.time()
+        old_world = self.world_size
         resp = self._call(timeout + 15.0, op="reform", rank=self._rank,
                           gen=self._gen, timeout=timeout)
         self._collective_reply(resp, "reform", timeout)
@@ -828,18 +1129,320 @@ class PodRuntime:
             self._failed = {}
             self._raised = set()
             self._seq = 0
-        self._runlog_event("pod_reform", rank=self._rank,
-                           world=self.world_size, gen=self._gen)
+        direction = ("grow" if self.world_size > old_world
+                     else "shrink" if self.world_size < old_world
+                     else "steady")
+        _runlog_event("pod_reform", rank=self._rank,
+                      world=self.world_size, gen=self._gen,
+                      direction=direction, old_world=old_world,
+                      new_world=self.world_size,
+                      took_s=round(time.time() - t0, 3))
         return {"gen": self._gen, "rank": self._rank,
                 "world_size": self.world_size}
 
-    @staticmethod
-    def _runlog_event(what, **fields):
+
+# -- supervisor (the production launcher side) ------------------------------
+
+class RankExit:
+    """One rank process's terminal state as the supervisor observed it.
+    ``incarnation`` counts spawns of this origin (1 = the original
+    process, 2+ = supervised replacements)."""
+
+    def __init__(self, rank, returncode, t_reaped, incarnation=1):
+        self.rank = rank
+        self.returncode = returncode
+        self.t_reaped = t_reaped
+        self.incarnation = incarnation
+
+    @property
+    def signal(self):
+        """Signal name when the rank died by signal, else None."""
+        from .launch import signal_name
+        return signal_name(self.returncode)
+
+    def __repr__(self):
+        return (f"RankExit(rank={self.rank}, returncode={self.returncode}"
+                + (f", signal={self.signal}" if self.signal else "")
+                + (f", incarnation={self.incarnation}"
+                   if self.incarnation != 1 else "") + ")")
+
+
+class PodSupervisor:
+    """Launch AND heal a pod of local rank processes.
+
+    The production-facing wrapper over the coordinator (the reference's
+    launcher watchdog, ``launch_utils.py watch_local_trainers:565``, but
+    where the reference restarts the WHOLE job this supervisor replaces
+    one rank at a time): it hosts the :class:`PodCoordinator` (so no
+    rank's death takes rendezvous down), spawns one POSIX process per
+    rank through ``launch.spawn_trainer`` (env contract + per-rank
+    run-log/flight dirs), and its watchdog
+
+    - **reaps** exited children and marks signal/error deaths failed at
+      the coordinator immediately (the fast detection path — the lease
+      TTL bounds detection even with no supervisor);
+    - **respawns** a replacement process for each reaped rank when a
+      :class:`~paddle_tpu.distributed.restart.RestartPolicy` is supplied
+      (``restart=``): the policy's exponential backoff paces the
+      relaunch and its bounded budget stops a crash-looping rank from
+      burning the machine. The replacement joins the coordinator's
+      LOBBY; the survivors' next :meth:`PodRuntime.reform` admits it and
+      the pod grows back to full world — the kill→shrink→heal→grow
+      lifecycle.
+
+    ``testing.virtual_pod.VirtualPod`` subclasses this with
+    deterministic process kill-points for the chaos tier.
+    """
+
+    def __init__(self, nprocs, script, *, workdir, script_args=(),
+                 env=None, lease_ttl=3.0, heartbeat_interval=0.5,
+                 barrier_timeout=60.0, watchdog_interval=0.2,
+                 devices_per_proc=1, restart=None,
+                 straggler_threshold=None):
+        self.nprocs = int(nprocs)
+        self.script = str(script)
+        self.script_args = list(script_args)
+        self.workdir = str(workdir)
+        self.extra_env = dict(env or {})
+        self.lease_ttl = float(lease_ttl)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.barrier_timeout = float(barrier_timeout)
+        self.watchdog_interval = float(watchdog_interval)
+        self.devices_per_proc = int(devices_per_proc)
+        self.restart = restart  # RestartPolicy; None = never respawn
+        self.straggler_threshold = straggler_threshold
+        self.log_dir = os.path.join(self.workdir, "logs")
+        self.runlog_dir = os.path.join(self.workdir, "runlogs")
+        self.flight_dir = os.path.join(self.workdir, "flight")
+        self.coordinator = None
+        self.exits = {}            # origin -> LATEST RankExit
+        self.exit_history = []     # every reap, in order
+        self.respawns_denied = []  # origins whose restart budget ran out
+        self._procs = []
+        self._cluster = None
+        self._base_envs = {}
+        self._incarnation = {}     # origin -> spawn count (1 = original)
+        self._pending_respawn = {}  # origin -> earliest respawn time
+        self._closing = False      # terminate() in progress: no respawns
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        from . import launch
+        for d in (self.log_dir, self.runlog_dir, self.flight_dir):
+            os.makedirs(d, exist_ok=True)
+        self.coordinator, endpoint = start_coordinator(
+            expected=self.nprocs, lease_ttl=self.lease_ttl,
+            straggler_threshold=self.straggler_threshold)
+        eps = [f"127.0.0.1:{20000 + i}" for i in range(self.nprocs)]
+        self._cluster = launch.get_cluster(["127.0.0.1"], "127.0.0.1",
+                                           eps, self.nprocs)
+        self._base_envs = {
+            "PADDLE_POD_COORDINATOR": endpoint,
+            "PADDLE_POD_HEARTBEAT_S": str(self.heartbeat_interval),
+            "PADDLE_POD_BARRIER_TIMEOUT": str(self.barrier_timeout),
+            "PADDLE_TPU_RUNLOG_DIR": self.runlog_dir,
+            "PADDLE_TPU_FLIGHT_DIR": self.flight_dir,
+            # ranks are CPU, single-device: the pod axis IS the
+            # parallelism under supervision, and 1-device XLA startup
+            # keeps an N-process pod cheap to bring up
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count="
+                         f"{self.devices_per_proc}",
+            "PYTHONPATH": _repo_root() + os.pathsep
+                          + os.environ.get("PYTHONPATH", ""),
+        }
+        self._base_envs.update(self.extra_env)
+        for t in self._cluster.pods[0].trainers:
+            self._spawn_rank(t.rank, incarnation=1)
+        return self
+
+    # -- respawn -------------------------------------------------------------
+    def _respawn_env(self, origin, incarnation):
+        """Env OVERRIDES for a respawned rank (subclass hook — the
+        virtual pod arms per-incarnation kill specs through it)."""
+        return {}
+
+    def _spawn_rank(self, origin, incarnation):
+        from . import launch
+        trainer = next(t for t in self._cluster.pods[0].trainers
+                       if t.rank == origin)
+        envs = dict(self._base_envs)
+        if incarnation > 1:
+            envs["PADDLE_TPU_POD_INCARNATION"] = str(incarnation)
+            envs.update(self._respawn_env(origin, incarnation))
+        tp = launch.spawn_trainer(
+            self._cluster, trainer, self.script, self.script_args,
+            log_dir=self.log_dir, envs=envs,
+            log_mode="w" if incarnation == 1 else "a")
+        tp.incarnation = incarnation
+        tp.reaped = False
+        self._incarnation[origin] = incarnation
+        self._procs.append(tp)
+        if incarnation > 1:
+            try:
+                from .. import monitor
+                monitor.stat_add("pod_respawns_total", 1)
+            except Exception:
+                pass
+            _runlog_event("pod_respawn", origin=origin,
+                          incarnation=incarnation)
+        return tp
+
+    def _schedule_respawn(self, origin, reason):
+        if self.restart is None or self._closing:
+            # a deliberate terminate() reaps children with nonzero exit
+            # codes — those are not crashes and must neither burn the
+            # restart budget nor log denied respawns
+            return
+        delay = self.restart.schedule(origin)
+        if delay is None:
+            # bounded budget: a crash-looping rank stays down and the
+            # pod runs degraded instead of thrashing
+            self.respawns_denied.append(origin)
+            _runlog_event("pod_respawn_denied", origin=origin,
+                          reason=reason)
+            return
+        self._pending_respawn[origin] = time.time() + delay
+
+    def _spawn_due_respawns(self, alive):
+        now = time.time()
+        for origin, not_before in list(self._pending_respawn.items()):
+            if not alive:
+                # no survivor is left to reform the replacement into —
+                # whole-pod restart is the elastic relaunch path's job
+                del self._pending_respawn[origin]
+                self.respawns_denied.append(origin)
+                continue
+            if now < not_before:
+                continue  # the policy's backoff delay is still running
+            del self._pending_respawn[origin]
+            self._spawn_rank(origin, self._incarnation.get(origin, 1) + 1)
+
+    # -- watchdog ------------------------------------------------------------
+    def watch_once(self):
+        """One watchdog pass: reap exited children, mark signal/error
+        deaths failed at the coordinator (the fast detection path),
+        schedule replacements through the restart policy, and spawn any
+        respawn whose backoff elapsed. Returns the ranks still alive."""
+        alive = []
+        for tp in self._procs:
+            if getattr(tp, "reaped", False):
+                continue
+            ret = tp.proc.poll()
+            if ret is None:
+                alive.append(tp.rank)
+                continue
+            tp.reaped = True
+            ex = RankExit(tp.rank, ret, time.time(),
+                          incarnation=getattr(tp, "incarnation", 1))
+            self.exits[tp.rank] = ex
+            self.exit_history.append(ex)
+            if tp.log_f:
+                tp.log_f.close()
+                tp.log_f = None
+            if ret != 0:
+                reason = (f"killed by {ex.signal}" if ex.signal
+                          else f"exited with code {ret}")
+                self.coordinator.mark_failed(tp.rank, reason)
+                self._schedule_respawn(tp.rank, reason)
+        self._spawn_due_respawns(alive)
+        return alive
+
+    def wait(self, timeout=180.0):
+        """Watchdog loop until every rank exits and no respawn is
+        pending (or ``timeout``: the stragglers are terminated with a
+        grace period and a TimeoutError raises). Returns
+        ``{origin: latest RankExit}`` (``exit_history`` holds every
+        incarnation's exit)."""
+        deadline = time.time() + float(timeout)
+        while True:
+            alive = self.watch_once()
+            if not alive and not self._pending_respawn:
+                return dict(self.exits)
+            if time.time() > deadline:
+                self.terminate()
+                raise TimeoutError(
+                    f"pod rank(s) {alive} still alive after "
+                    f"{timeout:.0f}s; terminated. Logs under "
+                    f"{self.log_dir}: " + self.tail_logs())
+            time.sleep(self.watchdog_interval)
+
+    def run(self, timeout=180.0):
+        """``start()`` + ``wait()`` + coordinator shutdown."""
+        self.start()
         try:
-            from ..observability import runlog
-            runlog.event(what, **fields)
-        except Exception:
-            pass
+            return self.wait(timeout=timeout)
+        finally:
+            self.close()
+
+    def kill_rank(self, rank, sig=None):
+        """Externally kill a rank's CURRENT process (the preemption
+        story — vs the deterministic in-process kill-points)."""
+        import signal as _signal
+        sig = _signal.SIGKILL if sig is None else sig
+        for tp in self._procs:
+            if tp.rank == rank and not getattr(tp, "reaped", False) \
+                    and tp.proc.poll() is None:
+                tp.proc.send_signal(sig)
+                return True
+        return False
+
+    def terminate(self, grace_s=5.0):
+        from . import launch
+        self._closing = True
+        self._pending_respawn.clear()
+        launch.terminate_local_procs(self._procs, grace_s=grace_s)
+        self.watch_once()
+
+    def close(self):
+        if self.coordinator is not None:
+            self.coordinator.close()
+            self.coordinator = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        try:
+            self.terminate()
+        finally:
+            self.close()
+        return False
+
+    # -- evidence ------------------------------------------------------------
+    def log(self, rank):
+        """A rank's captured stdout+stderr (``workerlog.<rank>``;
+        respawned incarnations APPEND to their rank's log)."""
+        try:
+            with open(os.path.join(self.log_dir,
+                                   f"workerlog.{rank}")) as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def tail_logs(self, n=2000):
+        out = []
+        for r in range(self.nprocs):
+            text = self.log(r)
+            if text:
+                out.append(f"--- workerlog.{r} ---\n{text[-n:]}")
+        return "\n".join(out)
+
+    def runlog_paths(self):
+        """Every per-rank run-log JSONL written so far — including a
+        killed rank's (its log ends at the kill, which is the point)."""
+        try:
+            return sorted(
+                os.path.join(self.runlog_dir, f)
+                for f in os.listdir(self.runlog_dir)
+                if f.endswith(".jsonl"))
+        except OSError:
+            return []
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
 
 def _jax_cross_process_capable():

@@ -20,7 +20,10 @@ Instrumented points (grep for ``kill_point(`` to enumerate):
   ``RESOURCE_EXHAUSTED``-message exception to exercise the flight
   recorder's OOM classification)
 - ``pod/*`` and ``checkpoint/pod_*`` — the virtual-pod training loop
-  and multi-process checkpoint stages (``testing.virtual_pod``)
+  and multi-process checkpoint stages (``testing.virtual_pod``),
+  including the read-side ``checkpoint/pod_restore`` (a rank killed
+  DURING its elastic restore — the heal-and-grow chaos cycle kills a
+  freshly respawned replacement exactly there)
 
 **Process-level kill-points** (the cross-process analog of
 :func:`inject`): arming a point with :func:`arm_process_kill` — or via

@@ -2,235 +2,86 @@
 
 The cross-process analog of the 8-device virtual CPU mesh: pod
 semantics — rendezvous, heartbeat failure detection, barrier timeouts,
-elastic re-formation, rank-0-committed multi-process checkpoints — are
-provable on one machine with no TPU, against *actual* process
-boundaries and *actual* SIGKILLs.
+elastic re-formation (down AND back up), rank-0-committed multi-process
+checkpoints — are provable on one machine with no TPU, against *actual*
+process boundaries and *actual* SIGKILLs.
 
-The parent (this class) plays the role the reference gives the
-launcher's watchdog (``launch_utils.py watch_local_trainers:565``): it
-hosts the :class:`~paddle_tpu.distributed.pod.PodCoordinator` (so no
-rank's death takes the rendezvous service down), spawns one POSIX
-process per rank through ``distributed.launch.start_local_trainers``
-(the reference env contract, plus ``PADDLE_POD_COORDINATOR`` and the
-per-rank run-log/flight dirs), and its watchdog marks a reaped child
-failed at the coordinator immediately — the fast detection path; the
-lease TTL bounds detection even with no supervisor.
+The parent is a :class:`~paddle_tpu.distributed.pod.PodSupervisor`
+(the production launcher: coordinator hosting, watchdog reaping, fast
+failure marking, and — given a ``restart=RestartPolicy(...)`` —
+supervised replacement spawning so the pod re-forms UPWARD after a
+kill). This subclass adds the chaos tier's determinism:
 
-Process-level kill-points ride the ``PADDLE_TPU_PROCESS_KILL`` env
-(``testing.faults``): ``VirtualPod(..., kill=(rank, point, nth))``
-SIGKILLs that rank at the nth hit of the named point — deterministic,
-uncatchable, real.
+- **Process-level kill-points** ride the ``PADDLE_TPU_PROCESS_KILL``
+  env (``testing.faults``): ``VirtualPod(..., kill=(rank, point, nth))``
+  SIGKILLs that rank at the nth hit of the named point —
+  deterministic, uncatchable, real.
+- **Per-incarnation kill specs**: ``respawn_kills={origin: [(point,
+  nth), None, ...]}`` arms the k-th RESPAWN of that origin with its own
+  kill spec (``None`` = the replacement runs clean). A replacement
+  never inherits the original's kill spec — without this, every
+  incarnation would re-kill itself identically and the restart budget
+  would just burn down.
 
-Typical test shape::
+Typical test shapes::
 
     pod = VirtualPod(2, FIXTURE, workdir=tmp, kill=(1, "pod/mid_step", 5))
     exits = pod.run(timeout=180)
     assert exits[1].signal == "SIGKILL" and exits[0].returncode == 0
-    ... parse pod.log(0), merge pod.runlog_paths() with trace_view ...
+
+    # kill -> shrink -> heal -> grow:
+    pod = VirtualPod(2, FIXTURE, workdir=tmp,
+                     kill=(1, "pod/mid_step", 5),
+                     restart=RestartPolicy(max_restarts=2, seed=0))
+    exits = pod.run(timeout=240)     # replacement rejoins, world heals
+    assert exits[1].returncode == 0  # the LAST incarnation finished
 """
-import os
-import signal
 import sys
-import time
 
-__all__ = ["VirtualPod", "RankExit"]
+from ..distributed.pod import PodSupervisor, RankExit, RestartPolicy
 
-
-class RankExit:
-    """One rank's terminal state as the watchdog observed it."""
-
-    def __init__(self, rank, returncode, t_reaped):
-        self.rank = rank
-        self.returncode = returncode
-        self.t_reaped = t_reaped
-
-    @property
-    def signal(self):
-        """Signal name when the rank died by signal, else None."""
-        from ..distributed.launch import signal_name
-        return signal_name(self.returncode)
-
-    def __repr__(self):
-        return (f"RankExit(rank={self.rank}, returncode={self.returncode}"
-                + (f", signal={self.signal}" if self.signal else "") + ")")
+__all__ = ["VirtualPod", "RankExit", "RestartPolicy"]
 
 
-class VirtualPod:
+class VirtualPod(PodSupervisor):
     """Launch ``nprocs`` real localhost ranks running ``script`` under a
-    parent-hosted pod coordinator. See module docstring."""
+    parent-hosted pod coordinator, with deterministic kill specs. See
+    module docstring."""
 
     def __init__(self, nprocs, script, *, workdir, script_args=(),
-                 env=None, kill=None, lease_ttl=2.0,
+                 env=None, kill=None, respawn_kills=None, lease_ttl=2.0,
                  heartbeat_interval=0.25, barrier_timeout=30.0,
                  watchdog_interval=0.2, started_port=0,
-                 devices_per_proc=1):
-        self.nprocs = int(nprocs)
-        self.script = str(script)
-        self.script_args = list(script_args)
-        self.workdir = str(workdir)
-        self.extra_env = dict(env or {})
+                 devices_per_proc=1, restart=None,
+                 straggler_threshold=None):
         self.kills = ([] if kill is None
                       else [kill] if isinstance(kill, tuple) else list(kill))
-        self.lease_ttl = float(lease_ttl)
-        self.heartbeat_interval = float(heartbeat_interval)
-        self.barrier_timeout = float(barrier_timeout)
-        self.watchdog_interval = float(watchdog_interval)
-        self.devices_per_proc = int(devices_per_proc)
-        self.log_dir = os.path.join(self.workdir, "logs")
-        self.runlog_dir = os.path.join(self.workdir, "runlogs")
-        self.flight_dir = os.path.join(self.workdir, "flight")
-        self.coordinator = None
-        self.exits = {}
-        self._procs = []
-        self._marked = set()
-
-    # -- lifecycle -----------------------------------------------------------
-    def start(self):
-        from ..distributed import launch
-        from ..distributed.pod import start_coordinator
-        for d in (self.log_dir, self.runlog_dir, self.flight_dir):
-            os.makedirs(d, exist_ok=True)
-        self.coordinator, endpoint = start_coordinator(
-            expected=self.nprocs, lease_ttl=self.lease_ttl)
-
-        eps = [f"127.0.0.1:{20000 + i}" for i in range(self.nprocs)]
-        cluster = launch.get_cluster(["127.0.0.1"], "127.0.0.1", eps,
-                                     self.nprocs)
-        envs = {
-            "PADDLE_POD_COORDINATOR": endpoint,
-            "PADDLE_POD_HEARTBEAT_S": str(self.heartbeat_interval),
-            "PADDLE_POD_BARRIER_TIMEOUT": str(self.barrier_timeout),
-            "PADDLE_TPU_RUNLOG_DIR": self.runlog_dir,
-            "PADDLE_TPU_FLIGHT_DIR": self.flight_dir,
-            # children are CPU, single-device: the pod axis IS the
-            # parallelism under test, and 1-device XLA startup is what
-            # keeps a 2-process test inside the tier-1 budget
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count="
-                         f"{self.devices_per_proc}",
-            "PYTHONPATH": _repo_root() + os.pathsep
-                          + os.environ.get("PYTHONPATH", ""),
-        }
+        self.respawn_kills = {int(o): list(specs)
+                              for o, specs in (respawn_kills or {}).items()}
+        env = dict(env or {})
         if self.kills:
-            envs["PADDLE_TPU_PROCESS_KILL"] = ",".join(
+            env["PADDLE_TPU_PROCESS_KILL"] = ",".join(
                 f"{point}@{rank}#{nth}" for rank, point, nth in
                 (k if len(k) == 3 else (k[0], k[1], 1) for k in self.kills))
-        envs.update(self.extra_env)
-        self._procs = launch.start_local_trainers(
-            cluster, cluster.pods[0], self.script, self.script_args,
-            log_dir=self.log_dir, envs=envs)
-        return self
+        super().__init__(nprocs, script, workdir=workdir,
+                         script_args=script_args, env=env,
+                         lease_ttl=lease_ttl,
+                         heartbeat_interval=heartbeat_interval,
+                         barrier_timeout=barrier_timeout,
+                         watchdog_interval=watchdog_interval,
+                         devices_per_proc=devices_per_proc,
+                         restart=restart,
+                         straggler_threshold=straggler_threshold)
 
-    def watch_once(self):
-        """One watchdog pass: reap exited children, mark signal/error
-        deaths failed at the coordinator (the fast detection path).
-        Returns the ranks still alive."""
-        alive = []
-        for tp in self._procs:
-            if tp.rank in self.exits:
-                continue
-            ret = tp.proc.poll()
-            if ret is None:
-                alive.append(tp.rank)
-                continue
-            self.exits[tp.rank] = RankExit(tp.rank, ret, time.time())
-            if tp.log_f:
-                tp.log_f.close()
-                tp.log_f = None
-            if ret != 0 and tp.rank not in self._marked:
-                self._marked.add(tp.rank)
-                ex = self.exits[tp.rank]
-                reason = (f"killed by {ex.signal}" if ex.signal
-                          else f"exited with code {ret}")
-                self.coordinator.mark_failed(tp.rank, reason)
-        return alive
-
-    def wait(self, timeout=180.0):
-        """Watchdog loop until every rank exits (or ``timeout``: the
-        stragglers are terminated with a grace period and a TimeoutError
-        raises). Returns ``{rank: RankExit}``."""
-        deadline = time.time() + float(timeout)
-        while True:
-            alive = self.watch_once()
-            if not alive:
-                return dict(self.exits)
-            if time.time() > deadline:
-                self.terminate()
-                raise TimeoutError(
-                    f"virtual pod rank(s) {alive} still alive after "
-                    f"{timeout:.0f}s; terminated. Logs under "
-                    f"{self.log_dir}: " + self.tail_logs())
-            time.sleep(self.watchdog_interval)
-
-    def run(self, timeout=180.0):
-        """``start()`` + ``wait()`` + coordinator shutdown."""
-        self.start()
-        try:
-            return self.wait(timeout=timeout)
-        finally:
-            self.close()
-
-    def kill_rank(self, rank, sig=signal.SIGKILL):
-        """Externally kill a rank (the preemption story — vs the
-        deterministic in-process kill-points)."""
-        for tp in self._procs:
-            if tp.rank == rank and tp.proc.poll() is None:
-                tp.proc.send_signal(sig)
-                return True
-        return False
-
-    def terminate(self, grace_s=5.0):
-        from ..distributed import launch
-        launch.terminate_local_procs(self._procs, grace_s=grace_s)
-        self.watch_once()
-
-    def close(self):
-        if self.coordinator is not None:
-            self.coordinator.close()
-            self.coordinator = None
-
-    def __enter__(self):
-        return self.start()
-
-    def __exit__(self, *exc):
-        try:
-            self.terminate()
-        finally:
-            self.close()
-        return False
-
-    # -- evidence ------------------------------------------------------------
-    def log(self, rank):
-        """A rank's captured stdout+stderr (``workerlog.<rank>``)."""
-        try:
-            with open(os.path.join(self.log_dir, f"workerlog.{rank}")) as f:
-                return f.read()
-        except OSError:
-            return ""
-
-    def tail_logs(self, n=2000):
-        out = []
-        for r in range(self.nprocs):
-            text = self.log(r)
-            if text:
-                out.append(f"--- workerlog.{r} ---\n{text[-n:]}")
-        return "\n".join(out)
-
-    def runlog_paths(self):
-        """Every per-rank run-log JSONL written so far — including a
-        killed rank's (its log ends at the kill, which is the point)."""
-        try:
-            return sorted(
-                os.path.join(self.runlog_dir, f)
-                for f in os.listdir(self.runlog_dir)
-                if f.endswith(".jsonl"))
-        except OSError:
-            return []
-
-
-def _repo_root():
-    return os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+    def _respawn_env(self, origin, incarnation):
+        """Replacement ranks run CLEAN by default (the original's kill
+        spec must not re-kill every incarnation); ``respawn_kills``
+        arms the k-th respawn with its own deterministic spec."""
+        specs = self.respawn_kills.get(int(origin))
+        i = incarnation - 2  # incarnation 2 == first respawn == specs[0]
+        spec = specs[i] if specs and i < len(specs) else None
+        return {"PADDLE_TPU_PROCESS_KILL":
+                "" if spec is None else f"{spec[0]}@{origin}#{spec[1]}"}
 
 
 def _main():  # pragma: no cover - tiny CLI convenience
@@ -242,6 +93,8 @@ def _main():  # pragma: no cover - tiny CLI convenience
     ap.add_argument("--workdir", default="/tmp/virtual_pod")
     ap.add_argument("--kill", default=None,
                     help="point@rank[#nth] process kill spec")
+    ap.add_argument("--restarts", type=int, default=0,
+                    help="respawn budget per origin (0 = never respawn)")
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("script")
     ap.add_argument("script_args", nargs="...")
@@ -251,8 +104,11 @@ def _main():  # pragma: no cover - tiny CLI convenience
         point, _, rest = args.kill.partition("@")
         rank_s, _, nth_s = rest.partition("#")
         kill = (int(rank_s), point, int(nth_s) if nth_s else 1)
+    restart = (RestartPolicy(max_restarts=args.restarts)
+               if args.restarts > 0 else None)
     pod = VirtualPod(args.nprocs, args.script, workdir=args.workdir,
-                     script_args=args.script_args, kill=kill)
+                     script_args=args.script_args, kill=kill,
+                     restart=restart)
     exits = pod.run(timeout=args.timeout)
     for r in sorted(exits):
         print(f"rank {r}: {exits[r]!r}")

@@ -9,6 +9,19 @@ a failed pod save), re-form at the smaller world size, elastically
 restore from the last pod checkpoint, and continue — losses must stay
 within 1e-6 of a single-process control run of the same fixture.
 
+The HEAL half (``POD_FIX_TARGET_WORLD``): at every step boundary the
+ranks agree (an allreduce of each rank's lobby observation, so no rank
+reforms alone) on whether replacement joiners are parked at the
+coordinator; when one is, every rank commits the current state
+(``mgr.save``), calls ``pod.reform()`` — the world GROWS, the joiner is
+admitted — and every rank (incumbents and the replacement alike)
+restores from that checkpoint, so the grown world resumes from one
+consistent step. From ``POD_FIX_HEAL_BY_STEP`` onward a rank that finds
+itself below the target world BLOCKS at the boundary until a joiner
+arrives (bounded by ``POD_FIX_HEAL_TIMEOUT``) — the tail steps of the
+run are guaranteed to execute at full world, which is what the
+1e-6-vs-control acceptance needs.
+
 The forward/backward math is hand-written numpy float64 against the
 framework-held float32 params: the mean-of-shard-means the pod computes
 and the full-batch mean the control computes then agree to ~1e-15
@@ -23,8 +36,9 @@ Stdout protocol (the test parses these):
   LOSS <step> <loss>
   CKPT <step>
   FAILURE_DETECTED t=<wall> failed=[..] err=<ExcType>
-  REFORMED rank=R world=W gen=G
-  RESUME_FROM <step>
+  REFORMED rank=R world=W gen=G dir=<shrink|grow|steady> t=<wall>
+  RESUME_FROM <step> t=<wall>
+  HEAL_TIMEOUT step=<step>             (degraded: no joiner arrived)
   DONE rank=R world=W
 """
 import os
@@ -52,6 +66,10 @@ STEPS = int(os.environ.get("POD_FIX_STEPS", "8"))
 CKPT_EVERY = int(os.environ.get("POD_FIX_CKPT_EVERY", "3"))
 BATCH = int(os.environ.get("POD_FIX_BATCH", "8"))
 ROOT = os.environ["POD_FIX_CKPT_ROOT"]
+# heal knobs: 0/-1 = never wait for replacements (PR-11 behavior)
+TARGET_WORLD = int(os.environ.get("POD_FIX_TARGET_WORLD", "0"))
+HEAL_BY_STEP = int(os.environ.get("POD_FIX_HEAL_BY_STEP", "-1"))
+HEAL_TIMEOUT = float(os.environ.get("POD_FIX_HEAL_TIMEOUT", "60"))
 IN_DIM, HID = 8, 16
 
 
@@ -114,10 +132,63 @@ def main():
     meta = mgr.restore()
     step = (int(meta["step"]) + 1) if meta else 0
     if meta:
-        print(f"RESUME_FROM {step}", flush=True)
+        print(f"RESUME_FROM {step} t={time.time():.3f}", flush=True)
+
+    def reform_and_restore():
+        nonlocal step, meta
+        old_w = pod.world_size
+        pod.reform(timeout=30.0)
+        d = ("grow" if pod.world_size > old_w
+             else "shrink" if pod.world_size < old_w else "steady")
+        print(f"REFORMED rank={pod.rank} world={pod.world_size} "
+              f"gen={pod.gen} dir={d} t={time.time():.3f}", flush=True)
+        meta = mgr.restore()
+        step = (int(meta["step"]) + 1) if meta else 0
+        print(f"RESUME_FROM {step} t={time.time():.3f}", flush=True)
 
     while step < STEPS:
         try:
+            # -- window boundary: learn of parked joiners and grow back.
+            # The decision MUST be collective: each rank's lobby glimpse
+            # can differ (a joiner landing between two polls), and a
+            # rank that reforms alone while its peer enters the step
+            # barrier deadlocks both — so the observed count is
+            # allreduced and every rank acts on the SAME total.
+            attempt = 0
+            wait_t0 = None
+            while True:
+                joiners = len(pod.pending_joiners())
+                agreed = pod.allreduce(
+                    [float(joiners)],
+                    name=f"lobby{step}.{attempt}.g{pod.gen}",
+                    timeout=30.0)[0]
+                attempt += 1
+                if agreed > 0:
+                    # commit the pre-grow state so EVERY rank of the
+                    # grown world (incumbents + replacement) restores
+                    # to the same step from the same checkpoint
+                    if step > 0:
+                        mgr.save(step - 1)
+                    reform_and_restore()
+                    # the admitted replacement starts ITS boundary loop
+                    # at attempt 0 — reset so the next lobby allreduce
+                    # name matches across incumbents and replacements
+                    attempt = 0
+                    wait_t0 = None
+                    continue  # more joiners may be parked already
+                if TARGET_WORLD and 0 <= HEAL_BY_STEP <= step \
+                        and pod.world_size < TARGET_WORLD:
+                    # from HEAL_BY_STEP on, a degraded world blocks at
+                    # the boundary for its replacement (bounded): the
+                    # tail of the run must execute at full world
+                    wait_t0 = time.time() if wait_t0 is None else wait_t0
+                    if time.time() - wait_t0 > HEAL_TIMEOUT:
+                        print(f"HEAL_TIMEOUT step={step}", flush=True)
+                        break
+                    time.sleep(0.25)
+                    continue
+                break
+
             faults.kill_point("pod/before_barrier")
             pod.barrier(f"step{step}.g{pod.gen}", timeout=30.0)
             x, y = _data(step)
@@ -150,12 +221,7 @@ def main():
             print(f"FAILURE_DETECTED t={time.time():.3f} "
                   f"failed={getattr(e, 'ranks', [])} "
                   f"err={type(e).__name__}", flush=True)
-            pod.reform(timeout=30.0)
-            print(f"REFORMED rank={pod.rank} world={pod.world_size} "
-                  f"gen={pod.gen}", flush=True)
-            meta = mgr.restore()
-            step = (int(meta["step"]) + 1) if meta else 0
-            print(f"RESUME_FROM {step}", flush=True)
+            reform_and_restore()
 
     obs.memory.runlog_snapshot(rank=pod.origin, export=True)
     print(f"DONE rank={pod.rank} world={pod.world_size}", flush=True)

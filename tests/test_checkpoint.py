@@ -208,42 +208,51 @@ def test_zero_gacc_window_store_roundtrip(tmp_path):
     del _s, m, opt
 
 
-@pytest.mark.parametrize("stage", [1, 3])
-def test_elastic_resume_different_dp_degree(stage, tmp_path):
-    """Elastic resume: a dp=8 checkpoint restores into a dp=4 optimizer
-    by re-flattening the shards — every materialized param AND moment is
-    bitwise-identical to the dp=8 state, the stores live 1/4 per rank,
-    and continued training matches the dp=8 continuation to fp32
-    tolerance (the microbatch regrouping reorders the gradient mean)."""
-    s8, m8, o8, _ = _build(stage, dp=8, acc=None)
-    s8(paddle.to_tensor(X1), paddle.to_tensor(Y1))
+@pytest.mark.parametrize("stage,dp_from,dp_to", [
+    (1, 8, 4), (3, 8, 4),   # shrink: PR-7's original direction
+    (1, 4, 8), (3, 4, 8),   # GROW: the reform-up path's dependency —
+                            # flat stores re-flatten to MORE shards
+])
+def test_elastic_resume_different_dp_degree(stage, dp_from, dp_to,
+                                            tmp_path):
+    """Elastic resume in BOTH directions: a dp=d_from checkpoint
+    restores into a dp=d_to optimizer by re-flattening the shards —
+    every materialized param AND moment is bitwise-identical to the
+    d_from state, the stores live 1/d_to per rank, and continued
+    training matches the d_from continuation to fp32 tolerance (the
+    microbatch regrouping reorders the gradient mean). The grow
+    direction (d_to > d_from) is what a pod re-forming UPWARD after a
+    supervised respawn resumes through."""
+    sA, mA, oA, _ = _build(stage, dp=dp_from, acc=None)
+    sA(paddle.to_tensor(X1), paddle.to_tensor(Y1))
     checkpoint.CheckpointManager(str(tmp_path)).add_model(
-        m8).add_optimizer(o8).save(1)
-    p8 = [np.asarray(p._value).copy() for p in m8.parameters()]
-    mom8 = [np.asarray(o8._accumulators[("moment1", id(p))]._value).copy()
-            for p in m8.parameters()]
-    l2_8 = s8(paddle.to_tensor(X2), paddle.to_tensor(Y2)).numpy()
-    del s8, m8, o8
+        mA).add_optimizer(oA).save(1)
+    pA = [np.asarray(p._value).copy() for p in mA.parameters()]
+    momA = [np.asarray(oA._accumulators[("moment1", id(p))]._value).copy()
+            for p in mA.parameters()]
+    l2_A = sA(paddle.to_tensor(X2), paddle.to_tensor(Y2)).numpy()
+    del sA, mA, oA
     gc.collect()
 
-    s4, m4, o4, _ = _build(stage, dp=4, seed=99, acc=None)
+    sB, mB, oB, _ = _build(stage, dp=dp_to, seed=99, acc=None)
     meta = checkpoint.CheckpointManager(str(tmp_path)).add_model(
-        m4).add_optimizer(o4).restore()
-    assert meta["zero"]["opt"]["degree"] == 8 and o4._zero["degree"] == 4
-    for p, ref in zip(m4.parameters(), p8):
+        mB).add_optimizer(oB).restore()
+    assert meta["zero"]["opt"]["degree"] == dp_from \
+        and oB._zero["degree"] == dp_to
+    for p, ref in zip(mB.parameters(), pA):
         assert np.asarray(p._value).tobytes() == ref.tobytes(), p.name
-    for p, ref in zip(m4.parameters(), mom8):
-        got = np.asarray(o4._accumulators[("moment1", id(p))]._value)
+    for p, ref in zip(mB.parameters(), momA):
+        got = np.asarray(oB._accumulators[("moment1", id(p))]._value)
         assert got.tobytes() == ref.tobytes(), ("moment", p.name)
-    for sd in o4._zero["stores"]:
+    for sd in oB._zero["stores"]:
         for slot in sd:
             arr = sd[slot].tensor._value
-            assert len(arr.sharding.device_set) == 4
+            assert len(arr.sharding.device_set) == dp_to
             assert arr.addressable_shards[0].data.shape[0] == \
-                arr.shape[0] // 4
-    l2_4 = s4(paddle.to_tensor(X2), paddle.to_tensor(Y2)).numpy()
-    np.testing.assert_allclose(l2_4, l2_8, rtol=1e-6)
-    del s4, m4, o4
+                arr.shape[0] // dp_to
+    l2_B = sB(paddle.to_tensor(X2), paddle.to_tensor(Y2)).numpy()
+    np.testing.assert_allclose(l2_B, l2_A, rtol=1e-6)
+    del sB, mB, oB
 
 
 def test_zero3_restore_without_optimizer_rejected(tmp_path):

@@ -1,4 +1,5 @@
-"""Multi-host virtual pod runtime (ISSUE 11).
+"""Multi-host virtual pod runtime (ISSUE 11) + elastic scale-UP
+(ISSUE 12).
 
 The contract under test: a pod of REAL localhost processes survives a
 REAL SIGKILL of one rank mid-step — the failure is detected within the
@@ -7,12 +8,19 @@ size, elastically restore from the rank-0-committed multi-process
 checkpoint (per-rank shard files, one manifest), continue with losses
 within 1e-6 of a single-process control, and `tools/trace_view.py`
 merges every rank's run-log — the dead rank's included — into one
-trace. Plus the coordinator/runtime unit semantics (rendezvous,
-barrier-with-timeout, lease-expiry detection, deterministic allreduce,
-re-formation), the pod checkpoint partition/merge (including the ZeRO
-store re-flattening across rank files), and the satellite fixes
-(spawn signal reap, launcher grace teardown, barrier lint,
-per-rank ledger stats).
+trace. ISSUE 12 closes the loop UPWARD: the supervisor RESPAWNS the
+reaped rank under a budgeted-backoff RestartPolicy, the replacement
+parks in the coordinator's lobby, the survivors' next reform GROWS the
+world back, and every rank restores from the latest pod checkpoint —
+kill -> shrink -> heal -> grow, generations strictly monotone, losses
+still within 1e-6 of the uninterrupted control; three consecutive
+kill/heal cycles (one killing a replacement DURING its own restore)
+never deadlock. Plus the coordinator/runtime unit semantics
+(rendezvous, lobby admission, barrier-with-timeout, lease-expiry +
+straggler detection, deterministic allreduce, re-formation up and
+down), the pod checkpoint partition/merge, and the satellites
+(pod-failure flight dumps, respawn lint, reform timeline, shared
+restart policy).
 """
 import io
 import json
@@ -27,8 +35,9 @@ import time
 import numpy as np
 import pytest
 
-from paddle_tpu.distributed.pod import (BarrierTimeoutError, PodRuntime,
-                                        RankFailedError, start_coordinator)
+from paddle_tpu.distributed.pod import (BarrierTimeoutError, PodCoordinator,
+                                        PodRuntime, RankFailedError,
+                                        RestartPolicy, start_coordinator)
 from paddle_tpu.testing import faults
 from paddle_tpu.testing.virtual_pod import VirtualPod
 
@@ -286,9 +295,259 @@ class TestCoordinator:
         monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
         monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
         monkeypatch.setenv("PADDLE_POD_BARRIER_TIMEOUT", "12.5")
+        monkeypatch.setenv("PADDLE_POD_JOIN_TIMEOUT", "90")
         pod = PodRuntime.from_env()
         assert (pod.coordinator, pod.num_processes, pod.origin,
-                pod.barrier_timeout) == ("127.0.0.1:1234", 4, 2, 12.5)
+                pod.barrier_timeout, pod.join_timeout) == \
+            ("127.0.0.1:1234", 4, 2, 12.5, 90.0)
+
+    def test_lobby_join_and_reform_up(self):
+        """The kill->shrink->heal->grow lifecycle in-process: a
+        post-formation joiner parks in the LOBBY (running generation
+        undisturbed), survivors see it via pending_joiners(), and the
+        next reform GROWS the world — gen+1, the replacement admitted
+        at the appended rank, collectives spanning the new world, stale
+        generations still rejected loudly."""
+        coord, ep = start_coordinator(expected=2, lease_ttl=30.0)
+        pods, rep = {}, {}
+        try:
+            def run(r):
+                pods[r] = self._pod(ep, 2, r).init()
+
+            ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+            coord.mark_failed(1, "killed by SIGKILL (supervisor)")
+            with pytest.raises(RankFailedError):
+                pods[0].barrier("b", timeout=10.0)
+            assert pods[0].reform(timeout=10.0) == {
+                "gen": 1, "rank": 0, "world_size": 1}
+            assert pods[0].pending_joiners() == []
+
+            # the replacement joins: parked, NOT a member yet, and the
+            # survivor's generation does not move
+            def join_rep():
+                rep["pod"] = self._pod(ep, 2, 1,
+                                       join_timeout=30.0).init()
+
+            t = threading.Thread(target=join_rep)
+            t.start()
+            deadline = time.time() + 10
+            while pods[0].pending_joiners() != [1] \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert pods[0].pending_joiners() == [1]
+            assert pods[0].gen == 1 and pods[0].world_size == 1
+            assert coord.state()["members"] == {0: coord.state()
+                                                ["members"][0]}
+
+            # reform-up: the survivor keeps rank 0 (committer stays an
+            # incumbent), the joiner appends as rank 1, world grows
+            view = pods[0].reform(timeout=10.0)
+            t.join(15)
+            assert view == {"gen": 2, "rank": 0, "world_size": 2}
+            assert (rep["pod"].rank, rep["pod"].world_size,
+                    rep["pod"].gen) == (1, 2, 2)
+            assert rep["pod"].uid == coord.uid
+            assert pods[0].pending_joiners() == []
+
+            out = {}
+
+            def ar(p, r):
+                out[r] = p.allreduce(np.full(3, float(r + 1)),
+                                     name="healed", timeout=10.0)
+
+            ts = [threading.Thread(target=ar, args=(pods[0], 0)),
+                  threading.Thread(target=ar, args=(rep["pod"], 1))]
+            [t.start() for t in ts]
+            [t.join(15) for t in ts]
+            np.testing.assert_array_equal(out[0], np.full(3, 3.0))
+            np.testing.assert_array_equal(out[1], np.full(3, 3.0))
+            # the shrunk generation is history: its ops are rejected
+            resp = coord.handle_req({"op": "barrier", "rank": 0,
+                                     "gen": 1, "name": "x",
+                                     "timeout": 1.0})
+            assert resp == {"ok": False, "error": "stale_gen", "gen": 2}
+        finally:
+            for p in list(pods.values()) + list(rep.values()):
+                p.shutdown()
+            coord.close()
+
+    def test_net_new_rank_scales_out_beyond_original_world(self):
+        """The lobby is not only for replacements: a NET-NEW origin
+        joining a healthy formed pod is admitted at the next reform and
+        the world grows past the launch size (scale-out)."""
+        coord, ep = start_coordinator(expected=2, lease_ttl=30.0)
+        pods, new = {}, {}
+        try:
+            def run(r):
+                pods[r] = self._pod(ep, 2, r).init()
+
+            ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+
+            def join_new():
+                new["pod"] = self._pod(ep, 2, 7,
+                                       join_timeout=30.0).init()
+
+            t = threading.Thread(target=join_new)
+            t.start()
+            deadline = time.time() + 10
+            while pods[0].pending_joiners() != [7] \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            views = {}
+
+            def ref(r):
+                views[r] = pods[r].reform(timeout=10.0)
+
+            ts = [threading.Thread(target=ref, args=(r,)) for r in (0, 1)]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+            t.join(15)
+            assert views[0]["world_size"] == views[1]["world_size"] == 3
+            assert (new["pod"].rank, new["pod"].world_size,
+                    new["pod"].gen) == (2, 3, 1)
+            # data re-shards over the grown world
+            assert new["pod"].shard_range(9) == (6, 9)
+        finally:
+            for p in list(pods.values()) + list(new.values()):
+                p.shutdown()
+            coord.close()
+
+    def test_replacement_joining_before_reform_parks_not_bounces(self):
+        """The race the supervisor creates on every fast respawn: the
+        dead rank is marked failed but the survivors have NOT reformed
+        yet (mid-step), so its origin still sits in the roster. The
+        replacement's join must PARK in the lobby (a failed member no
+        longer owns its origin) — bouncing it as duplicate_origin would
+        burn one RestartPolicy attempt per incarnation until the budget
+        dies and the pod stays degraded forever. A single reform then
+        does shrink+grow in one transition: dead rank out, replacement
+        in, world size preserved."""
+        coord, ep = start_coordinator(expected=2, lease_ttl=30.0)
+        pods, rep = {}, {}
+        try:
+            def run(r):
+                pods[r] = self._pod(ep, 2, r).init()
+
+            ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+            coord.mark_failed(1, "killed by SIGKILL (supervisor)")
+            # NO reform yet — the dead rank is still in the roster
+
+            def join_rep():
+                rep["pod"] = self._pod(ep, 2, 1,
+                                       join_timeout=30.0).init()
+
+            t = threading.Thread(target=join_rep)
+            t.start()
+            deadline = time.time() + 10
+            while pods[0].pending_joiners() != [1] \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            assert pods[0].pending_joiners() == [1]  # parked, not bounced
+            # the survivor learns of the death within a heartbeat
+            with pytest.raises(RankFailedError):
+                while time.time() < deadline:
+                    pods[0].check_failures()
+                    time.sleep(0.05)
+                raise AssertionError("failure never surfaced")
+            view = pods[0].reform(timeout=10.0)
+            t.join(15)
+            assert view == {"gen": 1, "rank": 0, "world_size": 2}
+            assert (rep["pod"].rank, rep["pod"].world_size,
+                    rep["pod"].gen) == (1, 2, 1)
+        finally:
+            for p in list(pods.values()) + list(rep.values()):
+                p.shutdown()
+            coord.close()
+
+    def test_duplicate_origin_rejected_from_lobby(self):
+        """A live origin cannot be shadowed by a lobby joiner — only a
+        REPLACEMENT (predecessor marked failed) may reuse the id."""
+        coord, ep = start_coordinator(expected=2, lease_ttl=30.0)
+        pods = {}
+        try:
+            def run(r):
+                pods[r] = self._pod(ep, 2, r).init()
+
+            ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+            from paddle_tpu.distributed.pod import PodError
+            with pytest.raises(PodError, match="duplicate_origin"):
+                self._pod(ep, 2, 1, join_timeout=5.0).init()
+        finally:
+            for p in pods.values():
+                p.shutdown()
+            coord.close()
+
+    def test_straggler_detection_before_failure(self, tmp_path):
+        """A slow-but-alive rank (heartbeat gap past the straggler
+        threshold but under the lease ttl) surfaces in stragglers(),
+        heartbeat_stats percentiles, pod_rank_heartbeat_ms gauges, and
+        an edge-triggered pod_straggler run-log event — BEFORE it ever
+        becomes a failure."""
+        from paddle_tpu.observability import export, runlog
+        log_path = str(tmp_path / "sup.jsonl")
+        runlog.start_run(path=log_path, rank=0, run_id="strag")
+        coord = PodCoordinator(("127.0.0.1", 0), expected=2,
+                               lease_ttl=30.0, monitor_interval=0.1,
+                               straggler_threshold=0.3)
+        serve = threading.Thread(target=coord.serve_forever, daemon=True)
+        serve.start()
+        ep = coord.endpoint
+        pods = {}
+        try:
+            def run(r, hb):
+                pods[r] = PodRuntime(ep, 2, r, heartbeat_interval=hb,
+                                     barrier_timeout=10.0).init()
+
+            ts = [threading.Thread(target=run, args=(0, 0.05)),
+                  threading.Thread(target=run, args=(1, 1.2))]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+            # rank 1 beats every 1.2s: its gap spends most of its time
+            # past the 0.3s threshold; rank 0 (50ms) never does
+            deadline = time.time() + 10
+            seen = set()
+            while time.time() < deadline:
+                seen.update(coord.stragglers())
+                if 1 in seen:
+                    break
+                time.sleep(0.05)
+            assert 1 in seen and 0 not in seen
+            # the runtime-side query agrees
+            assert pods[0].stragglers(threshold=0.3) in ([], [1])
+            # gap HISTORY needs rank 1's first (late) heartbeat to land
+            stats = coord.heartbeat_stats()
+            while "max_ms" not in stats.get(1, {}) \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+                stats = coord.heartbeat_stats()
+            assert stats[1]["max_ms"] > 300 > stats[0]["p95_ms"]
+            gauges = export.gauges()
+            assert any(k.startswith('pod_rank_heartbeat_ms{rank="1"')
+                       for k in gauges), sorted(gauges)
+            # the lease never expired: no failure, only the warning
+            assert coord.state()["failed"] == {}
+        finally:
+            for p in pods.values():
+                p.shutdown()
+            coord.close()
+            runlog.stop_run()
+        with open(log_path) as f:
+            events = [json.loads(line) for line in f]
+        strag = [e for e in events if e.get("event") == "pod_straggler"]
+        assert strag and strag[0]["origin"] == 1
+        assert strag[0]["gap_ms"] > 300
+        # edge-triggered: at most one event per 1.2s heartbeat episode,
+        # NOT one per 0.1s monitor sweep (the sweeps outnumber the
+        # episodes ~12:1 — an un-edge-triggered emitter would spam)
+        assert len(strag) <= 8
 
 
 # ------------------------------------------------------- pod checkpointing
@@ -622,6 +881,225 @@ def test_barrier_without_timeout_lint_rule(tmp_path):
             if f.rule == "barrier-without-timeout"] == []
 
 
+class TestRestartPolicy:
+    """The shared budgeted-backoff policy (distributed/restart.py) —
+    the pod supervisor's respawn pacing and fleet/elastic.py's relaunch
+    pacing are this one object."""
+
+    def test_budget_bounds_and_reset_reopens(self):
+        p = RestartPolicy(max_restarts=3, base_delay=0.1, jitter=0.0)
+        delays = [p.schedule("r1") for _ in range(5)]
+        assert all(d is not None for d in delays[:3])
+        assert delays[3] is None and delays[4] is None
+        assert p.attempts("r1") == 3
+        # keys are independent budgets
+        assert p.schedule("r2") is not None
+        p.reset("r1")
+        assert p.schedule("r1") is not None
+
+    def test_exponential_backoff_capped(self):
+        p = RestartPolicy(max_restarts=6, base_delay=0.2, factor=2.0,
+                          max_delay=1.0, jitter=0.0)
+        got = [p.schedule("k") for _ in range(5)]
+        assert got == [0.2, 0.4, 0.8, 1.0, 1.0]
+
+    def test_jitter_is_seeded_and_bounded(self):
+        a = [RestartPolicy(max_restarts=4, base_delay=1.0, jitter=0.25,
+                           seed=7).schedule("k") for _ in range(1)]
+        b = RestartPolicy(max_restarts=4, base_delay=1.0, jitter=0.25,
+                          seed=7)
+        c = RestartPolicy(max_restarts=4, base_delay=1.0, jitter=0.25,
+                          seed=8)
+        assert a[0] == b.schedule("k")          # same seed replays
+        assert b.schedule("k") != c.schedule("k")
+        assert 0.75 <= a[0] <= 1.25             # symmetric, bounded
+
+    def test_sliding_window_ages_out_attempts(self):
+        p = RestartPolicy(max_restarts=2, base_delay=0.1, jitter=0.0,
+                          window_s=10.0)
+        assert p.schedule("k", now=0.0) is not None
+        assert p.schedule("k", now=1.0) is not None
+        assert p.schedule("k", now=5.0) is None     # budget spent
+        assert p.schedule("k", now=20.0) is not None  # window aged out
+
+
+class _FakeProc:
+    def __init__(self, rc_script):
+        self._rc = rc_script  # callable() -> poll value
+        self.terminated = False
+
+    def poll(self):
+        return self._rc()
+
+    def terminate(self):
+        self.terminated = True
+
+
+def test_elastic_relaunch_shares_restart_policy(tmp_path):
+    """fleet/elastic.py's KV-relaunch path (the reference's
+    watch->restart loop) paces itself through the SAME RestartPolicy
+    the pod supervisor uses: a dead child is relaunched after backoff,
+    a clean exit under stable membership completes, and an exhausted
+    budget EXITS instead of crash-looping."""
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus,
+                                                      FileKVStore)
+    store = FileKVStore(str(tmp_path))
+    mgr = ElasticManager("n1:1", np=1, job_id="j", store=store, ttl=30,
+                         heartbeat_interval=0.2)
+    mgr.register()
+    try:
+        spawned = []
+
+        def spawn_dies_then_completes():
+            rc = (lambda: 1) if not spawned else (lambda: 0)
+            proc = _FakeProc(rc)
+            spawned.append(proc)
+            return proc
+
+        policy = RestartPolicy(max_restarts=2, base_delay=0.01,
+                               jitter=0.0, seed=0)
+        status, proc = mgr.relaunch(spawn_dies_then_completes,
+                                    policy=policy, watch_interval=0.05)
+        assert status == ElasticStatus.COMPLETED
+        assert len(spawned) == 2 and proc is spawned[1]
+        assert policy.attempts(mgr.endpoint) == 1
+
+        # budget exhaustion: every child dies -> EXIT, bounded spawns
+        spawned.clear()
+
+        def spawn_always_dies():
+            proc = _FakeProc(lambda: 1)
+            spawned.append(proc)
+            return proc
+
+        status, proc = mgr.relaunch(
+            spawn_always_dies,
+            policy=RestartPolicy(max_restarts=2, base_delay=0.01,
+                                 jitter=0.0),
+            watch_interval=0.05)
+        assert status == ElasticStatus.EXIT and proc is None
+        assert len(spawned) == 3  # initial + exactly max_restarts
+    finally:
+        mgr.exit()
+
+
+def test_pod_failure_triggers_flight_dump(tmp_path):
+    """Satellite: RankFailedError and BarrierTimeoutError each leave an
+    atomic flight dump (reason="pod_failure") naming the dead/absent
+    origin ranks BEFORE any reform — the post-mortem exists even though
+    the survivor recovers and keeps running."""
+    from paddle_tpu.observability import flight
+    flight.install(str(tmp_path))
+    coord, ep = start_coordinator(expected=2, lease_ttl=30.0)
+    pods = {}
+    try:
+        def run(r):
+            pods[r] = PodRuntime(ep, 2, r, heartbeat_interval=0.1,
+                                 barrier_timeout=10.0).init()
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+        [t.start() for t in ts]
+        [t.join(30) for t in ts]
+
+        # absent rank -> BarrierTimeoutError dump
+        with pytest.raises(BarrierTimeoutError):
+            pods[0].barrier("never", timeout=0.5)
+        with open(flight.latest_dump()) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "pod_failure"
+        assert dump["pod_failure"]["absent_ranks"] == [1]
+        assert dump["pod_failure"]["op"] == "never"
+        assert dump["exception"]["type"] == "BarrierTimeoutError"
+
+        # dead rank -> RankFailedError dump
+        coord.mark_failed(1, "killed by SIGKILL (supervisor)")
+        with pytest.raises(RankFailedError):
+            pods[0].barrier("b", timeout=10.0)
+        with open(flight.latest_dump()) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "pod_failure"
+        assert dump["pod_failure"]["failed_ranks"] == [1]
+        assert dump["pod_failure"]["gen"] == 0
+        # the survivor reforms and keeps running — the dump persists
+        assert pods[0].reform(timeout=10.0)["world_size"] == 1
+    finally:
+        flight.uninstall()
+        for p in pods.values():
+            p.shutdown()
+        coord.close()
+
+
+def test_respawn_without_backoff_lint_rule(tmp_path):
+    from paddle_tpu.analysis import lint_source
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import subprocess, time\n"
+        "def keep_alive(cmd):\n"
+        "    while True:\n"                       # unpaced keep-alive
+        "        proc = subprocess.Popen(cmd)\n"
+        "        proc.wait()\n"
+        "def bounded(spawn_fn):\n"
+        "    for _ in range(5):\n"                # bounded but unpaced
+        "        try:\n"
+        "            spawn_fn()\n"
+        "        except OSError:\n"
+        "            pass\n"
+        "def fanout(trainers, spawn_trainer):\n"
+        "    for t in trainers:\n"                # one spawn per item
+        "        spawn_trainer(t)\n"
+        "def good(policy, spawn_fn):\n"
+        "    while True:\n"
+        "        delay = policy.schedule('k')\n"
+        "        if delay is None:\n"
+        "            return\n"
+        "        time.sleep(delay)\n"
+        "        spawn_fn()\n")
+    found = [f for f in lint_source(paths=[str(bad)])
+             if f.rule == "respawn-without-backoff"]
+    assert len(found) == 2
+    assert all(f.severity == "error" for f in found)
+    assert {f.loc.rsplit(":", 1)[1] for f in found} == {"3", "7"}
+    # the default sweep (distributed/ + fleet/elastic.py + the
+    # supervisor) is clean: every real respawn loop rides RestartPolicy
+    assert [f for f in lint_source()
+            if f.rule == "respawn-without-backoff"] == []
+
+
+def test_trace_view_reform_timeline(tmp_path):
+    """Satellite: pod_reform events (direction, worlds, gen) from every
+    rank's run-log collapse into one ordered reform timeline in
+    trace_view --stats."""
+    from paddle_tpu.observability import runlog
+    import trace_view
+
+    paths = []
+    for r in (0, 1):
+        p = str(tmp_path / f"pod.rank{r}.jsonl")
+        runlog.start_run(path=p, rank=r, run_id="heal")
+        runlog.event("pod_reform", rank=0 if r == 0 else 1, world=1,
+                     gen=1, direction="shrink", old_world=2, new_world=1,
+                     took_s=0.21)
+        if r == 0:
+            runlog.event("pod_reform", rank=0, world=2, gen=2,
+                         direction="grow", old_world=1, new_world=2,
+                         took_s=0.35)
+        runlog.stop_run()
+        paths.append(p)
+    events, n_bad = trace_view.load_events(paths)
+    assert n_bad == 0
+    timeline = trace_view.reform_timeline(events)
+    assert [(e["gen"], e["direction"], e["old_world"], e["new_world"])
+            for e in timeline] == [(1, "shrink", 2, 1), (2, "grow", 1, 2)]
+    assert timeline[1]["took_s"] == 0.35
+    buf = io.StringIO()
+    trace_view.print_stats(events, n_bad, file=buf)
+    out = buf.getvalue()
+    assert "reform timeline:" in out
+    assert re.search(r"gen 1: shrink\s+world 2->1", out)
+    assert re.search(r"gen 2: grow\s+world 1->2", out)
+
+
 def test_trace_view_stats_sums_ledger_across_ranks(tmp_path):
     """Satellite: per-rank state-ledger snapshots in each rank's runlog
     sum into a pod-wide residency line in trace_view --stats."""
@@ -796,6 +1274,185 @@ def test_pod_kill_sweep_2proc(tmp_path, victim, point, nth):
     assert f"FAILURE_DETECTED" in log and f"failed=[{victim}]" in log, log
     assert "REFORMED rank=0 world=1 gen=1" in log
     assert "DONE rank=0 world=1" in log
+    _assert_no_torn_checkpoint(root)
+
+
+def _reformed_transitions(log):
+    """[(world, gen, dir)] in print order from a rank's log."""
+    return [(int(m.group(1)), int(m.group(2)), m.group(3))
+            for m in re.finditer(
+                r"REFORMED rank=\d+ world=(\d+) gen=(\d+) dir=(\w+)", log)]
+
+
+def test_pod_kill_heal_grow_back_to_full_world(tmp_path_factory):
+    """THE scale-UP acceptance run: 2 real processes, rank 1 SIGKILLed
+    mid-step -> shrink to world 1 -> the supervisor RESPAWNS it
+    (RestartPolicy backoff) -> the replacement parks in the lobby ->
+    reform-up back to world 2 -> both ranks restore from the latest pod
+    checkpoint -> the tail of the run executes at FULL world, and every
+    step's loss is within 1e-6 of the uninterrupted control. The merged
+    runlogs carry the shrink AND grow pod_reform events (direction,
+    worlds, generations strictly monotone)."""
+    import trace_view
+
+    control = _control_losses(tmp_path_factory)
+    wd = str(tmp_path_factory.mktemp("pod_heal"))
+    root = os.path.join(wd, "ck")
+    pod = VirtualPod(2, FIXTURE, workdir=wd,
+                     kill=(1, "pod/mid_step", 5), lease_ttl=LEASE_TTL,
+                     restart=RestartPolicy(max_restarts=2,
+                                           base_delay=0.2, seed=0),
+                     env={"POD_FIX_CKPT_ROOT": root,
+                          "POD_FIX_TARGET_WORLD": "2",
+                          "POD_FIX_HEAL_BY_STEP": "6"})
+    exits = pod.run(timeout=240)
+
+    # the kill was real — and the LAST incarnation of rank 1 finished
+    kills = [e for e in pod.exit_history
+             if e.rank == 1 and e.signal == "SIGKILL"]
+    assert len(kills) == 1 and kills[0].incarnation == 1
+    assert exits[0].returncode == 0, pod.tail_logs()
+    assert exits[1].returncode == 0 and exits[1].incarnation == 2, \
+        pod.tail_logs()
+
+    log0, log1 = pod.log(0), pod.log(1)
+    # detection within the window, then the full lifecycle in order:
+    # shrink to 1 (gen 1), grow back to 2 (gen 2)
+    m = re.search(r"FAILURE_DETECTED t=([\d.]+) failed=\[1\]", log0)
+    assert m, log0
+    assert float(m.group(1)) - kills[0].t_reaped < LEASE_TTL + 2.0
+    assert _reformed_transitions(log0) == [(1, 1, "shrink"),
+                                           (2, 2, "grow")]
+    assert "DONE rank=0 world=2" in log0
+    # the replacement joined the SAME log (append), re-formed at gen 2,
+    # resumed from the shared checkpoint and finished at full world
+    assert log1.count("POD_READY rank=1") == 2
+    assert "POD_READY rank=1 world=2 gen=2" in log1
+    assert "DONE rank=1 world=2" in log1
+
+    # losses: every step within 1e-6 of the single-process control —
+    # pre-kill at world 2, degraded at world 1, healed at world 2
+    for log in (log0, log1):
+        got = _losses_by_step(log)
+        for s, v in got.items():
+            assert abs(v - control[s]) < 1e-6, (s, v, control[s])
+    assert sorted(_losses_by_step(log0)) == sorted(control)
+    # the healed tail REALLY ran at world 2: the replacement computed
+    # the final steps too
+    assert {6, 7} <= set(_losses_by_step(log1))
+
+    _assert_no_torn_checkpoint(root)
+
+    # merged trace: 3 process logs (rank0, rank1, rank1's replacement),
+    # reform timeline shrink->grow with strictly monotone generations
+    paths = pod.runlog_paths()
+    assert len(paths) == 3
+    events, _ = trace_view.load_events(paths)
+    timeline = trace_view.reform_timeline(events)
+    assert [(e["gen"], e["direction"]) for e in timeline] == \
+        [(1, "shrink"), (2, "grow")]
+    gens = [e["gen"] for e in timeline]
+    assert gens == sorted(gens) and len(set(gens)) == len(gens)
+    ev_names = {e.get("event") for e in events if e.get("kind") == "event"}
+    assert {"process_kill", "pod_reform", "checkpoint_publish",
+            "checkpoint_restore", "pod_join"} <= ev_names
+    # the replacement's own log records that it came in via the lobby
+    lobby_joins = [e for e in events if e.get("event") == "pod_join"
+                   and e.get("via") == "lobby"]
+    assert lobby_joins and lobby_joins[0]["gen"] == 2
+
+
+@pytest.mark.chaos
+def test_pod_three_kill_heal_cycles_monotone_generations(
+        tmp_path_factory):
+    """Chaos acceptance: THREE consecutive kill/heal cycles on one pod —
+    the original rank 1 killed mid-step, its first replacement killed
+    DURING ITS OWN ELASTIC RESTORE (checkpoint/pod_restore), the second
+    replacement killed mid-step again, the third replacement finishing
+    clean. No deadlock, generations strictly monotone
+    (0->1->2->3->4->5->6), no torn checkpoint, and the final losses
+    still match the uninterrupted control at every step."""
+    control = _control_losses(tmp_path_factory)
+    wd = str(tmp_path_factory.mktemp("pod_3cycle"))
+    root = os.path.join(wd, "ck")
+    pod = VirtualPod(
+        2, FIXTURE, workdir=wd,
+        kill=(1, "pod/mid_step", 5),
+        respawn_kills={1: [("checkpoint/pod_restore", 1),
+                           ("pod/mid_step", 2), None]},
+        lease_ttl=LEASE_TTL,
+        restart=RestartPolicy(max_restarts=4, base_delay=0.2, seed=0),
+        env={"POD_FIX_CKPT_ROOT": root, "POD_FIX_TARGET_WORLD": "2",
+             "POD_FIX_HEAL_BY_STEP": "6"})
+    exits = pod.run(timeout=300)
+
+    kills = [e for e in pod.exit_history
+             if e.rank == 1 and e.signal == "SIGKILL"]
+    assert [k.incarnation for k in kills] == [1, 2, 3], pod.exit_history
+    assert exits[0].returncode == 0, pod.tail_logs()
+    assert exits[1].returncode == 0 and exits[1].incarnation == 4
+
+    log0 = pod.log(0)
+    trans = _reformed_transitions(log0)
+    gens = [g for _w, g, _d in trans]
+    assert gens == sorted(gens) and len(set(gens)) == len(gens), trans
+    assert gens[-1] == 6, trans  # 3 shrinks + 3 grows
+    assert [d for _w, _g, d in trans] == \
+        ["shrink", "grow"] * 3, trans
+    assert trans[-1][0] == 2  # healed back to full world at the end
+    assert "DONE rank=0 world=2" in log0
+    assert "DONE rank=1 world=2" in pod.log(1)
+
+    # the mid-restore kill really happened at the restore point
+    import trace_view
+    events, _ = trace_view.load_events(pod.runlog_paths())
+    kill_points = {e.get("point") for e in events
+                   if e.get("event") == "process_kill"}
+    assert {"pod/mid_step", "checkpoint/pod_restore"} <= kill_points
+
+    _assert_no_torn_checkpoint(root)
+    for s, v in _losses_by_step(log0).items():
+        assert abs(v - control[s]) < 1e-6, (s, v, control[s])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("victim", [0, 3])
+def test_pod_kill_heal_4proc(tmp_path, victim):
+    """The 4-process heal sweep (slow tier): kill the committer (0) and
+    the last rank (3) mid-step — three survivors shrink to world 3,
+    the supervisor respawns the victim, the pod grows back to world 4,
+    and all four ranks finish the 8-step trajectory at full world."""
+    root = os.path.join(str(tmp_path), "ck")
+    pod = VirtualPod(
+        4, FIXTURE, workdir=str(tmp_path),
+        kill=(victim, "pod/mid_step", 5), lease_ttl=LEASE_TTL,
+        restart=RestartPolicy(max_restarts=2, base_delay=0.2, seed=0),
+        env={"POD_FIX_CKPT_ROOT": root, "POD_FIX_TARGET_WORLD": "4",
+             "POD_FIX_HEAL_BY_STEP": "6"})
+    exits = pod.run(timeout=300)
+    kills = [e for e in pod.exit_history
+             if e.rank == victim and e.signal == "SIGKILL"]
+    assert len(kills) == 1 and kills[0].incarnation == 1
+    done = 0
+    final = {}
+    for r in range(4):
+        assert exits[r].returncode == 0, pod.tail_logs()
+        log = pod.log(r)
+        if re.search(r"DONE rank=\d world=4", log):
+            done += 1
+        losses = _losses_by_step(log)
+        if losses:
+            final[r] = losses
+    assert done == 4, pod.tail_logs()
+    survivor = 1 if victim == 0 else 0
+    trans = _reformed_transitions(pod.log(survivor))
+    assert (3, 1, "shrink") in trans and (4, 2, "grow") in trans, trans
+    base = final[survivor]
+    assert sorted(base) == list(range(8))
+    for r, losses in final.items():
+        for s, v in losses.items():
+            assert abs(v - base[s]) < 1e-9, (r, s)
     _assert_no_torn_checkpoint(root)
 
 

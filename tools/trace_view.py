@@ -232,6 +232,31 @@ def state_residency(events):
     return dict(cats), len(last)
 
 
+def reform_timeline(events):
+    """The pod's generation history from ``pod_reform`` run-log events:
+    one entry per generation (every rank logs the same transition —
+    grouped by gen, earliest wall time wins), ordered by generation.
+    Each entry: ``{"gen", "direction", "old_world", "new_world", "t"
+    (wall seconds), "took_s" (slowest rank's reform wall time)}`` — the
+    kill→shrink→heal→grow lifecycle as a timeline."""
+    by_gen = {}
+    for r in events:
+        if r.get("kind") != "event" or r.get("event") != "pod_reform":
+            continue
+        gen = r.get("gen")
+        wall = (r.get("t", 0) + r["_offset_ns"]) / 1e9
+        cur = by_gen.setdefault(gen, {
+            "gen": gen, "direction": r.get("direction"),
+            "old_world": r.get("old_world"), "new_world": r.get("new_world",
+                                                               r.get("world")),
+            "t": wall, "took_s": r.get("took_s", 0) or 0})
+        cur["t"] = min(cur["t"], wall)
+        cur["took_s"] = max(cur["took_s"], r.get("took_s", 0) or 0)
+        if cur.get("direction") is None:
+            cur["direction"] = r.get("direction")
+    return [by_gen[g] for g in sorted(by_gen, key=lambda g: (g is None, g))]
+
+
 def print_stats(events, n_bad, file=None):
     file = file if file is not None else sys.stdout
     spans = [r for r in events if r.get("kind") == "span"]
@@ -259,6 +284,17 @@ def print_stats(events, n_bad, file=None):
                           for c, b in sorted(cats.items(),
                                              key=lambda kv: -kv[1])),
               file=file)
+    timeline = reform_timeline(events)
+    if timeline:
+        t0 = min(e["t"] for e in timeline)
+        print("  reform timeline:", file=file)
+        for e in timeline:
+            worlds = (f"world {e['old_world']}->{e['new_world']}"
+                      if e.get("old_world") is not None
+                      else f"world {e['new_world']}")
+            print(f"    gen {e['gen']}: {e.get('direction') or '?':<6} "
+                  f"{worlds} at +{e['t'] - t0:.3f}s "
+                  f"(reform {e['took_s']:.3f}s)", file=file)
     top = traces.most_common(5)
     if top:
         print("  largest traces: " + ", ".join(
