@@ -63,6 +63,19 @@ def main(argv=None):
                          "update + reduce/all_gather once per window "
                          "(cuts collective bytes per step ~N x for "
                          "zero<=1; needs k %% N == 0)")
+    ap.add_argument("--remat", default="none",
+                    choices=("none", "full", "selective", "offload"),
+                    help="activation-recompute policy applied per "
+                         "encoder layer (paddle_tpu.recompute): trade "
+                         "recompute FLOPs (full), saved matmul outputs "
+                         "(selective), or host traffic (offload — falls "
+                         "back loudly to selective without a "
+                         "pinned_host memory space) for the HBM the "
+                         "backward otherwise holds — then spend it on "
+                         "--batch/--k")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="override the per-step batch size (the knob "
+                         "the remat-freed HBM buys back)")
     args_cli = ap.parse_args(argv)
     if args_cli.zero:
         args_cli.scan = True  # ZeRO is an option of the scan step program
@@ -90,6 +103,10 @@ def main(argv=None):
         batch, seq, k, iters, warmup, windows = 4, 128, 2, 2, 1, 1
     if args_cli.k:
         k = args_cli.k
+    if args_cli.batch is not None:
+        if args_cli.batch < 1:
+            raise SystemExit(f"--batch must be >= 1, got {args_cli.batch}")
+        batch = args_cli.batch
 
     dp = 1
     if args_cli.zero:
@@ -100,6 +117,12 @@ def main(argv=None):
             batch = max(dp, batch - batch % dp)
 
     model = BertForPretraining(cfg)
+    if args_cli.remat != "none":
+        # per-encoder-layer remat segments (the granularity that pays:
+        # layer boundaries are the only fwd->bwd residuals left; each
+        # layer's attention/FFN internals rematerialize in backward)
+        for layer in model.bert.layers:
+            layer.enable_recompute(args_cli.remat)
     if on_tpu:
         model.to("bfloat16")  # pure-bf16 params, fp32 masters in AdamW
     opt = paddle.optimizer.AdamW(parameters=model.parameters(),
@@ -220,8 +243,24 @@ def main(argv=None):
     print(f"# backend={backend} batch={batch} seq={seq} k={k} "
           f"structure={'scan' if args_cli.scan else 'unroll'} "
           f"zero={args_cli.zero} accumulate={args_cli.accumulate} "
+          f"remat={args_cli.remat} "
           f"mfu={mfu:.3f} timer_mfu={t.get('mfu', 0.0):.3f} "
           f"loss={loss_val:.3f}", file=sys.stderr)
+    if args_cli.remat != "none":
+        # memory side of the trade: XLA attribution (meaningful on TPU,
+        # where barriers survive) + the backend-independent jaxpr
+        # liveness peak (the meter that shows remat even on CPU) — run
+        # `--remat none` back to back for the A/B
+        try:
+            xs = next(iter(step.memory_stats().values()))
+            ts = next(iter(step.traced_memory_stats().values()))
+            print(f"# remat memory: xla_temp={xs['temp_bytes']} "
+                  f"xla_peak={xs['peak_bytes']} "
+                  f"host_offload={xs.get('host_offload_bytes', 0)} "
+                  f"jaxpr_peak={ts['peak_bytes']}", file=sys.stderr)
+        except Exception as e:
+            print(f"# remat memory stats unavailable: {e}",
+                  file=sys.stderr)
     if args_cli.zero or args_cli.accumulate > 1:
         # after the timed windows (the AOT stats path recompiles once):
         # the psum_scatter-vs-psum evidence for this structure, plus the

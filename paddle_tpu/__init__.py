@@ -63,6 +63,7 @@ from . import checkpoint  # noqa: F401
 from . import testing  # noqa: F401
 from . import incubate  # noqa: F401
 
+from . import recompute  # noqa: F401
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401
 from . import observability  # noqa: F401

@@ -219,6 +219,57 @@ def _zero3_ranks():
     return pairs
 
 
+def _remat_like():
+    """Activation-recompute structures, both representations:
+
+    1. the POLICY SURFACE program — a Linear/ReLU/Linear block run
+       through ``paddle_tpu.recompute`` under ``program_guard``, which
+       records ONE fused ``recompute`` op (the control-flow fused-op
+       discipline: capture probes never leak into the Program);
+    2. the EXPANDED rewrite — the segment's forward ops re-recorded in
+       the backward region writing the SAME slots, stamped with
+       ``recompute.remat_replay`` and feeding a grad consumer, the
+       reference recompute_optimizer's backward-block replay shape. The
+       graph verifier must read the stamped re-writes as
+       rematerialization, not ``duplicate-slot-write`` (tests seed the
+       unstamped variant it must still reject)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, recompute as rc, static
+    from paddle_tpu.static.program import _OpRecord
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        y = static.data("label", [4], "int64")
+        blk = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 8))
+        h = rc.recompute(blk, x, policy="selective")
+        w = static.create_parameter([8, 16], "float32")
+        logits = paddle.matmul(h, w)
+        loss = nn.functional.cross_entropy(logits, y)
+    pairs = [(prog, [loss])]
+
+    prog2 = static.Program()
+    with static.program_guard(prog2):
+        x2 = static.data("x", [4, 8], "float32")
+        w1 = static.create_parameter([8, 16], "float32")
+        w2 = static.create_parameter([16, 8], "float32")
+        h1 = paddle.matmul(x2, w1)
+        a1 = nn.functional.relu(h1)
+        h2 = paddle.matmul(a1, w2)
+        loss2 = paddle.mean(h2)
+    seg = list(prog2.ops[:3])  # the forward segment to rematerialize
+    for op in seg:
+        replay = rc.remat_replay(
+            lambda *a, _fn=op.fn, **k: _fn(*a, **k))
+        prog2.ops.append(_OpRecord(replay, op.arg_slots, op.kwarg_slots,
+                                   op.out_slots, op.name))
+    with static.program_guard(prog2):
+        # the backward-region consumer of the replayed activations
+        gw2 = paddle.matmul(paddle.transpose(a1, [1, 0]), h2)
+    pairs.append((prog2, [loss2, gw2]))
+    return pairs
+
+
 def _ctr_like():
     """wide & deep CTR core — slot-id embedding gathers (the cached
     scan-window lookup is a gather from a device table; the Embedding
@@ -277,6 +328,7 @@ LADDER_BUILDERS = {
     "detection": _detection_like,
     "hbm_cache": _hbm_cache_like,
     "ctr": _ctr_like,
+    "remat": _remat_like,
     "serving": _serving_like,
     "allreduce": _allreduce_ranks,
     "zero1": _zero1_ranks,

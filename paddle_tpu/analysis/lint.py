@@ -42,6 +42,15 @@ Two surfaces:
     (``distributed.pod.PodRuntime.barrier`` raises
     ``BarrierTimeoutError`` naming the absent ranks). Scanned by
     default over ``distributed/`` (``BARRIER_PATHS``).
+  * ``raw-remat-outside-policy``: a direct ``jax.remat`` /
+    ``jax.checkpoint`` call in model/layer code. Which activations are
+    worth saving — and whether saved residuals park in device or pinned
+    host memory — is a BACKEND decision; a model that hardcodes a jax
+    policy can't be re-tuned per backend. Route segments through
+    ``paddle_tpu.recompute`` (``recompute(fn, policy=...)`` /
+    ``Layer.enable_recompute``) so policies stay swappable. Scanned by
+    default over the model/layer sources (``REMAT_PATHS``);
+    ``paddle_tpu/recompute.py`` itself is the one legitimate caller.
   * ``respawn-without-backoff``: a retry-shaped loop (``while`` or
     ``for range(...)``) that spawns/relaunches a PROCESS with no
     backoff/budget evidence — an ERROR. An unpaced respawn loop turns a
@@ -61,7 +70,7 @@ import os
 from .findings import ERROR, WARNING, Finding
 
 __all__ = ["lint_program", "lint_source", "HOT_PATHS", "RPC_PATHS",
-           "SPAN_PATHS", "BARRIER_PATHS", "RESPAWN_PATHS"]
+           "SPAN_PATHS", "BARRIER_PATHS", "RESPAWN_PATHS", "REMAT_PATHS"]
 
 # host-callback op names: each is a device->host round-trip inside the
 # compiled program (stalls the TPU pipeline every step)
@@ -131,6 +140,23 @@ RESPAWN_PATHS = (
     os.path.join("paddle_tpu", "distributed", "fleet", "elastic.py"),
     os.path.join("paddle_tpu", "testing", "virtual_pod.py"),
 )
+
+# model/layer sources scanned by default for raw-remat-outside-policy:
+# directories expand recursively; paddle_tpu/recompute.py is the policy
+# surface itself and is exempt
+REMAT_PATHS = (
+    os.path.join("paddle_tpu", "models"),
+    os.path.join("paddle_tpu", "nn"),
+    os.path.join("paddle_tpu", "vision"),
+    os.path.join("paddle_tpu", "text"),
+    os.path.join("paddle_tpu", "parallel"),
+)
+
+# call-chain leaves that mark a direct jax remat/checkpoint invocation
+_RAW_REMAT_CHAINS = frozenset({
+    "jax.remat", "jax.checkpoint", "jax.ad_checkpoint.checkpoint",
+    "jax.ad_checkpoint.remat", "ad_checkpoint.checkpoint",
+})
 
 # call names that mark a statement as spawning/relaunching a process
 _SPAWN_CALL_HINTS = frozenset({
@@ -478,6 +504,62 @@ class _BarrierChecker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _RawRematChecker(ast.NodeVisitor):
+    """Flags direct ``jax.remat`` / ``jax.checkpoint`` calls in model
+    and layer code — the policy surface (``paddle_tpu.recompute``) is
+    where backend-specific save/offload decisions live, and a model
+    that hardcodes one pins every backend to it. Both call styles are
+    caught: dotted chains (``jax.checkpoint(...)``) and bare names
+    bound by ``from jax[.ad_checkpoint] import remat/checkpoint
+    [as alias]``."""
+
+    def __init__(self, path, findings):
+        self.path = path
+        self.findings = findings
+        self._bare = {}  # local alias -> canonical dotted chain
+
+    def visit_ImportFrom(self, node):
+        if node.module in ("jax", "jax.ad_checkpoint"):
+            for alias in node.names:
+                if alias.name in ("remat", "checkpoint"):
+                    self._bare[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+        self.generic_visit(node)
+
+    def _flag(self, chain, lineno, how):
+        self.findings.append(Finding(
+            "raw-remat-outside-policy", WARNING,
+            f"direct {chain} {how} in model/layer code — the "
+            "save/offload policy is a backend decision; route the "
+            "segment through paddle_tpu.recompute "
+            "(recompute(fn, policy=...) or "
+            "Layer.enable_recompute(policy)) so policies stay "
+            "swappable", loc=f"{self.path}:{lineno}"))
+
+    def _canonical(self, node):
+        chain = _attr_chain(node) or ""
+        chain = self._bare.get(chain, chain)
+        return chain if chain in _RAW_REMAT_CHAINS else None
+
+    def visit_Call(self, node):
+        chain = self._canonical(node.func)
+        if chain:
+            self._flag(chain, node.lineno, "call")
+        self.generic_visit(node)
+
+    def _visit_fn(self, node):
+        # the idiomatic bare-decorator form (@jax.checkpoint with no
+        # parens) is an Attribute in decorator_list, never a Call
+        for dec in node.decorator_list:
+            if isinstance(dec, (ast.Attribute, ast.Name)):
+                chain = self._canonical(dec)
+                if chain:
+                    self._flag(chain, dec.lineno, "decorator")
+        self.generic_visit(node)
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _visit_fn
+
+
 class _SpanLeakChecker(ast.NodeVisitor):
     """Flags ``trace_span(...)`` results that never enter a ``with``.
 
@@ -583,6 +665,7 @@ def lint_source(paths=None, repo_root=None):
     findings = []
     targets = []
     barrier_only = set()
+    remat_only = set()
     if paths:
         targets.extend(paths)
     else:
@@ -599,6 +682,12 @@ def lint_source(paths=None, repo_root=None):
         barrier_only = {os.path.abspath(p) for p in barrier_files
                         if os.path.abspath(p) not in full_rule_files}
         targets.extend(barrier_files)
+        # likewise for the model/layer sources: the default sweep runs
+        # ONLY raw-remat-outside-policy on files reached via REMAT_PATHS
+        remat_files = _expand_py(REMAT_PATHS, repo_root)
+        remat_only = {os.path.abspath(p) for p in remat_files
+                      if os.path.abspath(p) not in full_rule_files}
+        targets.extend(remat_files)
     seen = set()
     for path in targets:
         path = os.path.abspath(path)
@@ -613,10 +702,18 @@ def lint_source(paths=None, repo_root=None):
             findings.append(Finding(
                 "syntax-error", ERROR, str(e), loc=f"{rel}:{e.lineno}"))
             continue
+        is_policy_surface = rel == os.path.join("paddle_tpu",
+                                                "recompute.py")
+        if path in remat_only:
+            if not is_policy_surface:
+                _RawRematChecker(rel, findings).visit(tree)
+            continue
         _BarrierChecker(rel, findings).visit(tree)
         _RespawnChecker(rel, findings).visit(tree)
         if path in barrier_only:
             continue
+        if not is_policy_surface:  # the one legitimate jax.checkpoint
+            _RawRematChecker(rel, findings).visit(tree)  # caller
         _TracedFnChecker(rel, findings).visit(tree)
         _RetryLoopChecker(rel, findings).visit(tree)
         if os.path.basename(rel) != "tracing.py":  # the factory itself

@@ -21,6 +21,29 @@ def in_slots(op):
            [v.idx for v in op.kwarg_slots.values() if isinstance(v, _Slot)]
 
 
+def _op_sig(op):
+    """Structural signature of an op record: name + which slots it reads
+    (positional and keyword) + which it writes. Constants compare by
+    repr — close enough to tell 'the same op re-recorded' from 'a
+    different op aimed at the same slot'."""
+    def _atom(a):
+        return ("s", a.idx) if isinstance(a, _Slot) else ("c", repr(a))
+    return (op.name,
+            tuple(_atom(a) for a in op.arg_slots),
+            tuple(sorted((k, _atom(v))
+                         for k, v in op.kwarg_slots.items())),
+            tuple(op.out_slots))
+
+
+def _same_op_shape(op, first):
+    """True when ``op`` is a re-recording of ``first``: identical name,
+    input slots, and output slots — the only duplicate-write shape the
+    remat_replay stamp may excuse (a stamped op computing from DIFFERENT
+    inputs into an already-written slot is still the ambiguous-overwrite
+    class duplicate-slot-write exists to catch)."""
+    return _op_sig(op) == _op_sig(first)
+
+
 def check_graph(prog, targets=None):
     """Structural verification of a Program. ``targets`` (optional fetch
     tensors/slots) additionally enables dead-op detection — without a fetch
@@ -64,11 +87,27 @@ def check_graph(prog, targets=None):
                     f"op writes slot {s} outside the program's slot space",
                     op_index=i, op_name=op.name, slot=s))
             elif s in produced_at:
-                findings.append(Finding(
-                    "duplicate-slot-write", ERROR,
-                    f"slot {s} already written by op[{produced_at[s]}]; "
-                    "replay is order-dependent and XLA buffer reuse is "
-                    "ambiguous", op_index=i, op_name=op.name, slot=s))
+                first = prog.ops[produced_at[s]]
+                if getattr(op.fn, "_remat_replay", False) \
+                        and _same_op_shape(op, first):
+                    # a recompute rewrite re-records a segment's forward
+                    # ops in the backward region, re-writing the slots
+                    # the originals produced (reference: the recompute
+                    # optimizer's backward-block replay; here the
+                    # paddle_tpu.recompute.remat_replay stamp) — the
+                    # value is recomputed, not ambiguously overwritten,
+                    # so a matching-op replay is NOT a duplicate write
+                    pass
+                else:
+                    findings.append(Finding(
+                        "duplicate-slot-write", ERROR,
+                        f"slot {s} already written by "
+                        f"op[{produced_at[s]}]; replay is "
+                        "order-dependent and XLA buffer reuse is "
+                        "ambiguous (a rematerialization replay must "
+                        "carry the recompute.remat_replay stamp and "
+                        "re-record the SAME op)", op_index=i,
+                        op_name=op.name, slot=s))
             else:
                 produced_at[s] = i
             if s in inputs:

@@ -1,18 +1,28 @@
 """Activation recompute (reference: `fleet/utils/recompute.py:63`
-RecomputeFunction — PyLayer that drops intermediate activations and replays
-the forward in backward, restoring RNG state for dropout determinism).
+RecomputeFunction — drop intermediate activations, replay the forward in
+backward with the RNG state restored for dropout determinism).
 
-Eager mode: true memory saving (no tape inside the segment). Under
-@to_static the replay traces the segment twice, giving XLA a rematerialization
-region (jax.checkpoint-equivalent structure).
+Rebased (ISSUE 13) onto the ``paddle_tpu.recompute`` policy surface: the
+segment dispatches as ONE ``jax.checkpoint`` tape op, so eager mode
+holds only policy-saved residuals, ``@to_static`` stages a true XLA
+rematerialization region, and dropout replays bitwise (the RNG key
+mathematics threads through the remat region — the RecomputeFunction
+RNG-state-replay contract is structural now, not a save/restore dance).
+``preserve_rng_state`` is kept for API compatibility; replay is always
+RNG-exact. The legacy PyLayer implementation remains available as
+``RecomputeFunction`` for code addressing it directly.
 """
 from ....autograd.py_layer import PyLayer
 from ....core import random as core_random
-from ....core.autograd import enable_grad, grad as autograd_grad, no_grad
+from ....core.autograd import enable_grad, no_grad
 from ....core.tensor import Tensor
 
 
 class RecomputeFunction(PyLayer):
+    """Legacy eager replay path (pre-policy-surface); prefer
+    :func:`recompute`, which rematerializes through ``jax.checkpoint``
+    policies and composes with to_static/ZeRO."""
+
     @staticmethod
     def forward(ctx, run_function, preserve_rng_state, *args):
         ctx.run_function = run_function
@@ -65,10 +75,17 @@ class RecomputeFunction(PyLayer):
         return tuple(result)
 
 
-def recompute(function, *args, preserve_rng_state=True, **kwargs):
-    """reference API: paddle.distributed.fleet.utils.recompute"""
-    if kwargs:
-        function_ = lambda *a: function(*a, **kwargs)  # noqa: E731
-    else:
-        function_ = function
-    return RecomputeFunction.apply(function_, preserve_rng_state, *args)
+def recompute(function, *args, preserve_rng_state=True, policy="full",
+              **kwargs):
+    """reference API: paddle.distributed.fleet.utils.recompute —
+    delegates to ``paddle_tpu.recompute`` (``policy`` picks full /
+    selective / offload; RNG replay is always exact). This call shape
+    is ALWAYS immediate, zero-arg closures included (the policy
+    surface's no-arg call returns a wrapper instead — fleet callers
+    passing ``partial(block, x)`` must keep getting Tensors back).
+    Like ``preserve_rng_state`` always was, ``policy`` is consumed HERE,
+    not forwarded — a segment function with its own ``policy`` keyword
+    must be wrapped in ``functools.partial`` first."""
+    del preserve_rng_state  # replay is structurally RNG-exact now
+    from ....recompute import _segment_call
+    return _segment_call(function, args, kwargs, policy)

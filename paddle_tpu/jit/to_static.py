@@ -480,9 +480,26 @@ class StaticFunction:
                 raise aux["memory_error"]
             return aux["memory"]
 
+        def traced_stats():
+            # jaxpr-level liveness meter (observability.jaxpr_mem): the
+            # backend-independent structural view that stays honest about
+            # rematerialization where the CPU executable meter cannot
+            # (XLA CPU strips optimization barriers and CSEs remat away)
+            ex = aux.get("example_args")
+            if ex is None:
+                raise RuntimeError(
+                    "program has not executed yet; run the step once "
+                    "before asking for its traced memory stats")
+            if "traced" not in aux:
+                from ..observability import jaxpr_mem
+                aux["traced"] = jaxpr_mem.traced_peak_stats(
+                    get_jitted(), *ex)
+            return aux["traced"]
+
         aux["capture"] = capture
         aux["hlo_text"] = hlo_text
         aux["memory_stats"] = memory_stats
+        aux["traced_stats"] = traced_stats
         return aux
 
     def hlo_text(self):
@@ -533,6 +550,23 @@ class StaticFunction:
         attributable (the abstract arg twins are captured on first
         call); unexecuted entries are skipped."""
         out = {label: aux["memory_stats"]()
+               for label, aux in self._memory_entries()}
+        if not out:
+            raise RuntimeError(
+                "no executed compiled entry yet; call the step once "
+                "before asking for its memory attribution")
+        return out
+
+    def traced_memory_stats(self):
+        """Jaxpr-liveness memory attribution per compiled entry
+        (``observability.jaxpr_mem``): the sequential high-water bytes
+        of the TRACED step program, keyed like :meth:`memory_stats`.
+        Backend-independent and remat-aware — an activation-recompute
+        policy shrinks this number even on the CPU smoke host, where
+        the compiled-executable meter is blind to rematerialization
+        (barriers stripped + CSE). The TPU re-pin captures the
+        executable view."""
+        out = {label: aux["traced_stats"]()
                for label, aux in self._memory_entries()}
         if not out:
             raise RuntimeError(

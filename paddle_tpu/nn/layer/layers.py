@@ -216,6 +216,25 @@ class Layer:
     def named_children(self):
         return [(n, l) for n, l in self._sub_layers.items() if l is not None]
 
+    # ----------------------------------------------------- recompute seam
+    def enable_recompute(self, policy="full"):
+        """Run this layer's forward as an activation-recompute segment
+        (``paddle_tpu.recompute``): activations inside are dropped per
+        ``policy`` (``full`` / ``selective`` / ``offload``) and
+        rematerialized in backward — dropout replays bitwise via the
+        threaded RNG state. Applies in train mode while gradients are
+        enabled; eval/no-grad calls run the plain forward. Returns
+        ``self`` for chaining."""
+        from ...recompute import resolve_policy
+        if not callable(policy):
+            resolve_policy(policy)  # validate the name loudly, up front
+        object.__setattr__(self, "_recompute_policy", policy)
+        return self
+
+    def disable_recompute(self):
+        object.__setattr__(self, "_recompute_policy", None)
+        return self
+
     # ---------------------------------------------------------------- mode
     def train(self):
         self.training = True
@@ -298,7 +317,20 @@ class Layer:
             result = hook(self, inputs)
             if result is not None:
                 inputs = result if isinstance(result, tuple) else (result,)
-        outputs = self.forward(*inputs, **kwargs)
+        rc_policy = self.__dict__.get("_recompute_policy")
+        if rc_policy is not None and self.training:
+            from ...core.autograd import grad_enabled
+            if grad_enabled():
+                # always-immediate call shape: the public recompute()
+                # returns a WRAPPER for no-arg calls, and a forward
+                # taking zero inputs must still run here
+                from ...recompute import _segment_call
+                outputs = _segment_call(self.forward, inputs, kwargs,
+                                        rc_policy)
+            else:
+                outputs = self.forward(*inputs, **kwargs)
+        else:
+            outputs = self.forward(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             result = hook(self, inputs, outputs)
             if result is not None:

@@ -1,0 +1,145 @@
+"""Jaxpr-level liveness meter: backend-independent activation accounting.
+
+PR 10's per-program attribution reads the compiled executable's XLA
+``memory_analysis()`` — the right meter on TPU, where the compiler
+honors ``optimization_barrier`` and rematerialization survives into
+buffer assignment. The CPU backend, however, STRIPS optimization
+barriers and lets CSE/scheduling undo rematerialization entirely (the
+compiled CPU program of a remat'd and a plain step are byte-identical),
+so XLA byte accounting on the smoke host cannot show what activation
+recompute saves — the one claim the remat bench rows exist to gate.
+
+This module meters the STRUCTURE instead: a sequential liveness walk
+over the traced (pre-XLA) jaxpr of the step program. Every value born
+at an equation stays live until its last consumer; the high-water mark
+of live bytes is the peak a scheduler that honors program order (the
+TPU compile pipeline) has to provision. Rematerialization is visible
+here by construction — a remat segment's internal activations die at
+the segment boundary and the backward's ``remat2`` equation recomputes
+them inside its own (recursively metered) working set, so the
+forward→backward residual edges shrink exactly as the policy promises.
+
+Deterministic (pure structure, no wall clock, no backend), so the
+``*_jaxpr_peak_mb`` bench rows VALUE-gate between CPU runs the same way
+the PR-10 byte rows do. The XLA ``memory_analysis`` numbers ride along
+as metadata, and the TPU re-pin (ROADMAP) re-captures the executable
+view where it is meaningful.
+"""
+import numpy as np
+
+__all__ = ["aval_bytes", "jaxpr_peak_bytes", "jaxpr_peak_stats",
+           "traced_peak_stats"]
+
+
+def aval_bytes(aval):
+    """Bytes of one abstract value (0 for non-array avals: tokens,
+    opaque effects)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except TypeError:
+            return 0  # polymorphic dim: not meterable
+    try:
+        return n * np.dtype(dtype).itemsize
+    except TypeError:
+        return 0  # extended dtypes (PRNG keys): key_data views meter them
+
+
+def _size(var):
+    return aval_bytes(var.aval)
+
+
+def _sub_jaxprs(eqn):
+    """Every sub-jaxpr an equation owns (scan/while/cond bodies, remat
+    regions, pjit calls, custom-vjp closures) — recursion descends into
+    each so an equation's footprint includes its internal working set."""
+    out = []
+    for v in eqn.params.values():
+        # ClosedJaxpr (pjit, remat2, custom_jvp/vjp call_jaxpr, scan)
+        if hasattr(v, "jaxpr") and hasattr(v, "consts"):
+            out.append(v.jaxpr)
+        elif hasattr(v, "eqns") and hasattr(v, "invars"):
+            out.append(v)  # open Jaxpr (cond branches list items below)
+        elif isinstance(v, (list, tuple)):
+            for w in v:
+                if hasattr(w, "jaxpr") and hasattr(w, "consts"):
+                    out.append(w.jaxpr)
+                elif hasattr(w, "eqns") and hasattr(w, "invars"):
+                    out.append(w)
+    return out
+
+
+def jaxpr_peak_bytes(jaxpr):
+    """Sequential-liveness high-water bytes of one jaxpr: inputs are
+    resident throughout their live range, each equation adds its outputs
+    plus its internal (recursive) working set, and a value frees after
+    its last consumer. Program order is the jaxpr's — the order the
+    trace executed and the order a barrier-honoring scheduler keeps."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)  # accept ClosedJaxpr
+
+    def _vars(atoms):
+        seen, out = set(), []
+        for a in atoms:
+            if hasattr(a, "aval") and not hasattr(a, "val"):  # Var, not Literal
+                if id(a) not in seen:
+                    seen.add(id(a))
+                    out.append(a)
+        return out
+
+    last_use = {}
+    n_eqns = len(jaxpr.eqns)
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in _vars(eqn.invars):
+            last_use[v] = i
+    for v in _vars(jaxpr.outvars):
+        last_use[v] = n_eqns  # outputs live to the end
+
+    live = 0
+    for v in _vars(list(jaxpr.invars) + list(jaxpr.constvars)):
+        live += _size(v)
+    peak = live
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        inner = 0
+        for sub in _sub_jaxprs(eqn):
+            # the sub-jaxpr's boundary values ARE the equation's operands
+            # — already counted in the outer live set; only the working
+            # set it allocates BEYOND its inputs is additional footprint
+            sub_j = getattr(sub, "jaxpr", sub)
+            base = sum(_size(v) for v in _vars(list(sub_j.invars)
+                                               + list(sub_j.constvars)))
+            inner = max(inner, max(0, jaxpr_peak_bytes(sub_j) - base))
+        born = sum(_size(v) for v in _vars(eqn.outvars))
+        peak = max(peak, live + born + inner)
+        live += born
+        for v in _vars(list(eqn.invars) + list(eqn.outvars)):
+            if last_use.get(v, -1) <= i:
+                live -= _size(v)
+    return peak
+
+
+def jaxpr_peak_stats(closed_jaxpr):
+    """``{"peak_bytes", "argument_bytes", "output_bytes", "eqns"}`` for a
+    traced program: the liveness high-water plus the boundary sizes that
+    contextualize it."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    return {
+        "peak_bytes": jaxpr_peak_bytes(jaxpr),
+        "argument_bytes": sum(_size(v) for v in jaxpr.invars),
+        "output_bytes": sum(_size(v) for v in jaxpr.outvars),
+        "eqns": len(jaxpr.eqns),
+    }
+
+
+def traced_peak_stats(fn, *abstract_args):
+    """Trace ``fn`` on ShapeDtypeStruct twins and meter the jaxpr —
+    the entry point ``StaticFunction.traced_memory_stats()`` uses with
+    each compiled entry's captured example args."""
+    import jax
+    closed = jax.make_jaxpr(fn)(*abstract_args)
+    return jaxpr_peak_stats(closed)

@@ -46,9 +46,17 @@ __all__ = ["program_stats", "peak_bytes", "top_buffers",
 # the CompiledMemoryStats fields exported as program_hbm_bytes{kind=}
 MEMORY_KINDS = ("argument", "output", "temp", "alias", "generated_code")
 
+# host-memory CompiledMemoryStats fields (jaxlib exposes host_* twins on
+# backends with host memory spaces): summed into ONE "host_offload" kind
+# — the bytes the offload recompute policy parked OFF the device. Absent
+# fields read as 0 (older jaxlib / backends without host spaces).
+HOST_MEMORY_KINDS = ("host_argument", "host_output", "host_temp",
+                     "host_alias", "host_generated_code")
+
 STATE_CATEGORIES = ("param", "buffer", "opt_moment", "master",
                     "zero_param", "zero_moment", "zero_master", "gacc",
-                    "rng", "lr", "hbm_cache", "grad", "other")
+                    "rng", "lr", "hbm_cache", "grad", "host_offload",
+                    "other")
 
 
 class MemoryAttributionError(RuntimeError):
@@ -82,6 +90,14 @@ def program_stats(compiled):
                 f"memory analysis lacks {kind}_size_in_bytes "
                 f"(got {type(ma).__name__})")
         out[f"{kind}_bytes"] = int(val)
+    # residuals the offload recompute policy parked in host memory: they
+    # are NOT device HBM (peak_bytes excludes them by construction — the
+    # host_* fields are separate) but the ledger must show where the
+    # bytes went, so they surface as one aggregated kind
+    host = 0
+    for kind in HOST_MEMORY_KINDS:
+        host += int(getattr(ma, f"{kind}_size_in_bytes", 0) or 0)
+    out["host_offload_bytes"] = host
     out["peak_bytes"] = peak_bytes(out)
     return out
 
@@ -168,13 +184,17 @@ def clear_program_memory():
 
 def export_program_memory(entry, stats):
     """Export one program's byte kinds as
-    ``program_hbm_bytes{entry=,kind=}`` gauges (peak included)."""
+    ``program_hbm_bytes{entry=,kind=}`` gauges (peak and — when the
+    record carries it — the host_offload aggregate included)."""
     from . import export
-    for kind in MEMORY_KINDS + ("peak",):
+    for kind in MEMORY_KINDS + ("peak", "host_offload"):
+        val = stats.get(f"{kind}_bytes")
+        if val is None:
+            continue  # records from older captures lack host_offload
         export.set_gauge(
             "program_hbm_bytes" + export.format_labels(
                 "program_hbm_bytes", entry=entry, kind=kind),
-            stats[f"{kind}_bytes"])
+            val)
 
 
 # -- framework-state residency ledger -------------------------------------
@@ -191,11 +211,34 @@ _NAME_CATEGORIES = (
 )
 
 
+def is_host_parked(arr):
+    """True when a jax.Array lives in a HOST memory space of a device
+    whose default memory is elsewhere (the pjit ``pinned_host`` memory
+    kind the offload recompute policy uses). On CPU the default memory
+    IS a host space, so nothing classifies as parked — the category
+    only lights up where offload actually moved bytes off the device."""
+    import jax
+    if not isinstance(arr, jax.Array):
+        return False
+    try:
+        mk = arr.sharding.memory_kind
+        if mk is None or "host" not in str(mk):
+            return False
+        dev = next(iter(arr.sharding.device_set))
+        return str(mk) != str(dev.default_memory().kind)
+    except Exception:
+        return False
+
+
 def classify_tensor(t):
-    """Ledger category of a registered stateful tensor: an explicit
+    """Ledger category of a registered stateful tensor: host-parked
+    values (offload policy) classify ``host_offload`` first — residency
+    proof must show where the bytes went — then an explicit
     ``_ledger_category`` tag (set by the optimizer / RNG / lr / cache
-    constructors) wins, then the structural-name patterns, then the
+    constructors), then the structural-name patterns, then the
     Parameter/buffer fallback."""
+    if is_host_parked(getattr(t, "_value", None)):
+        return "host_offload"
     cat = getattr(t, "_ledger_category", None)
     if cat is not None:
         return cat

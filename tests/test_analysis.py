@@ -441,7 +441,7 @@ class TestLadderAndCLI:
         fs, summary = analysis.ladder.verify_ladder()
         assert fs == []
         assert set(summary) == {"resnet", "gpt", "bert", "detection",
-                                "hbm_cache", "ctr", "serving",
+                                "hbm_cache", "ctr", "remat", "serving",
                                 "allreduce", "zero1", "zero3"}
 
     def test_cli_source_mode(self):

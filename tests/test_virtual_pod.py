@@ -1362,10 +1362,15 @@ def test_pod_kill_heal_grow_back_to_full_world(tmp_path_factory):
     assert lobby_joins and lobby_joins[0]["gen"] == 2
 
 
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_pod_three_kill_heal_cycles_monotone_generations(
         tmp_path_factory):
-    """Chaos acceptance: THREE consecutive kill/heal cycles on one pod —
+    """Chaos acceptance (slow tier — ISSUE 13's tier-1 budget squeeze:
+    ~24 s, the heavier of the two heal-and-grow e2e cases; the single
+    kill->shrink->heal->grow lifecycle keeps tier-1 coverage in
+    test_pod_kill_heal_grow_back_to_full_world): THREE consecutive
+    kill/heal cycles on one pod —
     the original rank 1 killed mid-step, its first replacement killed
     DURING ITS OWN ELASTIC RESTORE (checkpoint/pod_restore), the second
     replacement killed mid-step again, the third replacement finishing

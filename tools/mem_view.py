@@ -20,6 +20,14 @@ Sources (pick one):
     # gate: exit 3 when any program peak exceeds the budget
     python tools/mem_view.py --ladder --budget-mb 16000
 
+    # A/B evidence view: per-entry / per-category deltas between two
+    # captures (flight dumps, memory.snapshot() files, or --out files)
+    # — the one-command remat-on-vs-off comparison
+    python tools/mem_view.py --diff before.json after.json
+
+    # record a capture for a later --diff
+    python tools/mem_view.py --ladder --out capture.json
+
 Exit codes: 0 ok, 1 usage/attribution error, 3 budget exceeded.
 """
 import argparse
@@ -30,7 +38,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-KINDS = ("argument", "output", "temp", "alias", "generated_code", "peak")
+KINDS = ("argument", "output", "temp", "alias", "generated_code",
+         "host_offload", "peak")
 
 
 def _mb(nbytes):
@@ -59,8 +68,58 @@ def format_program_table(programs):
             rows.append([entry, "ERR: " + str(stats["error"])[:60]]
                         + [""] * (len(KINDS) - 1))
             continue
-        rows.append([entry] + [f"{_mb(stats[f'{k}_bytes']):.3f}"
+        rows.append([entry] + [f"{_mb(stats.get(f'{k}_bytes', 0)):.3f}"
                                for k in KINDS])
+    return _render(rows)
+
+
+def _fmt_delta(nbytes):
+    return f"{_mb(nbytes):+.3f}"
+
+
+def format_program_diff(progs_a, progs_b):
+    """Per-entry, per-kind deltas (B minus A) over the union of entries;
+    an entry present on one side only renders its bytes one-sided with
+    the delta against zero."""
+    rows = [["entry"] + [f"{k}_mb(A)" for k in ("peak",)]
+            + [f"{k}_mb(B)" for k in ("peak",)]
+            + [f"d_{k}_mb" for k in KINDS]]
+    for entry in sorted(set(progs_a) | set(progs_b)):
+        a = progs_a.get(entry, {})
+        b = progs_b.get(entry, {})
+        if "error" in a or "error" in b:
+            rows.append([entry, "ERR", "ERR"] + [""] * len(KINDS))
+            continue
+        rows.append(
+            [entry,
+             f"{_mb(a.get('peak_bytes', 0)):.3f}",
+             f"{_mb(b.get('peak_bytes', 0)):.3f}"]
+            + [_fmt_delta(b.get(f"{k}_bytes", 0) - a.get(f"{k}_bytes", 0))
+               for k in KINDS])
+    return _render(rows)
+
+
+def format_state_diff(state_a, state_b):
+    """Per-category resident/global deltas (B minus A) plus totals."""
+    cats_a = state_a.get("categories", {})
+    cats_b = state_b.get("categories", {})
+    rows = [["category", "resident_mb(A)", "resident_mb(B)",
+             "d_resident_mb", "d_global_mb"]]
+    names = sorted(set(cats_a) | set(cats_b),
+                   key=lambda c: -(cats_b.get(c, cats_a.get(c))["bytes"]))
+    for cat in names:
+        a = cats_a.get(cat, {"bytes": 0, "global_bytes": 0})
+        b = cats_b.get(cat, {"bytes": 0, "global_bytes": 0})
+        rows.append([cat, f"{_mb(a['bytes']):.3f}", f"{_mb(b['bytes']):.3f}",
+                     _fmt_delta(b["bytes"] - a["bytes"]),
+                     _fmt_delta(b["global_bytes"] - a["global_bytes"])])
+    rows.append(["TOTAL",
+                 f"{_mb(state_a.get('total_bytes', 0)):.3f}",
+                 f"{_mb(state_b.get('total_bytes', 0)):.3f}",
+                 _fmt_delta(state_b.get("total_bytes", 0)
+                            - state_a.get("total_bytes", 0)),
+                 _fmt_delta(state_b.get("total_global_bytes", 0)
+                            - state_a.get("total_global_bytes", 0))])
     return _render(rows)
 
 
@@ -125,13 +184,57 @@ def main(argv=None):
     ap.add_argument("--snapshot", metavar="JSON",
                     help="render a recorded memory snapshot / flight "
                     "dump instead of attributing the ladder")
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                    help="side-by-side per-entry/per-category deltas "
+                    "(B minus A) between two captures — the remat "
+                    "on/off A/B evidence view")
+    ap.add_argument("--out", metavar="JSON",
+                    help="also write the rendered sections as a "
+                    "canonical capture (feed a later --diff)")
     ap.add_argument("--budget-mb", type=float, default=None,
                     help="fail (exit 3) when any program peak exceeds "
                     "this many MB")
     args = ap.parse_args(argv)
 
-    if bool(args.ladder) == bool(args.snapshot):
-        ap.error("pick exactly one source: --ladder or --snapshot FILE")
+    sources = [bool(args.ladder), bool(args.snapshot), bool(args.diff)]
+    if sum(sources) != 1:
+        ap.error("pick exactly one source: --ladder, --snapshot FILE, "
+                 "or --diff A.json B.json")
+
+    if args.diff:
+        if args.out:
+            ap.error("--out records a single capture; it does not "
+                     "combine with --diff")
+        progs_a, state_a = _snapshot_sections(args.diff[0])
+        progs_b, state_b = _snapshot_sections(args.diff[1])
+        print(f"program deltas (B={args.diff[1]} minus A={args.diff[0]}):")
+        if progs_a or progs_b:
+            print(format_program_diff(progs_a, progs_b))
+        else:
+            print("no program attributions on either side")
+        if state_a or state_b:
+            print()
+            print("state residency deltas:")
+            print(format_state_diff(state_a or {}, state_b or {}))
+        rc = 1 if any("error" in s for s in
+                      list(progs_a.values()) + list(progs_b.values())) \
+            else 0
+        if args.budget_mb is not None:
+            # the gate judges the AFTER side — a diff invocation with a
+            # budget must never pass silently without evaluating it
+            ok, over = check_budget(progs_b, args.budget_mb)
+            if ok:
+                print(f"\nBUDGET(B): PASS (every program peak <= "
+                      f"{args.budget_mb:g} MB)")
+            else:
+                for entry, peak in over:
+                    print(f"\nBUDGET(B): {entry} "
+                          + ("attribution failed" if peak is None
+                             else f"peak {peak:.3f} MB > "
+                                  f"{args.budget_mb:g} MB"))
+                print("BUDGET(B): FAIL")
+                rc = 3
+        return rc
 
     state = None
     if args.snapshot:
@@ -139,6 +242,11 @@ def main(argv=None):
     else:
         configs = args.configs.split(",") if args.configs else None
         programs = _ladder_programs(configs)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"programs": programs, "state": state or {}}, f,
+                      indent=1)
 
     if programs:
         print(format_program_table(programs))

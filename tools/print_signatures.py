@@ -41,6 +41,7 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.observability",
     "paddle_tpu.observability.memory",
+    "paddle_tpu.recompute",
     "paddle_tpu.serving",
     "paddle_tpu.checkpoint",
     "paddle_tpu.checkpoint.multihost",
