@@ -641,6 +641,64 @@ def bench_tracing_overhead():
             "disabled = shared null span (guard-only)"}
 
 
+def bench_lockwatch_overhead():
+    """Lock-order watchdog tax: uncontended acquire/release throughput
+    of a plain threading.Lock vs the lockwatch factory DISARMED (must
+    be the same object kind — the row asserts <2x) vs ARMED (the
+    instrumented wrapper: held-set + edge-graph bookkeeping). The row
+    that keeps the watchdog honest about 'near-zero cost when off' —
+    and shows what the chaos tier pays for running deadlock-checked."""
+    import threading
+
+    import jax
+    from paddle_tpu import _lockwatch as lockwatch
+
+    N = 200_000
+
+    def spin(lk):
+        t0 = time.perf_counter()
+        for _ in range(N):
+            lk.acquire()
+            lk.release()
+        return time.perf_counter() - t0
+
+    plain = threading.Lock()
+    spin(plain)  # warm
+    plain_s = min(spin(plain) for _ in range(3))
+
+    was = lockwatch.disable()
+    try:
+        disarmed = lockwatch.Lock("bench.disarmed")
+        spin(disarmed)
+        disarmed_s = min(spin(disarmed) for _ in range(3))
+        lockwatch.enable()
+        armed = lockwatch.Lock("bench.armed")
+        spin(armed)
+        armed_s = min(spin(armed) for _ in range(3))
+    finally:
+        (lockwatch.enable if was else lockwatch.disable)()
+        lockwatch.reset()
+
+    disarmed_x = disarmed_s / plain_s
+    if disarmed_x >= 2.0:
+        raise RuntimeError(
+            f"disarmed lockwatch lock costs {disarmed_x:.2f}x a plain "
+            "threading.Lock (acceptance: <2x) — the opt-out path "
+            "regressed")
+    return {"metric": "lockwatch_overhead_ops_per_s",
+            "value": round(N / armed_s, 1), "unit": "ops/s",
+            "backend": jax.default_backend(), "gate": "presence",
+            "plain_ns": round(plain_s / N * 1e9, 1),
+            "disarmed_ns": round(disarmed_s / N * 1e9, 1),
+            "armed_ns": round(armed_s / N * 1e9, 1),
+            "disarmed_overhead_x": round(disarmed_x, 3),
+            "armed_overhead_x": round(armed_s / plain_s, 3),
+            "note": "uncontended acquire/release; disarmed factory "
+            "returns a raw threading.Lock (the <2x acceptance is "
+            "asserted in-bench), armed pays held-set + order-graph "
+            "bookkeeping — host-dependent, presence-pinned"}
+
+
 def bench_memory(n_virtual=8):
     """HBM memory accounting rows (observability.memory): compiled-step
     XLA attribution peak + per-rank state residency of a ZeRO-3 scan
@@ -921,6 +979,7 @@ BENCHES = {"resnet": bench_resnet50, "gpt": bench_gpt_sharding_pp,
            "hbm_cache": bench_hbm_cache, "ctr": bench_ctr,
            "serving": bench_serving, "checkpoint": bench_checkpoint,
            "tracing_overhead": bench_tracing_overhead,
+           "lockwatch_overhead": bench_lockwatch_overhead,
            "memory": bench_memory, "remat": bench_remat,
            "pod_recovery": bench_pod_recovery,
            "bert": bench_bert}
@@ -958,7 +1017,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="resnet,gpt,allreduce,detection,"
                     "hbm_cache,ctr,serving,checkpoint,tracing_overhead,"
-                    "memory,remat,pod_recovery,bert")
+                    "lockwatch_overhead,memory,remat,pod_recovery,bert")
     ap.add_argument("--out", help="write the run's records as a JSON file")
     ap.add_argument("--results", help="gate a previously recorded results "
                     "JSON instead of running the ladder")

@@ -34,6 +34,8 @@ lint + the verified benchmark-ladder miniatures in `ladder`).
 import os
 
 from .. import monitor as _monitor
+from . import concurrency as _concurrency
+from . import lockwatch  # noqa: F401  (the runtime watchdog facade)
 from .collectives import (check_collective_order,  # noqa: F401
                           check_collectives, collective_sequence)
 from .donation import check_donation, check_static_function  # noqa: F401
@@ -48,6 +50,7 @@ __all__ = [
     "format_findings", "check_graph", "check_dtypes", "check_donation",
     "check_static_function", "check_collectives", "check_collective_order",
     "collective_sequence", "lint_program", "lint_source",
+    "check_concurrency", "lockwatch",
     "set_debug", "debug_enabled",
 ]
 
@@ -102,6 +105,19 @@ def lint(program):
     """TPU program lint (host callbacks in the compiled stream, unseeded
     RNG ops, ...). Advisory: findings are warnings, never raised."""
     findings = lint_program(program)
+    _export(findings)
+    return findings
+
+
+def check_concurrency(paths=None, repo_root=None):
+    """Static concurrency rules (lock-order cycles, blocking calls under
+    a lock, Condition.wait discipline, notify-without-lock) over the
+    thread-heavy runtime modules — see
+    :mod:`paddle_tpu.analysis.concurrency`. Findings export as counters
+    like every other checker; the runtime complement is
+    :mod:`paddle_tpu.analysis.lockwatch`."""
+    findings = _concurrency.check_concurrency(paths=paths,
+                                              repo_root=repo_root)
     _export(findings)
     return findings
 

@@ -1,0 +1,745 @@
+"""Static concurrency analysis over the thread-heavy runtime modules.
+
+PRs 7-13 turned the repro into a genuinely concurrent system — the pod
+coordinator's server-side Conditions, heartbeat threads, the cache
+prefetch/write-back workers, the serving batcher, and the runlog/flight
+writers all hold hand-rolled ``threading`` discipline — and the failure
+modes of that discipline (lock-order inversion, a wire RPC under a
+mutex, a missed-notify hang) never show up in a traced *program*, only
+in the host runtime. This pass lints exactly that layer: an AST walk
+per module that
+
+- builds a **lock-acquisition-order graph** from ``with lock:`` /
+  ``lock.acquire()`` nesting — including one level of call-site
+  propagation (``with a: self.helper()`` where ``helper`` takes ``b``
+  records the edge ``a -> b``) — and flags any cycle as
+  ``lock-order-cycle`` (ERROR): two call paths taking the same locks in
+  opposite orders deadlock the moment the scheduler interleaves them;
+- flags **blocking calls while a lock is held**
+  (``blocking-call-under-lock``, WARNING): RPC round-trips
+  (``_call``/``pull_sparse``/``push_*``), collectives
+  (``barrier``/``allreduce``), ``future.result``, thread/process
+  ``join``, ``sleep``, file ``flush``/``fsync``, run-log/flight writes
+  (``event``/``dump``), socket I/O, and subprocess waits — the lock
+  converts one slow peer into a stall of every thread behind it.
+  Waiting on the condition built over the held lock is exempt (that is
+  what ``Condition.wait`` is for);
+- flags a ``Condition.wait`` outside a ``while``-predicate loop
+  (``cond-wait-outside-loop``, WARNING — wakeups are spurious and
+  notifies race, the predicate must be re-checked) and a bare
+  ``Condition.wait()`` with no timeout (``cond-wait-without-timeout``,
+  WARNING — a missed notify becomes an unbounded, metric-invisible
+  hang; the barrier-without-timeout sweep's sibling rule);
+- flags ``notify``/``notify_all`` without holding the associated lock
+  (``notify-without-lock``, ERROR — raises at runtime, and the
+  ``threading.Condition(existing_lock)`` aliasing is resolved so
+  ``with self._mu: self._cv.notify_all()`` is correctly clean). By
+  repo convention a ``*_locked`` function asserts its caller holds the
+  lock; notifies inside them are trusted.
+
+Deliberate violations carry a structured suppression comment::
+
+    with self._mu:  # lint: blocking-call-under-lock <reason>
+        self._sock.sendall(msg)
+
+``# lint: <rule-or-prefix> <reason>`` on the flagged line, the line
+above it, or the line of the ``with`` that acquired the relevant lock
+demotes the finding to INFO with the reason attached — auditable in
+every sweep, never silently dropped. The same comments work for the
+``lint_source`` rule families.
+
+Default scan surface: every module under ``CONCURRENCY_PATHS``
+(``distributed/``, ``serving/``, ``observability/``, ``testing/``).
+CLI: ``python tools/lint_program.py --concurrency`` (part of the
+default sweep). The dynamic complement — the runtime watchdog that
+checks the orders the process actually takes — is
+:mod:`paddle_tpu.analysis.lockwatch`.
+
+Known blind spots (by design, kept simple): nested ``def``/``lambda``
+bodies are skipped (traced jax closures run on other schedules), device
+compute via ``__call__`` on a compiled StaticFunction is
+indistinguishable from a plain call, and lock identity is name-based
+per class (``self._locks[i]`` collapses to one node).
+"""
+import ast
+import io
+import os
+import re
+import tokenize
+
+from .findings import ERROR, INFO, WARNING, Finding
+
+__all__ = ["check_concurrency", "CONCURRENCY_PATHS", "BLOCKING_LEAVES",
+           "parse_suppressions", "apply_suppressions"]
+
+# default scan surface: the thread-heavy runtime packages
+CONCURRENCY_PATHS = (
+    os.path.join("paddle_tpu", "distributed"),
+    os.path.join("paddle_tpu", "serving"),
+    os.path.join("paddle_tpu", "observability"),
+    os.path.join("paddle_tpu", "testing"),
+)
+
+# call-chain leaves that block the calling thread: RPC round-trips, pod
+# collectives, futures, thread/process joins, sleeps, file/queue
+# flushes, run-log/flight writes, socket and subprocess I/O
+BLOCKING_LEAVES = frozenset({
+    "_call", "pull_sparse", "pull_dense", "pull_dense_init",
+    "push_sparse", "push_dense", "push_sparse_delta", "push_sparse_grad",
+    "_send_arrays", "_recv_arrays",
+    "barrier", "allreduce", "allreduce_mean", "reform",
+    "result", "sleep", "flush", "fsync", "join",
+    "event", "dump",
+    "sendall", "recv", "recv_into", "readline",
+    "connect", "create_connection", "urlopen", "getresponse",
+    "communicate", "check_output", "check_call",
+})
+
+# identifier shapes that read as a lock: _mu, _lock, _locks, _cv,
+# _cond, *_lock, mutex, ... (word-boundary so "unlock"/"block" miss)
+_LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|locks|mu|mutex|cv|cond)\d*$")
+
+_LOCK_FACTORY_LEAVES = frozenset({"Lock", "RLock", "Condition"})
+_LOCK_FACTORY_ROOTS = frozenset({"threading", "lockwatch", "_lockwatch"})
+
+
+def _is_lockish(leaf):
+    return bool(_LOCK_NAME_RE.search(leaf.lower().rstrip("[]")))
+
+
+def _attr_chain(node):
+    """'a.b.c' for Attribute/Name chains; subscripts collapse to '[]'
+    ('self._locks[i]' -> 'self._locks[]'); anything else None."""
+    parts = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+            if isinstance(node, ast.Attribute):
+                parts.append(node.attr + "[]")
+                node = node.value
+            elif isinstance(node, ast.Name):
+                parts.append(node.id + "[]")
+                return ".".join(reversed(parts))
+            else:
+                return None
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        else:
+            return None
+
+
+# -- suppression comments --------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*([A-Za-z0-9_-]+)(?:\s+(.*))?$")
+
+
+def parse_suppressions(source):
+    """``{line: (rule_token, reason)}`` for every structured
+    ``# lint: <rule-or-prefix> <reason>`` comment in ``source``."""
+    out = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    out[tok.start[0]] = (m.group(1),
+                                         (m.group(2) or "").strip())
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        pass
+    return out
+
+
+def _finding_line(f):
+    if not f.loc:
+        return None
+    try:
+        return int(f.loc.rsplit(":", 1)[1])
+    except (ValueError, IndexError):
+        return None
+
+
+def apply_suppressions(findings, suppressions):
+    """Demote findings carrying a matching suppression to INFO (message
+    gains the reason — auditable, never silently dropped). A suppression
+    matches when its token equals the finding's rule or is a prefix of
+    it, and sits on the flagged line, the line above, or any line in the
+    finding's ``ctx_lines`` (the ``with`` that acquired the lock)."""
+    if not suppressions:
+        return findings
+    out = []
+    for f in findings:
+        lines = []
+        line = _finding_line(f)
+        if line is not None:
+            lines = [line, line - 1]
+        for c in getattr(f, "ctx_lines", ()) or ():
+            lines += [c, c - 1]  # on the ctx line, or the line above it
+        hit = None
+        for ln in lines:
+            tok = suppressions.get(ln)
+            if tok and (f.rule == tok[0] or f.rule.startswith(tok[0])):
+                hit = tok
+                break
+        if hit is not None and f.severity != INFO:
+            g = Finding(f.rule, INFO,
+                        f"suppressed ({hit[1] or 'no reason given'}): "
+                        f"{f.message}", loc=f.loc)
+            out.append(g)
+        else:
+            out.append(f)
+    return out
+
+
+# -- per-module analysis ---------------------------------------------------
+
+class _FnSummary:
+    """What one function does, as seen from a call site."""
+
+    __slots__ = ("key", "acquired", "exposed_blocking", "calls",
+                 "edges", "local_findings")
+
+    def __init__(self, key):
+        self.key = key
+        self.acquired = set()         # lock ids taken anywhere inside
+        self.exposed_blocking = []    # [(leaf, line)] not under any local lock
+        self.calls = []               # [(callee_key, held_tuple, line)]
+        self.edges = []               # [(a, b, line)] direct nestings
+        self.local_findings = []      # Findings anchored in this fn
+
+
+class _ModuleChecker:
+    def __init__(self, rel, tree):
+        self.rel = rel
+        self.tree = tree
+        self.findings = []
+        self.class_locks = {}    # (cls, attr) -> True
+        self.module_locks = set()
+        self.aliases = {}        # (cls_or_None, attr) -> canonical attr
+        self.fns = {}            # (cls_or_None, name) -> _FnSummary
+
+    # -- pass 0: lock definitions + condition aliases ----------------------
+    def _collect_defs(self):
+        for cls, fn in self._iter_functions():
+            cls_name = cls.name if cls is not None else None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign) or \
+                        not isinstance(node.value, ast.Call):
+                    continue
+                chain = _attr_chain(node.value.func) or ""
+                parts = chain.split(".")
+                if parts[-1] not in _LOCK_FACTORY_LEAVES:
+                    continue
+                if len(parts) > 1 and parts[0] not in _LOCK_FACTORY_ROOTS:
+                    continue
+                for tgt in node.targets:
+                    tchain = _attr_chain(tgt)
+                    if tchain is None:
+                        continue
+                    if tchain.startswith("self.") and cls_name:
+                        attr = tchain[5:]
+                        self.class_locks[(cls_name, attr)] = True
+                        scope = cls_name
+                    elif "." not in tchain:
+                        self.module_locks.add(tchain)
+                        attr, scope = tchain, None
+                    else:
+                        continue
+                    # Condition(existing_lock): the condition IS that
+                    # lock for holding purposes
+                    if parts[-1] == "Condition" and node.value.args:
+                        src = _attr_chain(node.value.args[0])
+                        if src and src.startswith("self."):
+                            self.aliases[(scope, attr)] = src[5:]
+                        elif src and "." not in src:
+                            self.aliases[(scope, attr)] = src
+        # module-level assignments
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                chain = _attr_chain(node.value.func) or ""
+                parts = chain.split(".")
+                if parts[-1] in _LOCK_FACTORY_LEAVES and \
+                        (len(parts) == 1
+                         or parts[0] in _LOCK_FACTORY_ROOTS):
+                    for tgt in node.targets:
+                        tchain = _attr_chain(tgt)
+                        if tchain and "." not in tchain:
+                            self.module_locks.add(tchain)
+
+    def _iter_functions(self):
+        """(class_or_None, FunctionDef) for every top-level function and
+        method (nested defs are skipped — see module docstring)."""
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield None, node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        yield node, sub
+
+    # -- lock-id resolution -------------------------------------------------
+    def _canon(self, scope, attr):
+        seen = set()
+        while (scope, attr) in self.aliases and attr not in seen:
+            seen.add(attr)
+            attr = self.aliases[(scope, attr)]
+        return attr
+
+    def _resolve_lock(self, node, cls_name):
+        """Lock id for an expression, or None when it doesn't read as a
+        lock. Ids: 'Class.attr' (canonicalized through Condition
+        aliases) or bare module/local names."""
+        chain = _attr_chain(node)
+        if chain is None:
+            return None
+        return self._resolve_lock_chain(chain, cls_name)
+
+    # -- pass 1: per-function walk ------------------------------------------
+    def _analyze_functions(self):
+        for cls, fn in self._iter_functions():
+            cls_name = cls.name if cls is not None else None
+            key = (cls_name, fn.name)
+            summ = _FnSummary(key)
+            self.fns[key] = summ
+            self._walk_body(fn.body, [], summ, cls_name, fn,
+                            in_while=False)
+
+    def _walk_body(self, stmts, held, summ, cls_name, fn, in_while):
+        """held: list of (lock_id, ctx_line) in acquisition order; a
+        copy per body so a with-block's locks scope naturally. Raw
+        acquire()/release() statements extend/shrink the CURRENT body's
+        view."""
+        held = list(held)
+        for stmt in stmts:
+            self._walk_stmt(stmt, held, summ, cls_name, fn, in_while)
+
+    def _note_acquire(self, lock_id, line, held, summ):
+        for h, _ln in held:
+            if h != lock_id:
+                summ.edges.append((h, lock_id, line))
+
+    def _walk_stmt(self, node, held, summ, cls_name, fn, in_while):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested defs run on their own schedule: skip
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new = []
+            for item in node.items:
+                self._scan_expr(item.context_expr, held, summ, cls_name,
+                                fn, in_while, skip_lock_ctx=True)
+                lid = self._resolve_lock(item.context_expr, cls_name)
+                if lid is not None:
+                    summ.acquired.add(lid)
+                    self._note_acquire(lid, node.lineno, held + new, summ)
+                    new.append((lid, node.lineno))
+            self._walk_body(node.body, held + new, summ, cls_name, fn,
+                            in_while)
+            return
+        if isinstance(node, ast.While):
+            self._scan_expr(node.test, held, summ, cls_name, fn, in_while)
+            self._walk_body(node.body, held, summ, cls_name, fn,
+                            in_while=True)
+            self._walk_body(node.orelse, held, summ, cls_name, fn,
+                            in_while)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._scan_expr(node.iter, held, summ, cls_name, fn, in_while)
+            self._walk_body(node.body, held, summ, cls_name, fn, in_while)
+            self._walk_body(node.orelse, held, summ, cls_name, fn,
+                            in_while)
+            return
+        if isinstance(node, ast.If):
+            self._scan_expr(node.test, held, summ, cls_name, fn, in_while)
+            self._walk_body(node.body, held, summ, cls_name, fn, in_while)
+            self._walk_body(node.orelse, held, summ, cls_name, fn,
+                            in_while)
+            return
+        if isinstance(node, ast.Try):
+            self._walk_body(node.body, held, summ, cls_name, fn, in_while)
+            for h in node.handlers:
+                self._walk_body(h.body, held, summ, cls_name, fn,
+                                in_while)
+            self._walk_body(node.orelse, held, summ, cls_name, fn,
+                            in_while)
+            self._walk_body(node.finalbody, held, summ, cls_name, fn,
+                            in_while)
+            return
+        # raw acquire()/release() as a bare statement extends the held
+        # view for the REST of this body
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            chain = _attr_chain(call.func)
+            if chain and "." in chain:
+                recv, leaf = chain.rsplit(".", 1)
+                lid = self._resolve_lock_chain(recv, cls_name)
+                if lid is not None and leaf == "acquire":
+                    summ.acquired.add(lid)
+                    self._note_acquire(lid, node.lineno, held, summ)
+                    held.append((lid, node.lineno))
+                    return
+                if lid is not None and leaf == "release":
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][0] == lid:
+                            del held[i]
+                            break
+                    return
+        # generic statement: scan every expression for calls
+        self._scan_expr(node, held, summ, cls_name, fn, in_while)
+
+    def _resolve_lock_chain(self, chain, cls_name):
+        """_resolve_lock over an already-extracted chain string."""
+        if chain.startswith("self."):
+            attr = chain[5:]
+            if "." in attr:
+                return None
+            if (cls_name, attr.rstrip("[]")) in self.class_locks \
+                    or _is_lockish(attr):
+                return f"{cls_name}.{self._canon(cls_name, attr)}"
+            return None
+        if "." in chain:
+            return None
+        if chain in self.module_locks or _is_lockish(chain):
+            return self._canon(None, chain)
+        return None
+
+    def _scan_expr(self, node, held, summ, cls_name, fn, in_while,
+                   skip_lock_ctx=False):
+        """Visit every Call in an expression/statement subtree (without
+        entering nested function bodies)."""
+        for sub in self._walk_no_defs(node):
+            if isinstance(sub, ast.Call):
+                self._handle_call(sub, held, summ, cls_name, fn, in_while,
+                                  skip_lock_ctx=skip_lock_ctx)
+
+    @staticmethod
+    def _walk_no_defs(node):
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            yield n
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                stack.append(child)
+
+    @staticmethod
+    def _held_ids(held):
+        return [h for h, _ln in held]
+
+    @staticmethod
+    def _ctx_lines(held):
+        return [ln for _h, ln in held]
+
+    def _handle_call(self, call, held, summ, cls_name, fn, in_while,
+                     skip_lock_ctx=False):
+        chain = _attr_chain(call.func)
+        if chain is None:
+            return
+        parts = chain.split(".")
+        leaf = parts[-1]
+        recv_chain = ".".join(parts[:-1]) if len(parts) > 1 else None
+        recv_lock = (self._resolve_lock_chain(recv_chain, cls_name)
+                     if recv_chain else None)
+        held_ids = self._held_ids(held)
+
+        # condition-variable ops ------------------------------------------
+        if recv_lock is not None and leaf in ("wait", "wait_for"):
+            if recv_lock in held_ids:
+                # the legitimate cv wait: releases the held lock. Check
+                # the predicate-loop + timeout discipline.
+                if leaf == "wait" and not in_while:
+                    self._local(summ, Finding(
+                        "cond-wait-outside-loop", WARNING,
+                        f"{chain}() outside a while-predicate loop — "
+                        "wakeups are spurious and notifies race; wrap "
+                        "the wait in `while not <predicate>:` and "
+                        "re-check after every wake",
+                        loc=f"{self.rel}:{call.lineno}"), held)
+                if leaf == "wait" and not call.args and not call.keywords:
+                    self._local(summ, Finding(
+                        "cond-wait-without-timeout", WARNING,
+                        f"bare {chain}() with no timeout — a missed "
+                        "notify (crashed producer, torn-down peer) "
+                        "becomes an unbounded hang no metric surfaces; "
+                        "pass a timeout and re-check the predicate",
+                        loc=f"{self.rel}:{call.lineno}"), held)
+                return
+            # waiting on a DIFFERENT lock's condition while holding
+            # locks: blocks with the held locks pinned
+            if held_ids:
+                self._local(summ, Finding(
+                    "blocking-call-under-lock", WARNING,
+                    f"{chain}.{leaf}() waits on a condition whose lock "
+                    f"is not held, while holding "
+                    f"{', '.join(held_ids)} — every thread behind "
+                    "those locks stalls until this wait returns",
+                    loc=f"{self.rel}:{call.lineno}"), held)
+            return
+        if recv_lock is not None and leaf in ("notify", "notify_all"):
+            if recv_lock not in held_ids \
+                    and not fn.name.endswith("_locked") \
+                    and not self._fn_acquires(fn, recv_lock, cls_name):
+                self._local(summ, Finding(
+                    "notify-without-lock", ERROR,
+                    f"{chain}.{leaf}() without holding "
+                    f"{recv_lock} — raises RuntimeError at runtime (and "
+                    "a waiter woken without the mutex-protected state "
+                    "update is a lost-wakeup race); hold the lock, or "
+                    "name the enclosing function *_locked if the caller "
+                    "holds it by contract",
+                    loc=f"{self.rel}:{call.lineno}"), held)
+            return
+        if recv_lock is not None and leaf in ("acquire", "release",
+                                              "locked"):
+            if leaf == "acquire" and not skip_lock_ctx:
+                summ.acquired.add(recv_lock)
+                self._note_acquire(recv_lock, call.lineno, held, summ)
+            return
+
+        # plain calls -------------------------------------------------------
+        if leaf == "join" and not self._is_thread_join(call):
+            pass  # string/path join — not a blocking primitive
+        elif leaf in BLOCKING_LEAVES or \
+                (leaf == "wait" and recv_lock is None) or \
+                (len(parts) > 1 and parts[0] == "subprocess"):
+            if leaf == "wait" and recv_chain is None:
+                return
+            if held_ids:
+                self._local(summ, Finding(
+                    "blocking-call-under-lock", WARNING,
+                    f"{chain}() while holding {', '.join(held_ids)} — "
+                    "a blocking call under a lock turns one slow "
+                    "peer/disk/socket into a stall of every thread "
+                    "behind the lock; move the call outside the "
+                    "critical section (snapshot under the lock, act "
+                    "after releasing)",
+                    loc=f"{self.rel}:{call.lineno}"), held)
+            else:
+                summ.exposed_blocking.append((leaf, call.lineno))
+            return
+
+        # call-site bookkeeping for cross-function propagation
+        callee = self._resolve_callee(chain, cls_name)
+        if callee is not None:
+            summ.calls.append((callee, tuple(held), call.lineno))
+
+    def _local(self, summ, finding, held):
+        finding.ctx_lines = tuple(self._ctx_lines(held))
+        summ.local_findings.append(finding)
+
+    @staticmethod
+    def _is_thread_join(call):
+        """A join() that can block: not a str/sep join (constant or
+        comprehension-fed receivers) and not os.path.join."""
+        func = call.func
+        recv = func.value if isinstance(func, ast.Attribute) else None
+        if isinstance(recv, (ast.Constant, ast.JoinedStr)):
+            return False
+        chain = _attr_chain(func) or ""
+        if ".path.join" in ("." + chain) or chain == "os.path.join":
+            return False
+        for a in call.args:
+            if isinstance(a, (ast.GeneratorExp, ast.ListComp,
+                              ast.SetComp)):
+                return False
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return False
+        return True
+
+    def _fn_acquires(self, fn, lock_id, cls_name):
+        """Does ``fn`` ever acquire ``lock_id`` via a raw acquire() call
+        (the with-form is tracked positionally already)?"""
+        for sub in self._walk_no_defs(fn):
+            if isinstance(sub, ast.Call):
+                chain = _attr_chain(sub.func)
+                if chain and chain.endswith(".acquire"):
+                    lid = self._resolve_lock_chain(
+                        chain.rsplit(".", 1)[0], cls_name)
+                    if lid == lock_id:
+                        return True
+        return False
+
+    def _resolve_callee(self, chain, cls_name):
+        """(class, name) key for a same-module call target — resolved
+        lazily against self.fns at reporting time (the callee may be
+        analyzed after this call site)."""
+        parts = chain.split(".")
+        if parts[0] == "self" and len(parts) == 2 and cls_name:
+            return (cls_name, parts[1])
+        if len(parts) == 1:
+            return (None, parts[0])
+        return None
+
+    # -- pass 2: fixpoint over calls ----------------------------------------
+    def _fixpoint(self):
+        """ACQ(f): locks f may take, transitively. BLK(f): blocking
+        leaves f may hit with no lock of its own held, transitively
+        through calls made with nothing held locally."""
+        acq = {k: set(s.acquired) for k, s in self.fns.items()}
+        blk = {k: list(s.exposed_blocking) for k, s in self.fns.items()}
+        for k, s in self.fns.items():
+            acq[k] |= {a for a, _b, _ln in s.edges}
+            acq[k] |= {b for _a, b, _ln in s.edges}
+        changed = True
+        rounds = 0
+        while changed and rounds < 20:
+            changed = False
+            rounds += 1
+            for k, s in self.fns.items():
+                for callee, held, _line in s.calls:
+                    if callee not in self.fns:
+                        continue
+                    before = len(acq[k])
+                    acq[k] |= acq[callee]
+                    if len(acq[k]) != before:
+                        changed = True
+                    if not held:
+                        have = set(blk[k])
+                        for t in blk[callee]:
+                            if t not in have:
+                                blk[k].append(t)
+                                have.add(t)
+                                changed = True
+        return acq, blk
+
+    # -- pass 3: findings ----------------------------------------------------
+    def run(self):
+        self._collect_defs()
+        self._analyze_functions()
+        acq, blk = self._fixpoint()
+
+        edges = {}  # (a, b) -> (line, how)
+        for key, s in self.fns.items():
+            self.findings.extend(s.local_findings)
+            for a, b, line in s.edges:
+                edges.setdefault((a, b), (line, "nested acquisition"))
+            for callee, held, line in s.calls:
+                if callee not in self.fns or not held:
+                    continue
+                cname = (f"{callee[0]}.{callee[1]}" if callee[0]
+                         else callee[1])
+                for m in acq.get(callee, ()):
+                    for h, _ln in held:
+                        if m != h:
+                            edges.setdefault(
+                                (h, m),
+                                (line, f"via call to {cname}()"))
+                leaves = blk.get(callee, ())
+                if leaves:
+                    what = ", ".join(sorted(
+                        {f"{leaf}() ({self.rel}:{bl})"
+                         for leaf, bl in leaves}))
+                    # ctx carries BOTH the with-lines in the caller and
+                    # the blocking-leaf origin lines: a suppression at
+                    # the deliberate blocking call covers every locked
+                    # call site that reaches it
+                    self.findings.append(Finding(
+                        "blocking-call-under-lock", WARNING,
+                        f"call to {cname}() while holding "
+                        f"{', '.join(h for h, _ln in held)} — it "
+                        f"performs blocking {what}; snapshot under the "
+                        "lock, do the blocking work after releasing",
+                        loc=f"{self.rel}:{line}",
+                        ctx_lines=[ln for _h, ln in held]
+                        + [bl for _leaf, bl in leaves]))
+
+        self._report_cycles(edges)
+        return self.findings
+
+    def _report_cycles(self, edges):
+        adj = {}
+        for (a, b), _meta in edges.items():
+            adj.setdefault(a, set()).add(b)
+        reported = set()
+        for (a, b), (line, how) in sorted(edges.items(),
+                                          key=lambda kv: kv[1][0]):
+            path = self._path(adj, b, a)
+            if path is None:
+                continue
+            cycle = [a, b] + path[1:]
+            key = frozenset(cycle)
+            if key in reported:
+                continue
+            reported.add(key)
+            locs = []
+            for x, y in zip(cycle, cycle[1:]):
+                meta = edges.get((x, y))
+                if meta:
+                    locs.append(f"{x}->{y} at {self.rel}:{meta[0]} "
+                                f"({meta[1]})")
+            self.findings.append(Finding(
+                "lock-order-cycle", ERROR,
+                "lock-acquisition-order cycle "
+                + " -> ".join(cycle)
+                + " — two paths take these locks in opposite orders; "
+                "the first unlucky interleaving deadlocks both threads "
+                "with no timeout and no metric. Pick ONE order (or "
+                "drop to a single lock). Edges: " + "; ".join(locs),
+                loc=f"{self.rel}:{line}",
+                ctx_lines=[edges[(x, y)][0]
+                           for x, y in zip(cycle, cycle[1:])
+                           if (x, y) in edges]))
+
+    @staticmethod
+    def _path(adj, start, target):
+        if start == target:
+            return [start]
+        stack = [(start, [start])]
+        seen = {start}
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == target:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+
+def _expand_py(entries, repo_root):
+    out = []
+    for p in entries:
+        full = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        if os.path.isdir(full):
+            for dirpath, _dirs, files in os.walk(full):
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(files) if f.endswith(".py"))
+        else:
+            out.append(full)
+    return out
+
+
+def check_concurrency(paths=None, repo_root=None):
+    """Run the static concurrency rules over ``paths`` (files or
+    directories; default ``CONCURRENCY_PATHS``). Returns findings;
+    suppressed ones are demoted to INFO with the reason attached.
+    Files that fail to parse report a finding instead of raising."""
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    findings = []
+    seen = set()
+    for path in _expand_py(paths or CONCURRENCY_PATHS, repo_root):
+        path = os.path.abspath(path)
+        if path in seen or not os.path.isfile(path):
+            continue
+        seen.add(path)
+        rel = os.path.relpath(path, repo_root)
+        try:
+            with open(path) as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "syntax-error", ERROR, str(e), loc=f"{rel}:{e.lineno}"))
+            continue
+        fs = _ModuleChecker(rel, tree).run()
+        findings.extend(apply_suppressions(fs, parse_suppressions(src)))
+    return findings

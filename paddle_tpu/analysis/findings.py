@@ -20,10 +20,10 @@ class Finding:
     program op / slot / source location."""
 
     __slots__ = ("rule", "severity", "message", "op_index", "op_name",
-                 "slot", "loc")
+                 "slot", "loc", "ctx_lines")
 
     def __init__(self, rule, severity, message, op_index=None, op_name=None,
-                 slot=None, loc=None):
+                 slot=None, loc=None, ctx_lines=None):
         if severity not in (ERROR, WARNING, INFO):
             raise ValueError(f"bad severity {severity!r}")
         self.rule = rule
@@ -33,6 +33,9 @@ class Finding:
         self.op_name = op_name
         self.slot = slot
         self.loc = loc  # "path:line" for source-lint findings
+        # extra source lines a suppression comment may sit on (e.g. the
+        # `with` statement that acquired the lock a finding is about)
+        self.ctx_lines = tuple(ctx_lines) if ctx_lines else ()
 
     def __repr__(self):
         where = ""

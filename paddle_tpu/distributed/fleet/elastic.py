@@ -171,6 +171,7 @@ class TcpKVStore:
         self._mu = threading.Lock()
 
     def _call(self, **req):
+        # lint: blocking-call-under-lock the mutex serializes one KV connection's request/reply framing (same leaf-lock design as pod._Conn); nothing else is ever held around _call
         with self._mu:
             try:
                 if self._sock is None:

@@ -82,6 +82,7 @@ import time
 
 import numpy as np
 
+from .. import _lockwatch as lockwatch
 from .restart import RestartPolicy
 
 __all__ = ["PodRuntime", "PodCoordinator", "PodSupervisor", "RankExit",
@@ -175,7 +176,7 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
         self._admitted = {}  # origin -> {"gen","rank","world"} (post-reform)
         self._hb_gaps = {}   # origin -> deque of heartbeat gaps (seconds)
         self._straggling = set()  # origins currently past the threshold
-        self._cond = threading.Condition()
+        self._cond = lockwatch.Condition(name="pod.coordinator")
         self._closed = False
         super().__init__(addr, _PodHandler)
         interval = (monitor_interval if monitor_interval is not None
@@ -290,67 +291,98 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
     def _monitor_leases(self, interval):
         while not self._closed:
             time.sleep(interval)
-            now = time.time()
-            with self._cond:
-                # leases only bind once the pod has FORMED: during
-                # rendezvous a joined rank's heartbeat hasn't started
-                # (init() returns after join), so join skew longer than
-                # the ttl must not falsely kill the early joiners —
-                # formation re-stamps every lease (_op_join) and
-                # enforcement begins from there
-                if self.expected is None \
-                        or len(self._members) < self.expected:
-                    continue
-                for rank in list(self._members):
-                    if rank in self._failed:
-                        continue
-                    lease = self._leases.get(rank)
-                    if lease is not None and now - lease > self.lease_ttl:
-                        self._mark_failed_locked(
-                            rank, f"lease expired ({now - lease:.2f}s > "
-                                  f"ttl {self.lease_ttl:.2f}s without a "
-                                  "heartbeat)")
-                self._observe_stragglers_locked(now)
+            self._monitor_once(time.time())
 
-    def _observe_stragglers_locked(self, now):
-        """One straggler sweep: edge-triggered ``pod_straggler`` run-log
-        events (re-armed once the rank recovers under threshold/2) and
-        per-rank ``pod_rank_heartbeat_ms{rank=,q=}`` gauges. Best-effort
-        — a metrics error must never take the lease monitor down."""
-        try:
-            thr = self.straggler_threshold
-            gaps_now = {}
-            for rank, info in self._members.items():
+    def _monitor_once(self, now):
+        """One lease-enforcement + straggler sweep. Lock discipline:
+        membership state mutates under the condition, but the straggler
+        telemetry (run-log events and gauges — file + registry I/O) is
+        emitted AFTER release. Emitting it under the coordinator's one
+        condition serialized every join/barrier/heartbeat handler
+        behind a disk write per monitor tick — the exact hazard the
+        ``blocking-call-under-lock`` rule flags (it did, here)."""
+        with self._cond:
+            # leases only bind once the pod has FORMED: during
+            # rendezvous a joined rank's heartbeat hasn't started
+            # (init() returns after join), so join skew longer than
+            # the ttl must not falsely kill the early joiners —
+            # formation re-stamps every lease (_op_join) and
+            # enforcement begins from there
+            if self.expected is None \
+                    or len(self._members) < self.expected:
+                return
+            for rank in list(self._members):
                 if rank in self._failed:
                     continue
                 lease = self._leases.get(rank)
-                if lease is not None:
-                    gaps_now[info["origin"]] = now - lease
-            for origin, gap in gaps_now.items():
-                if gap > thr and gap <= self.lease_ttl \
-                        and origin not in self._straggling:
-                    self._straggling.add(origin)
-                    _runlog_event("pod_straggler", origin=origin,
-                                  gap_ms=round(gap * 1e3, 1),
-                                  threshold_ms=round(thr * 1e3, 1),
-                                  gen=self.gen)
-                    try:
-                        from .. import monitor
-                        monitor.stat_add("pod_stragglers_total", 1)
-                    except Exception:
-                        pass
-                elif gap <= thr / 2.0 and origin in self._straggling:
-                    self._straggling.discard(origin)
+                if lease is not None and now - lease > self.lease_ttl:
+                    self._mark_failed_locked(
+                        rank, f"lease expired ({now - lease:.2f}s > "
+                              f"ttl {self.lease_ttl:.2f}s without a "
+                              "heartbeat)")
+            snap = self._straggler_snapshot_locked(now)
+        self._emit_straggler_telemetry(snap)
+
+    def _straggler_snapshot_locked(self, now):
+        """One straggler sweep's STATE half (caller holds the
+        condition): update the edge-trigger set, return the plain-data
+        snapshot — new stragglers to announce plus per-rank gap series
+        — for :meth:`_emit_straggler_telemetry` to publish unlocked."""
+        thr = self.straggler_threshold
+        gaps_now = {}
+        for rank, info in self._members.items():
+            if rank in self._failed:
+                continue
+            lease = self._leases.get(rank)
+            if lease is not None:
+                gaps_now[info["origin"]] = now - lease
+        new_stragglers = []
+        for origin, gap in gaps_now.items():
+            if gap > thr and gap <= self.lease_ttl \
+                    and origin not in self._straggling:
+                self._straggling.add(origin)
+                new_stragglers.append((origin, gap))
+            elif gap <= thr / 2.0 and origin in self._straggling:
+                self._straggling.discard(origin)
+        series = {}
+        for origin, gap in gaps_now.items():
+            rec = {"last": gap}
+            hist = sorted(self._hb_gaps.get(origin, ()))
+            if hist:
+                rec["p50"] = hist[len(hist) // 2]
+                rec["p95"] = hist[min(len(hist) - 1,
+                                      int(round((len(hist) - 1)
+                                                * 0.95)))]
+            series[origin] = rec
+        return {"threshold": thr, "gen": self.gen,
+                "new_stragglers": new_stragglers, "series": series}
+
+    def _emit_straggler_telemetry(self, snap):
+        """Publish one straggler snapshot: edge-triggered
+        ``pod_straggler`` run-log events (re-armed once the rank
+        recovers under threshold/2) and per-rank
+        ``pod_rank_heartbeat_ms{rank=,q=}`` gauges. Runs with NO
+        coordinator lock held; best-effort — a metrics error must never
+        take the lease monitor down."""
+        try:
+            thr = snap["threshold"]
+            for origin, gap in snap["new_stragglers"]:
+                # 3-decimal precision like heartbeat_stats: the trigger
+                # is STRICTLY gap > threshold, and 1-decimal rounding
+                # could collapse a 300.04 ms gap onto the 300.0 ms
+                # threshold, contradicting the inequality downstream
+                _runlog_event("pod_straggler", origin=origin,
+                              gap_ms=round(gap * 1e3, 3),
+                              threshold_ms=round(thr * 1e3, 3),
+                              gen=snap["gen"])
+                try:
+                    from .. import monitor
+                    monitor.stat_add("pod_stragglers_total", 1)
+                except Exception:
+                    pass
             from ..observability import export
-            for origin, gap in gaps_now.items():
-                series = {"last": gap}
-                hist = sorted(self._hb_gaps.get(origin, ()))
-                if hist:
-                    series["p50"] = hist[len(hist) // 2]
-                    series["p95"] = hist[min(len(hist) - 1,
-                                             int(round((len(hist) - 1)
-                                                       * 0.95)))]
-                for q, v in series.items():
+            for origin, rec in snap["series"].items():
+                for q, v in rec.items():
                     name = "pod_rank_heartbeat_ms" + export.format_labels(
                         "pod_rank_heartbeat_ms", rank=origin, q=q)
                     export.set_gauge(name, round(v * 1e3, 3))
@@ -385,6 +417,7 @@ class PodCoordinator(socketserver.ThreadingTCPServer):
                 # parks in the LOBBY until the next reform admits it —
                 # the running generation is not disturbed, and nprocs
                 # is irrelevant (the world may have shrunk since launch)
+                # lint: blocking-call-under-lock one pod_lobby_join run-log write per (rare) lobby join; the handler owns the condition for its whole park-and-wait, and the cv-wait loop releases it between polls
                 return self._lobby_join_locked(int(req.get("origin", rank)),
                                                req, deadline)
             if self.expected is None:
@@ -739,9 +772,10 @@ class _Conn:
         self.connect_timeout = connect_timeout
         self._sock = None
         self._f = None
-        self._mu = threading.Lock()
+        self._mu = lockwatch.Lock(name="pod.conn")
 
     def call(self, io_timeout, **req):
+        # lint: blocking-call-under-lock the mutex serializes one wire connection's request/reply framing — blocking inside IS the design; callers hold no other lock across call() (the pod runtime splits ops and heartbeat onto separate _Conns exactly so this lock stays a leaf)
         with self._mu:
             try:
                 if self._sock is None:
@@ -806,7 +840,7 @@ class PodRuntime:
         self.join_timeout = float(join_timeout)
         self.jax_init = jax_init
         self.uid = None
-        self._lock = threading.RLock()
+        self._lock = lockwatch.RLock(name="pod.runtime")
         self._rank = int(process_id)
         self._world = list(range(self.num_processes))
         self._gen = 0

@@ -45,6 +45,7 @@ import time
 
 import numpy as np
 
+from ... import _lockwatch as lockwatch
 from ... import monitor
 from ...testing import faults as _faults
 
@@ -278,8 +279,8 @@ class WriteBackQueue:
         self._items = []      # [(table, keys u64, deltas f32[n, dim])]
         self._inflight = []   # taken by the worker, not yet pushed
         self._rows = 0        # enqueued + in-flight rows (backpressure)
-        self._mu = threading.Lock()
-        self._cv = threading.Condition(self._mu)
+        self._mu = lockwatch.Lock(name="wbq.mu")
+        self._cv = lockwatch.Condition(self._mu, name="wbq.cv")
         self._stop = False
         self._error = None
         self.pushed_rows = 0

@@ -49,12 +49,12 @@ Eviction gains a telemetry-driven adaptive watermark (``free_target`` /
 future fault never pays eviction + pull serially; cheap pulls → lazy.
 """
 import functools
-import threading
 import time
 from collections import OrderedDict
 
 import numpy as np
 
+from ... import _lockwatch as lockwatch
 from ... import monitor
 from ...core.dispatch import call_op, unwrap, wrap
 from .embedding import SparseEmbedding
@@ -202,7 +202,7 @@ class HbmEmbeddingCache:
         # index structures between the foreground step and the
         # prefetch/write-back threads (device ops stay inside it —
         # correctness over parallel dispatch on the host index)
-        self._mu = threading.RLock()
+        self._mu = lockwatch.RLock(name="hbm_cache.mu")
         self.writeback = writeback    # optional WriteBackQueue
         self._plan_pins = {}          # key -> count of unconsumed plans
         # deferred device work from the prefetch stage: the planner
@@ -865,8 +865,10 @@ class HbmEmbeddingCache:
         # with its delta still queued must not be re-pulled stale
         if self.writeback is not None and \
                 self.writeback.has_pending(self.table_id, keys):
+            # lint: blocking-call-under-lock read-your-writes: the queued delta must reach the PS before the re-pull or a stale row installs; sync fallback path only — the async pipeline (plan_window) pulls outside the lock
             self.writeback.flush()
         t0 = time.perf_counter()
+        # lint: blocking-call-under-lock the SYNC fault-in path holds the cache lock across the pull by design — slot assignment, eviction and install staging must be atomic against concurrent lookups; the async pipeline (plan_window) is the unlocked fast path and the prefetcher hides this cost
         rows = self.client.pull_sparse(self.table_id, keys)
         pull_ms = (time.perf_counter() - t0) * 1e3
         self._pull_ms_ema = pull_ms if self._pull_ms_ema is None else \
@@ -894,6 +896,7 @@ class HbmEmbeddingCache:
         if self.writeback is not None:
             self.writeback.put(self.table_id, keys, delta)
         else:
+            # lint: blocking-call-under-lock sync push fallback when no write-back queue is attached (single-thread CTR path); attach a WriteBackQueue to overlap pushes behind compute — put() above is watermark-bounded, not wire-bound
             self.client.push_sparse_delta(self.table_id, keys, delta)
 
     def _evict(self, n, pinned, strict=True, defer=False):

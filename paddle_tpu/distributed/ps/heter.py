@@ -131,6 +131,7 @@ class HeterClient:
 
     def send_and_recv(self, activations, labels):
         """Ship the embedding-stage output; get (loss, d_activations)."""
+        # lint: blocking-call-under-lock the mutex serializes the stage channel's request/reply framing — interleaved writers would corrupt the array stream; the lock is a leaf (nothing is held around send_and_recv)
         with self._mu:
             _send_arrays(self._sock, [activations, labels])
             arrays = _recv_arrays(self._sock)
@@ -140,6 +141,7 @@ class HeterClient:
             return float(loss), dacts
 
     def stop_server(self):
+        # lint: blocking-call-under-lock same wire-framing serialization as send_and_recv; shutdown-path only
         with self._mu:
             try:
                 _send_arrays(self._sock, [np.zeros(())])

@@ -22,6 +22,7 @@ import re
 import threading
 import time
 
+from .. import _lockwatch as lockwatch
 from .. import monitor
 
 __all__ = ["publish", "gauges", "set_gauge", "prometheus_text",
@@ -36,7 +37,7 @@ __all__ = ["publish", "gauges", "set_gauge", "prometheus_text",
 PROM_PREFIX = "paddle_tpu"
 
 _gauges = {}
-_gauges_lock = threading.Lock()
+_gauges_lock = lockwatch.Lock(name="metrics.gauges")
 
 # the quantile ladder every summary exports (Prometheus summary-type
 # convention: one labeled series per quantile + _count/_sum)
@@ -88,7 +89,7 @@ def _max_label_sets():
 
 
 _label_sets = {}  # metric -> set of label suffixes already admitted
-_label_sets_lock = threading.Lock()
+_label_sets_lock = lockwatch.Lock(name="metrics.label_sets")
 
 
 def clear_label_sets():
@@ -159,7 +160,7 @@ class Summary:
         self._n = 0          # lifetime observations (ring fills to window)
         self._count = 0
         self._sum = 0.0
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock(name="metrics.summary")
 
     def observe(self, value):
         v = float(value)
@@ -212,7 +213,7 @@ class Summary:
 
 
 _summaries = {}
-_summaries_lock = threading.Lock()
+_summaries_lock = lockwatch.Lock(name="metrics.summaries")
 
 
 def summary(name, window=None):
@@ -250,7 +251,7 @@ def clear_summaries():
 # label suffix ('ps_server_op_ns{table="1000",op="pull_sparse"}'); values
 # must be monotonic counters.
 _collectors = {}
-_collectors_lock = threading.Lock()
+_collectors_lock = lockwatch.Lock(name="metrics.collectors")
 
 _name_re = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -292,7 +293,7 @@ def collected():
 # aggregate on /healthz (200 while every component is "ok", 503
 # otherwise — the readiness-probe contract).
 _health = {}
-_health_lock = threading.Lock()
+_health_lock = lockwatch.Lock(name="metrics.health")
 
 
 def register_health(name, fn):

@@ -31,12 +31,14 @@ import sys
 import threading
 import traceback
 
+from .. import _lockwatch as _lockwatch_mod
+
 __all__ = ["install", "uninstall", "installed", "dump", "record",
            "recent_spans", "clear", "DEFAULT_RING"]
 
 DEFAULT_RING = 512
 
-_lock = threading.Lock()
+_lock = _lockwatch_mod.Lock(name="flight.ring")
 _ring = collections.deque(maxlen=DEFAULT_RING)
 _dir = [None]           # dump directory; None = not installed
 _seq = [0]
@@ -133,6 +135,19 @@ def _memory_section():
         return {"error": str(e)[:300]}
 
 
+def _lockwatch_section():
+    """Lock-order watchdog snapshot (edge graph, per-thread held sets,
+    recorded violations) — present in every dump while the watchdog is
+    armed, so a ``pod_failure`` / crash post-mortem shows who held what
+    at death. None (section absent) when disarmed."""
+    try:
+        if not _lockwatch_mod.enabled():
+            return None
+        return _lockwatch_mod.snapshot()
+    except Exception as e:
+        return {"error": str(e)[:300]}
+
+
 def _classify(reason, exc):
     """Recognize allocation failures: a dump whose exception matches the
     XLA allocation-error vocabulary (``RESOURCE_EXHAUSTED``, "out of
@@ -169,6 +184,9 @@ def dump(reason, exc=None, extra=None):
                "metrics": _metrics_snapshot(),
                "memory": _memory_section(),
                "faults": _faults_snapshot()}
+        lw = _lockwatch_section()
+        if lw is not None:
+            rec["lockwatch"] = lw
         if tagged != reason:
             rec["cause"] = reason
         if exc is not None:

@@ -30,10 +30,12 @@ import os
 import threading
 import time
 
+from .. import _lockwatch as lockwatch
+
 __all__ = ["RunLog", "start_run", "stop_run", "active", "event", "span",
            "log_path"]
 
-_lock = threading.Lock()
+_lock = lockwatch.Lock(name="runlog.registry")
 _active = [None]
 
 
@@ -95,7 +97,7 @@ class RunLog:
         # start_run, or an explicit path=): count what's already there
         # or max_bytes would bound only the NEW bytes, not the file
         self._bytes = self._f.tell()
-        self._lock = threading.Lock()
+        self._lock = lockwatch.Lock(name="runlog.file")
         self.events_written = 0
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -133,6 +135,7 @@ class RunLog:
 
     def _write(self, rec):
         line = json.dumps(rec, default=str)
+        # lint: blocking-call-under-lock one line + flush under the lock IS the stream's consistency contract (concurrent workers must not interleave bytes, a crash loses at most the line in flight); the fsync runs only on a size-triggered roll
         with self._lock:
             if self._f is None:
                 return
@@ -181,6 +184,7 @@ class RunLog:
         self._write(rec)
 
     def close(self):
+        # lint: blocking-call-under-lock shutdown-path flush+fsync; the lock orders close() against in-flight _write()s so no writer hits a closed file
         with self._lock:
             if self._f is not None:
                 self._f.flush()

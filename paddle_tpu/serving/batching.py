@@ -31,6 +31,8 @@ import time
 from collections import deque
 from concurrent.futures import Future, InvalidStateError
 
+from .. import _lockwatch as lockwatch
+
 __all__ = ["Request", "DynamicBatcher", "OverloadedError",
            "DeadlineExceeded"]
 
@@ -85,7 +87,7 @@ class DynamicBatcher:
                 f"max_pending must be >= 1, got {max_pending}")
         self._on_expired = on_expired
         self._q = deque()
-        self._cond = threading.Condition()
+        self._cond = lockwatch.Condition(name="serving.batcher")
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=name)
@@ -207,7 +209,11 @@ class DynamicBatcher:
             drained = False
             with self._cond:
                 while not self._q and self._running:
-                    self._cond.wait()
+                    # bounded idle wait + predicate re-check: a missed
+                    # notify (close() racing an exception path) must
+                    # degrade to a 0.5 s late wake, not a worker hung
+                    # forever on futures nobody will resolve
+                    self._cond.wait(timeout=0.5)
                 first = self._pop_live(expired)
                 if first is None:
                     if not self._running and not self._q:

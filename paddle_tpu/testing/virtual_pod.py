@@ -59,6 +59,13 @@ class VirtualPod(PodSupervisor):
         self.respawn_kills = {int(o): list(specs)
                               for o, specs in (respawn_kills or {}).items()}
         env = dict(env or {})
+        # the chaos tier runs deadlock-checked end-to-end: every rank
+        # arms the lock-order watchdog (analysis.lockwatch) so the pod
+        # runtime / runlog / cache locks are order-checked under real
+        # kills, and any violation rides the flight dump. Env-level so
+        # module-scope locks instrument too; a test may override with
+        # "0" to measure the disarmed path.
+        env.setdefault("PADDLE_TPU_LOCKWATCH", "1")
         if self.kills:
             env["PADDLE_TPU_PROCESS_KILL"] = ",".join(
                 f"{point}@{rank}#{nth}" for rank, point, nth in

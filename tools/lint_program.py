@@ -5,13 +5,19 @@ Reference analog: the C++-side graph checks that keep fluid's ~80 IR
 passes and `framework/prune.cc` honest, surfaced as a CI-runnable tool
 over the collapsed trace->XLA pipeline.
 
-    python tools/lint_program.py               # --ladder and --source
+    python tools/lint_program.py               # --ladder, --source and
+                                               # --concurrency (the default
+                                               # sweep)
     python tools/lint_program.py --ladder      # verify the benchmark
                                                # ladder's program miniatures
     python tools/lint_program.py --source      # AST lint (nondeterminism in
                                                # traced fns, eager jnp in
                                                # dispatch hot paths)
     python tools/lint_program.py --source paddle_tpu/core/dispatch.py ...
+    python tools/lint_program.py --concurrency # lock-order cycles, blocking
+                                               # calls under a lock, cv-wait
+                                               # discipline over the thread-
+                                               # heavy runtime modules
 
 Exit codes: 0 clean, 1 any error-severity finding (warnings print but do
 not fail the gate; --strict promotes them). Wired into the verify-skill
@@ -33,15 +39,22 @@ def main(argv=None):
     ap.add_argument("--source", nargs="*", metavar="PATH",
                     help="AST-lint sources (no PATH = the registered "
                     "hot-path files)")
+    ap.add_argument("--concurrency", nargs="*", metavar="PATH",
+                    help="static concurrency analysis (no PATH = the "
+                    "thread-heavy runtime modules under "
+                    "distributed/serving/observability/testing)")
     ap.add_argument("--configs", default=None,
                     help="comma list of ladder configs (default: all)")
     ap.add_argument("--strict", action="store_true",
                     help="warnings also fail the gate")
     args = ap.parse_args(argv)
 
-    # no flags = both; either flag alone selects just that half
-    run_ladder = args.ladder or args.source is None
-    run_source = args.source is not None or not args.ladder
+    # no flags = the full default sweep; any flag alone selects its part
+    none_selected = (not args.ladder and args.source is None
+                     and args.concurrency is None)
+    run_ladder = args.ladder or none_selected
+    run_source = args.source is not None or none_selected
+    run_concurrency = args.concurrency is not None or none_selected
 
     findings = []
     if run_ladder:
@@ -78,6 +91,9 @@ def main(argv=None):
     if run_source:
         from paddle_tpu.analysis import lint_source
         findings.extend(lint_source(paths=args.source or None))
+    if run_concurrency:
+        from paddle_tpu.analysis import check_concurrency
+        findings.extend(check_concurrency(paths=args.concurrency or None))
 
     n_err = sum(f.severity == "error" for f in findings)
     n_warn = sum(f.severity == "warning" for f in findings)

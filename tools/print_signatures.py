@@ -25,6 +25,8 @@ MODULES = [
     "paddle_tpu.static",
     "paddle_tpu.jit",
     "paddle_tpu.analysis",
+    "paddle_tpu.analysis.concurrency",
+    "paddle_tpu.analysis.lockwatch",
     "paddle_tpu.amp",
     "paddle_tpu.io",
     "paddle_tpu.metric",
