@@ -13,7 +13,7 @@ timings are only meaningful for programs the verifier accepts.
 """
 
 __all__ = ["LADDER_BUILDERS", "build_ladder_programs", "verify_ladder",
-           "attribute_memory"]
+           "attribute_memory", "attribute_overlap"]
 
 
 def _resnet_like():
@@ -408,6 +408,33 @@ def attribute_memory(configs=None, programs=None):
         for prog, targets in pairs:
             try:
                 rows.append(attribute_program(prog, targets))
+            except MemoryAttributionError as e:
+                rows.append({"error": str(e)[:300]})
+        out[name] = rows
+    return out
+
+
+def attribute_overlap(configs=None, programs=None):
+    """Collective-overlap attribution of every ladder twin
+    (``observability.overlap`` over the twin's AOT-compiled schedule):
+    ``{config: [stats per program]}``, failures as ``{"error": ...}``
+    rows — the same contract as :func:`attribute_memory`, rendered by
+    ``tools/overlap_view.py --ladder`` and gated by ``lint_program
+    --ladder``. The twins' stand-in collectives are identity ops, so
+    their compiled HLO honestly reports zero collective time on the
+    smoke mesh; what this pass certifies is that every verified twin's
+    schedule *parses and prices* without error."""
+    from ..observability.memory import MemoryAttributionError
+    from ..observability.overlap import attribute_program as _overlap
+
+    out = {}
+    if programs is None:
+        programs = build_ladder_programs(configs)
+    for name, pairs in programs.items():
+        rows = []
+        for prog, targets in pairs:
+            try:
+                rows.append(_overlap(prog, targets))
             except MemoryAttributionError as e:
                 rows.append({"error": str(e)[:300]})
         out[name] = rows

@@ -4,5 +4,6 @@ from .io import save, load, TranslatedLayer  # noqa: F401
 from .traced_layer import TracedLayer  # noqa: F401
 from . import dy2static  # noqa: F401  (reference: paddle.jit.dy2static)
 from . import compile_cache  # noqa: F401  (persistent XLA compile cache)
+from . import xla_flags  # noqa: F401  (per-program compiler options)
 
 compile_cache.configure_from_env()  # records env policy only; backend-clean

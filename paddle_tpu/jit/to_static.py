@@ -289,11 +289,18 @@ class StaticFunction:
     """
 
     def __init__(self, fn, input_spec=None, donate_state=True,
-                 scan_steps=None, dp_axis=None, accumulate_steps=None):
+                 scan_steps=None, dp_axis=None, accumulate_steps=None,
+                 xla_flags=None):
+        from . import xla_flags as _xla_flags_mod
         self._fn = fn
         self._cache = {}
         self._donate = donate_state
         self._input_spec = input_spec
+        # per-program XLA compiler options (latency-hiding A/B knob):
+        # resolved once at wrap time (env overlay included), applied to
+        # every compiled entry via _jit()
+        self._xla_flags = _xla_flags_mod.resolve(xla_flags)
+        self._flagged_jits = []
         if scan_steps is not None and int(scan_steps) < 1:
             raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
         self._scan_steps = int(scan_steps) if scan_steps is not None else None
@@ -319,6 +326,39 @@ class StaticFunction:
             self._accumulate_steps = a if a > 1 else None
         self._last_aux = None
         functools.update_wrapper(self, fn)
+
+    def _jit(self, fun, **kwargs):
+        """``jax.jit`` for one compiled entry, carrying this program's
+        XLA compiler options (``jit.xla_flags``): unknown-flag errors
+        degrade to an unflagged recompile with the fallback recorded as
+        provenance — see :meth:`xla_flags`."""
+        from . import xla_flags as _xla_flags_mod
+        flagged = _xla_flags_mod.jit(fun, xla_flags=self._xla_flags,
+                                     **kwargs)
+        self._flagged_jits.append(flagged)
+        return flagged
+
+    def xla_flags(self):
+        """Flag provenance of this program: the resolved per-program
+        compiler options (env overlay included) and whether the backend
+        accepted them — ``applied`` is True once a flagged compile
+        succeeded, False after the unknown-flag fallback (with the
+        error), None while no compiled entry has been judged yet. The
+        value the bench records and runlogs carry next to any A/B
+        row."""
+        prov = {"flags": dict(self._xla_flags), "applied": None,
+                "fallback_error": None}
+        if not self._xla_flags:
+            prov["applied"] = False  # nothing to apply
+            return prov
+        for fj in self._flagged_jits:
+            if fj.applied is True:
+                prov["applied"] = True
+            elif fj.applied is False and prov["applied"] is None:
+                prov["applied"] = False
+            if fj.fallback_error and not prov["fallback_error"]:
+                prov["fallback_error"] = fj.fallback_error
+        return prov
 
     # -- sharding helpers -------------------------------------------------
     @staticmethod
@@ -531,6 +571,34 @@ class StaticFunction:
         from ..observability import hlo_bytes
         stats = self.collective_stats()
         hlo_bytes.export_collective_bytes(stats)
+        return stats
+
+    def overlap_stats(self, **cost_kwargs):
+        """Schedule-level latency-hiding analysis of the most recent
+        entry (``observability.overlap``): pairs async collective
+        ``-start``/``-done`` ops with the compute scheduled between
+        them and prices hidden vs exposed collective time with a static
+        cost model, reporting ``collective_overlap_efficiency``,
+        ``exposed_collective_frac``, per-op splits, and the
+        ``backend_sync_schedule`` flag (XLA:CPU emits mostly-sync
+        schedules — efficiency 0.0 there is the honest baseline the
+        ``xla_flags`` latency-hiding A/B is judged against on real
+        hardware). Cost-model rates (``link_gbps``, ``hbm_gbps``,
+        ``peak_flops``) and ``per_execution`` pass through."""
+        from ..observability import overlap
+        return overlap.overlap_stats(self.hlo_text(), mesh=self._mesh(),
+                                     **cost_kwargs)
+
+    def export_overlap_stats(self, **cost_kwargs):
+        """Export :meth:`overlap_stats` onto the gauge board
+        (``collective_overlap_efficiency`` per program + per op-kind,
+        ``exposed_collective_ns_estimate{op=,axis=}``,
+        ``collective_async_pairs_total``/``collective_sync_total``) and
+        the active run-log; returns the stats."""
+        from ..observability import overlap
+        stats = self.overlap_stats(**cost_kwargs)
+        overlap.export_overlap_stats(
+            stats, program=getattr(self, "__name__", "fn"))
         return stats
 
     def memory_stats(self):
@@ -756,7 +824,7 @@ class StaticFunction:
                     [new_grads[i] for i in out_grad_idx])
 
         donate = (0, 1) if self._donate else ()
-        jitted = jax.jit(pure_fn2, donate_argnums=donate)
+        jitted = self._jit(pure_fn2, donate_argnums=donate)
 
         # introspection (tests / debugging): which state uids ended up where
         uids = [uid for uid, _ in state_items]
@@ -1102,9 +1170,9 @@ class StaticFunction:
                           rog_specs),
                 out_specs=(PartitionSpec(), cv_specs, cg_specs),
                 check_rep=False)
-            jitted = jax.jit(smapped, donate_argnums=donate)
+            jitted = self._jit(smapped, donate_argnums=donate)
         else:
-            jitted = jax.jit(pure_fn2, donate_argnums=donate)
+            jitted = self._jit(pure_fn2, donate_argnums=donate)
 
         uids = [uid for uid, _ in state_items]
         self._last_partition = {
@@ -1203,7 +1271,7 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               scan_steps=None, dp_axis=None, accumulate_steps=None,
-              **kwargs):
+              xla_flags=None, **kwargs):
     """Decorator / wrapper, usable as @to_static or to_static(fn).
 
     ``scan_steps=k`` compiles ``function`` (the single-step body) as a
@@ -1230,11 +1298,20 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     sharded per-bucket accumulator for ZeRO-2/3) and the window's last
     step fires one update over the 1/a-scaled accumulated gradients, so
     the reduce/update(/all_gather) collectives bill once per window
-    instead of once per step."""
+    instead of once per step.
+
+    ``xla_flags`` passes per-program XLA compiler options (a
+    ``jit.xla_flags`` preset name like ``"latency-hiding"``, a
+    ``"flag=value ..."`` string, or a dict; the
+    ``PADDLE_TPU_XLA_FLAGS`` env var overlays and wins). Flags a
+    backend doesn't register fall back to an unflagged compile with
+    provenance recorded — see ``StaticFunction.xla_flags()`` and
+    ``overlap_stats()`` for the A/B this knob exists for."""
     if function is None:
         return lambda fn: to_static(fn, input_spec=input_spec,
                                     scan_steps=scan_steps, dp_axis=dp_axis,
-                                    accumulate_steps=accumulate_steps)
+                                    accumulate_steps=accumulate_steps,
+                                    xla_flags=xla_flags)
     if isinstance(function, StaticFunction):
         return function
     # Layers: wrap forward, keep the layer object semantics
@@ -1244,12 +1321,14 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         static_forward = StaticFunction(layer.forward, input_spec,
                                         scan_steps=scan_steps,
                                         dp_axis=dp_axis,
-                                        accumulate_steps=accumulate_steps)
+                                        accumulate_steps=accumulate_steps,
+                                        xla_flags=xla_flags)
         layer.forward = static_forward
         return layer
     return StaticFunction(function, input_spec, scan_steps=scan_steps,
                           dp_axis=dp_axis,
-                          accumulate_steps=accumulate_steps)
+                          accumulate_steps=accumulate_steps,
+                          xla_flags=xla_flags)
 
 
 class InputSpec:

@@ -25,10 +25,11 @@ tokens/s / MFU / data-wait gauges into it. The perf gate:
 """
 from .. import profiler as _profiler
 from . import export, flight, gate, hlo_bytes, runlog, step  # noqa: F401
-from . import memory, tracing  # noqa: F401
+from . import memory, overlap, tracing  # noqa: F401
 from .gate import compare, load_results  # noqa: F401
 from .hlo_bytes import collective_stats, export_collective_bytes  # noqa: F401
 from .memory import state_ledger  # noqa: F401
+from .overlap import export_overlap_stats, overlap_stats  # noqa: F401
 from .runlog import start_run, stop_run  # noqa: F401
 from .step import StepTimer  # noqa: F401
 from .tracing import (CATEGORIES, attach_context, count,  # noqa: F401
@@ -39,10 +40,11 @@ __all__ = [
     "enable", "disable", "enabled", "trace_span", "current_span", "count",
     "CATEGORIES", "StepTimer", "export_chrome_trace",
     "collective_stats", "export_collective_bytes", "state_ledger",
+    "overlap_stats", "export_overlap_stats",
     "trace_context", "attach_context", "mint_context", "record_span",
     "start_run", "stop_run",
     "tracing", "export", "gate", "hlo_bytes", "step", "runlog", "flight",
-    "memory",
+    "memory", "overlap",
 ]
 
 

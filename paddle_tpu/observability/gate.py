@@ -34,16 +34,31 @@ DEFAULT_TOLERANCE = 0.10  # fractional noise allowance
 # time-like units and resource-footprint units both regress UPWARD
 _LOWER_BETTER_UNITS = {"ms", "s", "ns", "us", "MB", "MiB", "GB", "bytes"}
 
+# metric-name suffixes whose direction is part of the metric's meaning,
+# pinned here so every producer agrees without repeating "direction" in
+# each record: overlap efficiency (hidden/total) can only improve
+# upward; exposed collective fraction only downward. An explicit
+# per-record "direction" still outranks these.
+_HIGHER_BETTER_SUFFIXES = ("_overlap_efficiency",)
+_LOWER_BETTER_SUFFIXES = ("_exposed_collective_frac",)
+
 
 def higher_is_better(record):
     """Regression direction of one record: an explicit ``"direction":
     "lower"|"higher"`` pin wins (the memory rows pin ``lower`` — more
     resident bytes is a regression even though "MB" is not a time
-    unit); otherwise inferred from the unit — time-like and
-    byte-footprint units regress upward, rates/ratios downward."""
+    unit); then the metric-name suffix pins
+    (``*_overlap_efficiency`` up, ``*_exposed_collective_frac`` down);
+    otherwise inferred from the unit — time-like and byte-footprint
+    units regress upward, rates/ratios downward."""
     direction = record.get("direction")
     if direction in ("lower", "higher"):
         return direction == "higher"
+    name = record.get("metric", "")
+    if name.endswith(_HIGHER_BETTER_SUFFIXES):
+        return True
+    if name.endswith(_LOWER_BETTER_SUFFIXES):
+        return False
     return record.get("unit", "") not in _LOWER_BETTER_UNITS
 
 

@@ -24,7 +24,19 @@ dividing that by the window size.
 
 Axis attribution: HLO carries replica groups, not mesh axis names; a
 group size that matches exactly one axis of the active mesh gets that
-axis's name, anything ambiguous is labeled ``size<N>``.
+axis's name, anything ambiguous is labeled ``size<N>``. Both textual
+replica-group forms resolve identically: the explicit ``{{0,1},...}``
+list and the iota ``[groups,size]<=[dims]`` form (including the
+flattened single-group ``[N]<=[dims]`` print, whose one group spans all
+N participants).
+
+Async collectives: an ``<op>-start`` line carries the payload (its
+result tuple repeats the operand buffer next to the full result, which
+is why ``-start`` measures the LARGEST shape instead of the sum) and is
+billed exactly once per pair; the matching ``<op>-done`` line never
+matches :data:`_OP_RE` — the op name must be immediately followed by
+``(`` or ``-start(``, and ``-done(`` is neither. The async regression
+fixture in tests/test_overlap.py pins both properties.
 """
 import re
 
@@ -42,6 +54,10 @@ _DTYPE_BYTES = {
 }
 
 _SHAPE_RE = re.compile(r"\b([a-z]+[0-9]+|pred)\[([0-9,]*)\]")
+# `(` must IMMEDIATELY follow the op name (or its `-start` suffix):
+# that adjacency is what keeps `-done` lines out — `all-gather-done(`
+# has `-done` between the op name and the paren, so an async pair bills
+# its bytes exactly once, on the -start line
 _OP_RE = re.compile(
     r"=\s+(.*?)\s+(" + "|".join(COLLECTIVE_HLO_OPS) + r")(-start)?\(")
 _GROUPS_RE = re.compile(r"replica_groups=(\{\{[^}]*\}[^}]*\}|\[[0-9,]+\]"
@@ -77,9 +93,16 @@ def _group_size(line):
     if text.startswith("{"):
         first = text[2:].split("}", 1)[0]
         return len([x for x in first.split(",") if x.strip() != ""])
-    # iota form [g0,g1,...]<=[n]: first dim is the group count, the rest
-    # multiply out to the group size
+    # iota form [groups,size,...]<=[dims]: the first dim of the group-list
+    # shape is the group count, the rest multiply out to the group size.
+    # The flattened single-group print `[N]<=[dims]` (rank-1 shape: every
+    # participant in ONE group — what `{{0,...,N-1}}` renders as in iota
+    # form) has no trailing dims; its group size is N itself, not 1 —
+    # treating it as 1 is what used to mislabel shapes the `{{...}}`
+    # parser resolves fine.
     dims = [int(x) for x in text[1:].split("]", 1)[0].split(",")]
+    if len(dims) == 1:
+        return dims[0]
     size = 1
     for d in dims[1:]:
         size *= d

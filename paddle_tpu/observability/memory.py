@@ -41,6 +41,7 @@ __all__ = ["program_stats", "peak_bytes", "top_buffers",
            "record_program_memory", "program_memory",
            "export_program_memory", "snapshot", "runlog_snapshot",
            "flight_section", "is_oom_error", "attribute_program",
+           "compile_program_twin",
            "MemoryAttributionError", "MEMORY_KINDS", "STATE_CATEGORIES"]
 
 # the CompiledMemoryStats fields exported as program_hbm_bytes{kind=}
@@ -442,14 +443,14 @@ def is_oom_error(exc):
 
 # -- static-Program attribution (ladder / mem_view) ------------------------
 
-def attribute_program(prog, targets, bump=0):
-    """Memory attribution of a recorded ``static.Program``: compile the
-    program's pure function on abstract (ShapeDtypeStruct) feeds/params
-    — no real buffers — and return :func:`program_stats` of the
-    executable. Raises :class:`MemoryAttributionError` when the program
-    fails to compile or the backend yields no analysis; ladder
-    verification surfaces that as an error finding, refusing the
-    ladder the same way a verify failure does."""
+def compile_program_twin(prog, targets, bump=0):
+    """AOT-compile a recorded ``static.Program``'s pure function on
+    abstract (ShapeDtypeStruct) feeds/params — no real buffers — and
+    return the compiled executable. The shared front half of every
+    attribution pass over program twins (memory here,
+    ``observability.overlap`` for schedule analysis). Raises
+    :class:`MemoryAttributionError` when the program fails to
+    compile."""
     import jax
 
     from ..core.dtype import convert_dtype
@@ -478,14 +479,24 @@ def attribute_program(prog, targets, bump=0):
         params.append(jax.ShapeDtypeStruct(tuple(np.shape(v)),
                                            np.dtype(v.dtype)))
     try:
-        compiled = jax.jit(run).lower(feeds, params).compile()
+        return jax.jit(run).lower(feeds, params).compile()
     except MemoryAttributionError:
         raise
     except Exception as e:
         raise MemoryAttributionError(
             f"program failed to AOT-compile for attribution: "
             f"{str(e)[:300]}") from e
-    return program_stats(compiled)
+
+
+def attribute_program(prog, targets, bump=0):
+    """Memory attribution of a recorded ``static.Program``: compile the
+    program's pure function on abstract feeds via
+    :func:`compile_program_twin` and return :func:`program_stats` of
+    the executable. Raises :class:`MemoryAttributionError` when the
+    program fails to compile or the backend yields no analysis; ladder
+    verification surfaces that as an error finding, refusing the
+    ladder the same way a verify failure does."""
+    return program_stats(compile_program_twin(prog, targets, bump=bump))
 
 
 _MB = 1024 * 1024

@@ -82,12 +82,27 @@ def main(argv=None):
                     findings.append(Finding(
                         "memory-attribution-failed", ERROR,
                         f"[{name}] program {pi}: {s['error']}"))
+        # overlap attribution rides the same contract: a verified twin
+        # whose schedule cannot be parsed/priced refuses the ladder
+        overlap_attr = ladder.attribute_overlap(programs=programs)
+        for name, rows in sorted(overlap_attr.items()):
+            for pi, s in enumerate(rows):
+                if "error" in s:
+                    findings.append(Finding(
+                        "overlap-attribution-failed", ERROR,
+                        f"[{name}] program {pi}: {s['error']}"))
         for name, op_counts in sorted(summary.items()):
             peaks = [("err" if "error" in s
                       else f"{mem.mb(s['peak_bytes']):g}MB")
                      for s in attribution.get(name, [])]
+            overlaps = [("err" if "error" in s
+                         else "none" if not (s["sync_total"]
+                                             + s["async_pairs_total"])
+                         else f"{s['collective_overlap_efficiency']:.2f}")
+                        for s in overlap_attr.get(name, [])]
             print(f"ladder[{name}]: {len(op_counts)} program(s), "
-                  f"ops={op_counts}, hbm_peak={peaks}")
+                  f"ops={op_counts}, hbm_peak={peaks}, "
+                  f"overlap={overlaps}")
     if run_source:
         from paddle_tpu.analysis import lint_source
         findings.extend(lint_source(paths=args.source or None))

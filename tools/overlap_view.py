@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""Collective overlap viewer: text-Gantt schedule timelines + the flag
+A/B diff over ``observability.overlap``.
+
+Renders per-program hidden/exposed collective time from the compiled
+schedule — each collective a bar (``#`` hidden behind scheduled
+compute, ``=`` exposed), in schedule order per computation — plus the
+summary gauges (``collective_overlap_efficiency``, exposed fraction,
+async-pair vs sync counts). With a ``jax.profiler`` trace directory it
+correlates the schedule ESTIMATE against measured collective span
+wall-times from the trace.
+
+Sources (pick one):
+
+    # attribute the benchmark ladder's verified program twins
+    python tools/overlap_view.py --ladder [--configs zero3,allreduce]
+
+    # analyze a compiled HLO dump (e.g. StaticFunction.hlo_text())
+    python tools/overlap_view.py --hlo step.hlo
+
+    # flag A/B: efficiency / exposed-time deltas between two captures
+    # (the latency-hiding-scheduler on-vs-off evidence view)
+    python tools/overlap_view.py --diff off.json on.json
+
+    # record a capture for a later --diff
+    python tools/overlap_view.py --ladder --out off.json
+
+    # correlate against measured spans from jax.profiler.trace(dir)
+    python tools/overlap_view.py --hlo step.hlo --trace /tmp/prof
+
+Exit codes: 0 ok, 1 usage/attribution error.
+"""
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BAR_WIDTH = 32
+
+SUMMARY_KEYS = ("collective_overlap_efficiency", "exposed_collective_frac",
+                "hidden_ns", "exposed_ns", "collective_ns",
+                "async_pairs_total", "sync_total", "backend_sync_schedule")
+
+
+def _render(rows):
+    """Column-aligned ASCII table; first row is the header."""
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = []
+    for i, r in enumerate(rows):
+        lines.append("  ".join(c.ljust(w)
+                               for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def _us(ns):
+    return f"{ns / 1e3:.2f}us"
+
+
+def format_gantt(stats, label=""):
+    """Text Gantt of one program's collective spans, schedule order per
+    computation: bar length ~ estimated collective time, ``#`` the
+    portion hidden behind compute scheduled inside the async pair,
+    ``=`` the exposed remainder. Sync collectives are all ``=`` by
+    construction."""
+    pairs = sorted(stats.get("pairs", []),
+                   key=lambda p: (p["computation"], p["index"]))
+    lines = []
+    head = f"schedule timeline{' ' + label if label else ''}: " \
+           f"efficiency {stats['collective_overlap_efficiency']:.3f}, " \
+           f"exposed {_us(stats['exposed_ns'])} of " \
+           f"{_us(stats['collective_ns'])} collective " \
+           f"({stats['async_pairs_total']} async pair(s), " \
+           f"{stats['sync_total']} sync)"
+    lines.append(head)
+    if stats.get("backend_sync_schedule"):
+        lines.append("  NOTE: fully synchronous schedule — this backend "
+                     "(XLA:CPU) emits no async collective pairs; the "
+                     "efficiency 0.0 is the honest baseline, not an "
+                     "analyzer failure")
+    if not pairs:
+        lines.append("  (no collectives in this program)")
+        return "\n".join(lines)
+    scale = max(p["collective_ns"] for p in pairs) or 1.0
+    comp = None
+    name_w = max(len(p["name"]) for p in pairs)
+    for p in pairs:
+        if p["computation"] != comp:
+            comp = p["computation"]
+            lines.append(f"  %{comp}:")
+        n = max(1, int(round(BAR_WIDTH * p["collective_ns"] / scale)))
+        hidden_cells = int(round(n * (p["hidden_ns"] / p["collective_ns"]))
+                           ) if p["collective_ns"] else 0
+        bar = "#" * hidden_cells + "=" * (n - hidden_cells)
+        detail = (f"hidden {_us(p['hidden_ns'])} / exposed "
+                  f"{_us(p['exposed_ns'])}" if p["phase"] == "async"
+                  else f"exposed {_us(p['exposed_ns'])}")
+        mult = f" x{p['count']}" if p["count"] != 1 else ""
+        lines.append(f"    {p['name'].ljust(name_w)} "
+                     f"[{bar.ljust(BAR_WIDTH)}] {p['op']}@{p['axis']} "
+                     f"{detail} ({p['phase']}){mult}")
+    return "\n".join(lines)
+
+
+def format_program_table(programs):
+    """Summary table over ``{entry: stats}``; ``"error"`` records render
+    as ERR rows (an unattributable twin must stay visible)."""
+    rows = [["entry", "efficiency", "exposed_frac", "exposed_us",
+             "async", "sync", "sync_schedule"]]
+    for entry in sorted(programs):
+        s = programs[entry]
+        if "error" in s:
+            rows.append([entry, "ERR: " + str(s["error"])[:60],
+                         "", "", "", "", ""])
+            continue
+        rows.append([entry,
+                     f"{s['collective_overlap_efficiency']:.3f}",
+                     f"{s['exposed_collective_frac']:.3f}",
+                     f"{s['exposed_ns'] / 1e3:.2f}",
+                     str(s["async_pairs_total"]), str(s["sync_total"]),
+                     "yes" if s.get("backend_sync_schedule") else "no"])
+    return _render(rows)
+
+
+def format_program_diff(progs_a, progs_b):
+    """Per-entry flag A/B deltas (B minus A): efficiency up and exposed
+    time down is the latency-hiding win; entries on one side only diff
+    against zero."""
+    rows = [["entry", "eff(A)", "eff(B)", "d_eff", "exposed_us(A)",
+             "exposed_us(B)", "d_exposed_us", "async(A->B)"]]
+    for entry in sorted(set(progs_a) | set(progs_b)):
+        a = progs_a.get(entry, {})
+        b = progs_b.get(entry, {})
+        if "error" in a or "error" in b:
+            rows.append([entry, "ERR", "ERR", "", "", "", "", ""])
+            continue
+        ea = a.get("collective_overlap_efficiency", 0.0)
+        eb = b.get("collective_overlap_efficiency", 0.0)
+        xa = a.get("exposed_ns", 0.0) / 1e3
+        xb = b.get("exposed_ns", 0.0) / 1e3
+        rows.append([entry, f"{ea:.3f}", f"{eb:.3f}", f"{eb - ea:+.3f}",
+                     f"{xa:.2f}", f"{xb:.2f}", f"{xb - xa:+.2f}",
+                     f"{a.get('async_pairs_total', 0)}->"
+                     f"{b.get('async_pairs_total', 0)}"])
+    return _render(rows)
+
+
+_COLLECTIVE_NAMES = ("all-gather", "all-reduce", "reduce-scatter",
+                     "all-to-all", "collective-permute")
+
+
+def correlate_trace(trace_dir, stats):
+    """Best-effort correlation of the schedule ESTIMATE against
+    measured collective span wall-times from a ``jax.profiler.trace``
+    directory (``**/*.trace.json.gz`` chrome-trace shards): sums the
+    ``dur`` of complete events whose names carry a collective op
+    substring. Returns ``{"measured_collective_ns", "events",
+    "estimate_collective_ns", "measured_over_estimate"}`` or ``None``
+    when the directory holds no usable trace."""
+    shards = sorted(glob.glob(os.path.join(trace_dir, "**",
+                                           "*.trace.json.gz"),
+                              recursive=True))
+    shards += sorted(glob.glob(os.path.join(trace_dir, "**",
+                                            "*.trace.json"),
+                               recursive=True))
+    measured_us = 0.0
+    n_events = 0
+    for shard in shards:
+        try:
+            opener = gzip.open if shard.endswith(".gz") else open
+            with opener(shard, "rt") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for ev in data.get("traceEvents", []):
+            name = str(ev.get("name", "")).lower()
+            if ev.get("dur") is None:
+                continue
+            if any(op in name for op in _COLLECTIVE_NAMES):
+                measured_us += float(ev["dur"])
+                n_events += 1
+    if not n_events:
+        return None
+    measured_ns = measured_us * 1e3
+    est = stats["collective_ns"]
+    return {"measured_collective_ns": measured_ns, "events": n_events,
+            "estimate_collective_ns": est,
+            "measured_over_estimate": (measured_ns / est) if est
+            else None}
+
+
+def _ladder_programs(configs):
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # twins are smoke-scale
+    from paddle_tpu.analysis import ladder
+    out = {}
+    for name, rows in ladder.attribute_overlap(configs=configs).items():
+        for pi, stats in enumerate(rows):
+            label = name if len(rows) == 1 else f"{name}#{pi}"
+            out[label] = stats
+    return out
+
+
+def _capture_programs(path):
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("programs", data if isinstance(data, dict) else {})
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render collective overlap schedule timelines; "
+                    "--diff compares two captures (flag A/B)")
+    ap.add_argument("--ladder", action="store_true",
+                    help="attribute the benchmark ladder's program twins")
+    ap.add_argument("--configs", default=None,
+                    help="comma list of ladder configs (default: all)")
+    ap.add_argument("--hlo", metavar="FILE",
+                    help="analyze a compiled HLO text dump")
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"),
+                    help="per-entry efficiency/exposed deltas (B minus "
+                    "A) between two captures — the flag on/off view")
+    ap.add_argument("--out", metavar="JSON",
+                    help="write the analyzed programs as a capture "
+                    "(feed a later --diff)")
+    ap.add_argument("--trace", metavar="DIR",
+                    help="jax.profiler trace directory to correlate "
+                    "measured collective span wall-times against the "
+                    "schedule estimate")
+    ap.add_argument("--gantt", action="store_true",
+                    help="also render the per-collective schedule "
+                    "timeline for every entry (default for --hlo)")
+    args = ap.parse_args(argv)
+
+    sources = [bool(args.ladder), bool(args.hlo), bool(args.diff)]
+    if sum(sources) != 1:
+        ap.error("pick exactly one source: --ladder, --hlo FILE, or "
+                 "--diff A.json B.json")
+
+    if args.diff:
+        if args.out:
+            ap.error("--out records a single capture; it does not "
+                     "combine with --diff")
+        progs_a = _capture_programs(args.diff[0])
+        progs_b = _capture_programs(args.diff[1])
+        print(f"overlap deltas (B={args.diff[1]} minus A={args.diff[0]}):")
+        if progs_a or progs_b:
+            print(format_program_diff(progs_a, progs_b))
+        else:
+            print("no overlap attributions on either side")
+        return 1 if any("error" in s for s in
+                        list(progs_a.values()) + list(progs_b.values())) \
+            else 0
+
+    if args.hlo:
+        from paddle_tpu.observability import overlap
+        with open(args.hlo) as f:
+            stats = overlap.overlap_stats(f.read())
+        programs = {os.path.basename(args.hlo): stats}
+        gantt = True
+    else:
+        configs = args.configs.split(",") if args.configs else None
+        programs = _ladder_programs(configs)
+        gantt = args.gantt
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"programs": programs}, f, indent=1)
+
+    if programs:
+        print(format_program_table(programs))
+    else:
+        print("no programs in this source")
+    if gantt:
+        for entry in sorted(programs):
+            if "error" in programs[entry]:
+                continue
+            print()
+            print(format_gantt(programs[entry], label=entry))
+
+    if args.trace:
+        total = {"collective_ns": sum(
+            s.get("collective_ns", 0.0) for s in programs.values()
+            if "error" not in s)}
+        corr = correlate_trace(args.trace, total)
+        print()
+        if corr is None:
+            print(f"trace correlation: no collective spans found under "
+                  f"{args.trace} (no *.trace.json[.gz] shards, or the "
+                  f"profile carries no collective events)")
+        else:
+            ratio = corr["measured_over_estimate"]
+            print(f"trace correlation: measured collective wall-time "
+                  f"{_us(corr['measured_collective_ns'])} over "
+                  f"{corr['events']} span(s) vs schedule estimate "
+                  f"{_us(corr['estimate_collective_ns'])}"
+                  + (f" (measured/estimate {ratio:.2f}x)"
+                     if ratio is not None else ""))
+
+    return 1 if any("error" in s for s in programs.values()) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

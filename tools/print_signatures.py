@@ -24,6 +24,7 @@ MODULES = [
     "paddle_tpu.optimizer.lr",
     "paddle_tpu.static",
     "paddle_tpu.jit",
+    "paddle_tpu.jit.xla_flags",
     "paddle_tpu.analysis",
     "paddle_tpu.analysis.concurrency",
     "paddle_tpu.analysis.lockwatch",
@@ -43,6 +44,7 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.observability",
     "paddle_tpu.observability.memory",
+    "paddle_tpu.observability.overlap",
     "paddle_tpu.recompute",
     "paddle_tpu.serving",
     "paddle_tpu.checkpoint",
