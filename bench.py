@@ -57,6 +57,17 @@ def main(argv=None):
                          "PARAMETERS 1/dp: per-bucket all_gather "
                          "materializes them just-in-time before forward "
                          "and the update writes only shard rows")
+    ap.add_argument("--prefetch", default="on", choices=("on", "off"),
+                    help="latency-hiding ZeRO step (default on): "
+                         "double-buffered bucket pipeline — next "
+                         "bucket's param all_gather is emitted under "
+                         "the current bucket's compute, grad "
+                         "reduce-scatter under the next bucket's "
+                         "update, and the step tail re-gathers bucket "
+                         "0 into a carry slot so the next step starts "
+                         "warm. 'off' keeps the on-demand serial "
+                         "schedule (the A/B control; bitwise-equal "
+                         "losses either way)")
     ap.add_argument("--accumulate", type=int, default=1,
                     help="gradient-accumulation window: group the k "
                          "inner steps into k/N windows, optimizer "
@@ -129,9 +140,11 @@ def main(argv=None):
                                  learning_rate=1e-4,
                                  multi_precision=on_tpu)
     if args_cli.zero:
-        n_sharded = opt._zero_enable(axis="dp", stage=args_cli.zero)
+        n_sharded = opt._zero_enable(axis="dp", stage=args_cli.zero,
+                                     prefetch=args_cli.prefetch == "on")
         print(f"# zero{args_cli.zero}: dp={dp} sharded_stores={n_sharded} "
-              f"state_bytes/chip={opt._zero_state_bytes()}",
+              f"state_bytes/chip={opt._zero_state_bytes()} "
+              f"prefetch={args_cli.prefetch}",
               file=sys.stderr)
     params = list(model.parameters())
 
@@ -277,6 +290,19 @@ def main(argv=None):
             print(f"# per-execution collectives: {top}", file=sys.stderr)
         except Exception as e:  # stats are evidence, never a bench failure
             print(f"# in-trace collectives unavailable: {e}",
+                  file=sys.stderr)
+    if args_cli.zero:
+        # the --prefetch A/B's structural evidence: emission-order
+        # overlap headroom from the traced jaxpr (backend-independent —
+        # the number the mlp_zero3_schedulable_overlap row gates)
+        try:
+            sched = step.schedulable_stats()
+            print(f"# schedulable overlap: "
+                  f"{sched['schedulable_overlap']:.4f} "
+                  f"(prefetch={args_cli.prefetch}, "
+                  f"source={sched['source']})", file=sys.stderr)
+        except Exception as e:
+            print(f"# schedulable overlap unavailable: {e}",
                   file=sys.stderr)
 
 

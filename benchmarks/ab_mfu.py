@@ -27,6 +27,21 @@ Measured history on the shared v5e (for future rounds — don't re-try losers):
   owns that fusion. Don't retry.
 - r4 winners: k20 (+2.2% over k16) and pure-bf16 params + fp32 masters
   (+0.5%); combined 0.511 -> 0.525 MFU back-to-back.
+- r9 (CPU-small, 8-dev host mesh — no TPU attached to the builder):
+  latency-hiding ZeRO step (scan_k*_zero3_prefetch vs _noprefetch,
+  bench.py --prefetch): double-buffered bucket pipeline — prefetch
+  all_gather of bucket i+1 emitted under bucket i's compute, grad
+  reduce-scatter drained under the NEXT bucket's update, tail re-gather
+  of bucket 0 warm-starts the next step via a donated carry slot.
+  Structural evidence on the host mesh: schedulable-overlap score
+  0.3096 vs 0.0 serial on the layer-aligned MLP config
+  (mlp_zero3_schedulable_overlap row), losses bitwise-equal both arms,
+  per-execution collective counts/bytes unchanged, traced peak +1 bucket
+  exactly (the carry slot). CPU's sequential HLO executor can't CASH the
+  overlap — steady-state MFU rows for scan_k20_bf16_zero3_prefetch vs
+  _noprefetch still NEED a multichip TPU runner (expected win scales
+  with bucket count x collective exposure; pair with the
+  latency-hiding xla_flags preset that scan bodies now default to).
 - r8 (CPU-small, 8-dev host mesh — no TPU attached to the builder):
   ZeRO-3 (scan_k*_zero3, bench.py --zero 3) shards the PARAMETERS 1/dp on
   top of the zero1/2 state sharding: per-bucket all_gather materializes
@@ -71,7 +86,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
-               scan=False, zero=0, accumulate=1):
+               scan=False, zero=0, accumulate=1, prefetch=None):
     """The flagship program, identical to bench.py: k training steps per
     compiled program, optimization_barrier between backward and AdamW.
     Returns (step_fn, args, model) with step_fn compiled via to_static.
@@ -92,7 +107,13 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
 
     accumulate: gradient-accumulation window — group the k inner steps
     into k/accumulate windows with one optimizer update (and one
-    reduce/all_gather round for zero<=1) each (implies scan)."""
+    reduce/all_gather round for zero<=1) each (implies scan).
+
+    prefetch: the latency-hiding ZeRO step (None = the optimizer's
+    default, True/False explicit): double-buffered bucket pipeline —
+    next bucket's all_gather emitted under this bucket's compute, grad
+    reduce-scatter under the next bucket's update, tail re-gather of
+    bucket 0 into the carry slot for the next step's warm start."""
     import numpy as np
 
     import jax
@@ -122,7 +143,7 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
                                  learning_rate=1e-4,
                                  multi_precision=pure_bf16)
     if zero:
-        opt._zero_enable(axis="dp", stage=zero)
+        opt._zero_enable(axis="dp", stage=zero, prefetch=prefetch)
     params = list(model.parameters())
 
     def one_step(ids, tok, labels, nsp_labels):
@@ -163,12 +184,13 @@ def build_step(k=16, batch=16, seq=512, pure_bf16=False, white=(),
 
 def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2,
                 pure_bf16=False, white=(), scan=False, zero=0,
-                accumulate=1):
+                accumulate=1, prefetch=None):
     seq = 512
     step, args, model = build_step(k=k, batch=batch, seq=seq,
                                    pure_bf16=pure_bf16, white=white,
                                    scan=scan, zero=zero,
-                                   accumulate=accumulate)
+                                   accumulate=accumulate,
+                                   prefetch=prefetch)
     last = (lambda l: l[-1]) if scan else (lambda l: l)
     t_compile = time.perf_counter()
     for _ in range(warmup):
@@ -191,11 +213,13 @@ def run_variant(name, k=16, batch=16, iters=1, warmup=1, windows=2,
 
 
 def parse_spec(spec):
-    """'[scan_]k<N>[_b<N>][_bf16][_wsm][_wln][_zero<S>][_acc<N>]' ->
-    run_variant kwargs (e.g. scan_k20_bf16_zero3,
-    scan_k20_bf16_zero1_acc4)."""
+    """'[scan_]k<N>[_b<N>][_bf16][_wsm][_wln][_zero<S>][_acc<N>]
+    [_prefetch|_noprefetch]' -> run_variant kwargs (e.g.
+    scan_k20_bf16_zero3_prefetch vs scan_k20_bf16_zero3_noprefetch —
+    the latency-hiding pipeline A/B; bare zero3 takes the optimizer's
+    default, which is prefetch on)."""
     kw = {"k": 16, "batch": 16, "pure_bf16": False, "scan": False,
-          "zero": 0, "accumulate": 1}
+          "zero": 0, "accumulate": 1, "prefetch": None}
     white = []
     for part in spec.split("_"):
         if part == "scan":
@@ -203,6 +227,10 @@ def parse_spec(spec):
         elif part in ("zero1", "zero2", "zero3"):
             kw["zero"] = int(part[-1])
             kw["scan"] = True
+        elif part == "prefetch":
+            kw["prefetch"] = True
+        elif part == "noprefetch":
+            kw["prefetch"] = False
         elif part.startswith("acc") and part[3:].isdigit():
             kw["accumulate"] = int(part[3:])
             kw["scan"] = True

@@ -14,7 +14,7 @@ axis names no active mesh defines.
 from .findings import ERROR, WARNING, Finding
 
 __all__ = ["COLLECTIVE_OPS", "collective_sequence", "check_collectives",
-           "check_collective_order"]
+           "check_collective_order", "sequence_overlap_score"]
 
 # op_name values distributed/collective.py records through call_op
 COLLECTIVE_OPS = frozenset({
@@ -40,6 +40,47 @@ def collective_sequence(prog):
              getattr(op.fn, "_collective_nbytes", None),
              getattr(op.fn, "_collective_every", None))
             for i, op in enumerate(prog.ops) if op.name in COLLECTIVE_OPS]
+
+
+def sequence_overlap_score(prog):
+    """Record-level schedulable-overlap score of a program's collective
+    sequence — the ladder-twin counterpart of ``observability.overlap
+    .schedulable_stats`` (twin collectives are identity stand-ins that
+    never lower to HLO collective ops, so the compiled-schedule analyzer
+    honestly reports nothing for them; this reads the recorded op stream
+    instead). A collective is *schedulable* when at least one
+    non-collective op sits between its emission and its first consumer —
+    the emission-order slack a latency-hiding scheduler needs (the
+    prefetch-pipelined ZeRO twin emits bucket i+1's all-gather under
+    bucket i's compute; the serial twin's gather is consumer-adjacent).
+    Returns ``{"schedulable_overlap": payload-weighted frac,
+    "collective_bytes", "schedulable_bytes", "per_collective": [...]}``
+    with unstamped payloads weighted 1 byte. A collective nothing in the
+    program consumes (the tail re-gather feeding only the next step's
+    carry) scores 0 here: cross-step hiding is real but a single
+    recorded program cannot show it."""
+    from .verifier import in_slots
+
+    seq = collective_sequence(prog)
+    per = []
+    total = sched = 0
+    coll_idx = {i for i, _n, _a, _b, _e in seq}
+    for i, name, ax, nbytes, _every in seq:
+        weight = nbytes if nbytes else 1
+        outs = set(prog.ops[i].out_slots)
+        consumer = next((j for j in range(i + 1, len(prog.ops))
+                         if outs & set(in_slots(prog.ops[j]))), None)
+        between = [j for j in range(i + 1, consumer)
+                   if j not in coll_idx] if consumer is not None else []
+        total += weight
+        sched += weight if between else 0
+        per.append({"op_index": i, "op_name": name, "axis": ax,
+                    "nbytes": nbytes, "first_consumer": consumer,
+                    "compute_between": len(between),
+                    "schedulable": bool(between)})
+    return {"schedulable_overlap": sched / total if total else 0.0,
+            "collective_bytes": total, "schedulable_bytes": sched,
+            "per_collective": per}
 
 
 def _mesh_axes():
@@ -83,8 +124,9 @@ def check_collective_order(programs, mesh_axes=None):
     seqs = [collective_sequence(p) for p in programs]
     ref = seqs[0]
     for r, seq in enumerate(seqs[1:], start=1):
+        local = []
         if len(seq) != len(ref):
-            findings.append(Finding(
+            local.append(Finding(
                 "collective-order-mismatch", ERROR,
                 f"rank {r} issues {len(seq)} collectives but rank 0 "
                 f"issues {len(ref)} — the mesh deadlocks at the first "
@@ -92,7 +134,7 @@ def check_collective_order(programs, mesh_axes=None):
         for k, ((_, n0, a0, b0, e0), (_, n1, a1, b1, e1)) in enumerate(
                 zip(ref, seq)):
             if n0 != n1 or a0 != a1:
-                findings.append(Finding(
+                local.append(Finding(
                     "collective-order-mismatch", ERROR,
                     f"position {k}: rank 0 issues {n0}(axis={a0!r}) but "
                     f"rank {r} issues {n1}(axis={a1!r}) — mismatched "
@@ -104,7 +146,7 @@ def check_collective_order(programs, mesh_axes=None):
                 # skew (one blocks every step, the other once per
                 # window), while matching stamps let a per-window
                 # schedule verify clean instead of reading as divergence
-                findings.append(Finding(
+                local.append(Finding(
                     "collective-cadence-mismatch", ERROR,
                     f"position {k}: rank 0 fires {n0}(axis={a0!r}) every "
                     f"{e0} step(s) but rank {r} every {e1} — a per-step "
@@ -113,7 +155,7 @@ def check_collective_order(programs, mesh_axes=None):
                     "the mesh deadlocks inside the first window",
                     op_index=seq[k][0], op_name=n1))
             elif b0 is not None and b1 is not None and b0 != b1:
-                findings.append(Finding(
+                local.append(Finding(
                     "collective-order-mismatch", ERROR,
                     f"position {k}: rank 0's {n0}(axis={a0!r}) carries "
                     f"{b0} bytes but rank {r}'s carries {b1} — the ranks "
@@ -121,6 +163,27 @@ def check_collective_order(programs, mesh_axes=None):
                     "different payload cross-matches on the wire: data "
                     "corruption or a hang)",
                     op_index=seq[k][0], op_name=n1))
+        if local and len(seq) == len(ref) and (
+                sorted(repr(s[1:]) for s in seq)
+                == sorted(repr(s[1:]) for s in ref)):
+            # the ranks issue the SAME collectives (op kind, axis,
+            # payload, cadence all match as a multiset) in a different
+            # ORDER — a deterministic schedule reorder, the signature of
+            # the latency-hiding ZeRO prefetch pipeline compiled on one
+            # rank but not the other. Collapse the positional noise into
+            # one precise diagnosis; it is still an ERROR (the wire
+            # cross-matches mismatched positions and deadlocks) — every
+            # rank must compile with the same prefetch setting, and when
+            # they do the identical pipelined sequence verifies clean.
+            local = [Finding(
+                "collective-schedule-skew", ERROR,
+                f"rank {r} issues the same {len(seq)} collectives as "
+                "rank 0 in a different order — a deterministic schedule "
+                "reorder (e.g. the ZeRO prefetch pipeline enabled on one "
+                "rank only); reordered positions still cross-match on "
+                "the wire and deadlock, so every rank must compile with "
+                "the same schedule")]
+        findings.extend(local)
     for r, p in enumerate(programs):
         for f in check_collectives(p, mesh_axes=mesh_axes):
             f.message = f"rank {r}: {f.message}"

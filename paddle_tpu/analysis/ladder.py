@@ -219,6 +219,57 @@ def _zero3_ranks():
     return pairs
 
 
+def _zero3_prefetch_ranks():
+    """Two per-rank programs with the latency-hiding ZeRO-3 schedule —
+    the double-buffered prefetch pipeline's recorded twin. Bucket 0's
+    params arrive warm in the carry slot (no leading gather — the
+    previous step's tail re-gather filled it), bucket 1's all-gather is
+    emitted BEFORE bucket 0's compute consumes the slot, each bucket's
+    grad reduce-scatter drains under downstream compute, and the tail
+    re-gather of the updated bucket-0 shard warms the next step. The
+    reorder is deterministic and identical across ranks, so the order
+    checker accepts it (tests seed the serial-vs-pipelined mixed-rank
+    skew it must still reject), and ``collectives
+    .sequence_overlap_score`` reads every stamped payload as
+    schedulable — the record-level counterpart of the traced step's
+    ``schedulable_stats`` score."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.core.dispatch import call_op
+
+    def _stamped(op_name, nbytes):
+        def fn(*vs):
+            return vs[0]
+        fn._collective_axis = "dp"
+        fn._collective_nbytes = nbytes
+        fn._collective_every = 1
+        return lambda *vs: call_op(fn, *vs, op_name=op_name)
+
+    pairs = []
+    for _rank in range(2):
+        prog = static.Program()
+        with static.program_guard(prog):
+            slot0 = static.data("prefetch_slot_b0", [8, 16], "float32")
+            pshard1 = static.data("param_shard_b1", [2, 16], "float32")
+            g0 = static.data("grad_b0", [8, 16], "float32")
+            g1 = static.data("grad_b1", [8, 16], "float32")
+            # prefetch: bucket 1 gathers while bucket 0 computes
+            full1 = _stamped("c_allgather", 8 * 16 * 4)(pshard1)
+            h0 = paddle.matmul(slot0, paddle.transpose(slot0, [1, 0]))
+            # deferred rs: bucket 0's reduction drains under bucket 1
+            gs0 = _stamped("c_reducescatter", 8 * 16 * 4)(g0)
+            h1 = paddle.matmul(full1, paddle.transpose(full1, [1, 0]))
+            gs1 = _stamped("c_reducescatter", 8 * 16 * 4)(g1)
+            upd0 = paddle.add(slot0[:2], paddle.scale(gs0[:2], -0.01))
+            upd1 = paddle.add(pshard1, paddle.scale(gs1[:2], -0.01))
+            # tail re-gather: warm the next step's bucket-0 slot
+            nxt = _stamped("c_allgather", 8 * 16 * 4)(upd0)
+            loss = paddle.sum(h0) + paddle.sum(h1) + paddle.sum(nxt) \
+                + paddle.sum(upd1)
+        pairs.append((prog, [loss]))
+    return pairs
+
+
 def _remat_like():
     """Activation-recompute structures, both representations:
 
@@ -333,6 +384,7 @@ LADDER_BUILDERS = {
     "allreduce": _allreduce_ranks,
     "zero1": _zero1_ranks,
     "zero3": _zero3_ranks,
+    "zero3_prefetch": _zero3_prefetch_ranks,
 }
 
 
@@ -385,7 +437,7 @@ def verify_ladder(configs=None, mesh_axes=("dp",), memory=True,
                     _tag(name, [Finding(
                         "memory-attribution-failed", ERROR,
                         f"program {pi}: {e}")])
-        if name in ("allreduce", "zero1", "zero3"):
+        if name in ("allreduce", "zero1", "zero3", "zero3_prefetch"):
             _tag(name, check_collective_order([p for p, _t in pairs],
                                               mesh_axes=mesh_axes))
     return findings, summary
@@ -423,7 +475,14 @@ def attribute_overlap(configs=None, programs=None):
     --ladder``. The twins' stand-in collectives are identity ops, so
     their compiled HLO honestly reports zero collective time on the
     smoke mesh; what this pass certifies is that every verified twin's
-    schedule *parses and prices* without error."""
+    schedule *parses and prices* without error. Every row additionally
+    carries ``"sequence_schedulable"`` — the record-level
+    schedulable-overlap score (``analysis.collectives
+    .sequence_overlap_score``) computed from the stamped collective
+    sequence itself, which DOES discriminate on the smoke mesh: the
+    serial zero3 twin's consumer-adjacent gather scores below the
+    prefetch-pipelined twin's 1.0."""
+    from .collectives import sequence_overlap_score
     from ..observability.memory import MemoryAttributionError
     from ..observability.overlap import attribute_program as _overlap
 
@@ -434,8 +493,11 @@ def attribute_overlap(configs=None, programs=None):
         rows = []
         for prog, targets in pairs:
             try:
-                rows.append(_overlap(prog, targets))
+                row = _overlap(prog, targets)
             except MemoryAttributionError as e:
-                rows.append({"error": str(e)[:300]})
+                row = {"error": str(e)[:300]}
+            row["sequence_schedulable"] = \
+                sequence_overlap_score(prog)["schedulable_overlap"]
+            rows.append(row)
         out[name] = rows
     return out

@@ -321,6 +321,13 @@ def restore_optimizer(opt, data, strict=True):
                 raise StateMismatchError(
                     f"bucket {zb.index}: live slots {sorted(extra)} are "
                     "absent from the checkpoint")
+    # _restore_store writes store values directly (no flush), so the
+    # stage-3 prefetch carry slot — a derived cache of the bucket-0
+    # param store, deliberately NOT captured — must be re-derived or the
+    # next compiled step would forward stale pre-restore parameters
+    refresh = getattr(opt, "_zero3_prefetch_refresh", None)
+    if refresh is not None:
+        refresh()
 
 
 # -- scaler / rng ----------------------------------------------------------

@@ -298,8 +298,15 @@ class StaticFunction:
         self._input_spec = input_spec
         # per-program XLA compiler options (latency-hiding A/B knob):
         # resolved once at wrap time (env overlay included), applied to
-        # every compiled entry via _jit()
+        # every compiled entry via _jit(). Scan-stepped programs with no
+        # explicit request default to the latency-hiding preset IF the
+        # backend registers it — judged lazily at first build (probing
+        # at wrap time would force backend init at decoration);
+        # xla_flags=False opts out (the A/B control arm spelling)
         self._xla_flags = _xla_flags_mod.resolve(xla_flags)
+        self._xla_flags_default_pending = (
+            xla_flags is None and scan_steps is not None
+            and not self._xla_flags)  # env flags outrank the default too
         self._flagged_jits = []
         if scan_steps is not None and int(scan_steps) < 1:
             raise ValueError(f"scan_steps must be >= 1, got {scan_steps}")
@@ -333,6 +340,12 @@ class StaticFunction:
         degrade to an unflagged recompile with the fallback recorded as
         provenance — see :meth:`xla_flags`."""
         from . import xla_flags as _xla_flags_mod
+        if self._xla_flags_default_pending:
+            self._xla_flags_default_pending = False
+            preset = _xla_flags_mod.PRESETS[
+                _xla_flags_mod.DEFAULT_SCAN_PRESET]
+            if _xla_flags_mod.backend_accepts(preset):
+                self._xla_flags = dict(preset)
         flagged = _xla_flags_mod.jit(fun, xla_flags=self._xla_flags,
                                      **kwargs)
         self._flagged_jits.append(flagged)
@@ -532,14 +545,35 @@ class StaticFunction:
                     "before asking for its traced memory stats")
             if "traced" not in aux:
                 from ..observability import jaxpr_mem
+                # donated state (the default) compiles carried stores to
+                # in-place updates; the meter models that same aliasing
                 aux["traced"] = jaxpr_mem.traced_peak_stats(
-                    get_jitted(), *ex)
+                    get_jitted(), *ex, alias_io=self._donate)
             return aux["traced"]
+
+        def schedulable_stats(mesh=None, **cost_kwargs):
+            # jaxpr-level emission-order overlap headroom
+            # (observability.overlap.schedulable_stats): like the
+            # liveness meter, sourced from the traced program — the
+            # compiled text's postorder re-sort erases the pipeline
+            # structure this measures
+            ex = aux.get("example_args")
+            if ex is None:
+                raise RuntimeError(
+                    "program has not executed yet; run the step once "
+                    "before asking for its schedulable-overlap stats")
+            key = ("schedulable", tuple(sorted(cost_kwargs.items())))
+            if key not in aux:
+                from ..observability import overlap
+                aux[key] = overlap.schedulable_stats(
+                    get_jitted(), ex, mesh=mesh, **cost_kwargs)
+            return aux[key]
 
         aux["capture"] = capture
         aux["hlo_text"] = hlo_text
         aux["memory_stats"] = memory_stats
         aux["traced_stats"] = traced_stats
+        aux["schedulable_stats"] = schedulable_stats
         return aux
 
     def hlo_text(self):
@@ -584,10 +618,48 @@ class StaticFunction:
         schedules — efficiency 0.0 there is the honest baseline the
         ``xla_flags`` latency-hiding A/B is judged against on real
         hardware). Cost-model rates (``link_gbps``, ``hbm_gbps``,
-        ``peak_flops``) and ``per_execution`` pass through."""
+        ``peak_flops``) and ``per_execution`` pass through.
+
+        The ``schedulable_overlap`` / ``schedulable_ns`` fields are
+        spliced in from the TRACED JAXPR (:meth:`schedulable_stats`)
+        when the traced program is reachable: the compiled text's
+        dependency-postorder re-sort erases the emission-order pipeline
+        structure that score measures, so the text-derived value would
+        read 0.0 even for a correctly pipelined step. The text-walk
+        numbers remain in each ``pairs`` record."""
         from ..observability import overlap
-        return overlap.overlap_stats(self.hlo_text(), mesh=self._mesh(),
-                                     **cost_kwargs)
+        per_exec = cost_kwargs.pop("per_execution", True)
+        rates = dict(cost_kwargs)
+        stats = overlap.overlap_stats(self.hlo_text(), mesh=self._mesh(),
+                                      per_execution=per_exec, **rates)
+        try:
+            sched = self.schedulable_stats(**rates)
+        except Exception:
+            return stats  # no traced program (e.g. restored dump)
+        stats["schedulable_overlap"] = sched["schedulable_overlap"]
+        stats["schedulable_ns"] = sched["schedulable_ns"]
+        stats["schedulable_pairs"] = sched["pairs"]
+        for op, slot in sched["per_op"].items():
+            tslot = stats["per_op"].setdefault(
+                op, {"hidden_ns": 0.0, "exposed_ns": 0.0,
+                     "collective_ns": 0.0, "efficiency": 0.0})
+            tslot["schedulable_ns"] = slot["schedulable_ns"]
+            tslot["schedulable"] = slot["schedulable"]
+        stats["assumptions"]["schedulable_source"] = sched["source"]
+        return stats
+
+    def schedulable_stats(self, **cost_kwargs):
+        """Backend-independent schedulable-overlap score of the most
+        recent entry, measured on its traced jaxpr emission order
+        (``observability.overlap.schedulable_stats``): how much
+        collective time the program structure leaves hideable, before
+        any backend scheduler has its say. The serial on-demand ZeRO-3
+        step scores 0.0; the double-buffered prefetch pipeline scores
+        > 0 — on every backend, including the CPU smoke mesh."""
+        if self._last_aux is None:
+            raise RuntimeError("no compiled entry yet; call the step once")
+        return self._last_aux["schedulable_stats"](mesh=self._mesh(),
+                                                   **cost_kwargs)
 
     def export_overlap_stats(self, **cost_kwargs):
         """Export :meth:`overlap_stats` onto the gauge board
@@ -1306,7 +1378,11 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     ``PADDLE_TPU_XLA_FLAGS`` env var overlays and wins). Flags a
     backend doesn't register fall back to an unflagged compile with
     provenance recorded — see ``StaticFunction.xla_flags()`` and
-    ``overlap_stats()`` for the A/B this knob exists for."""
+    ``overlap_stats()`` for the A/B this knob exists for. Scan-stepped
+    programs (``scan_steps=k``) with no explicit value DEFAULT to the
+    ``"latency-hiding"`` preset when the backend registers it (judged
+    once per process — ``jit.xla_flags.backend_accepts``); pass
+    ``xla_flags=False`` to opt a program out (the A/B control arm)."""
     if function is None:
         return lambda fn: to_static(fn, input_spec=input_spec,
                                     scan_steps=scan_steps, dp_axis=dp_axis,

@@ -14,9 +14,15 @@ backend init — useless for an in-process A/B).
     step.overlap_stats()    # did the schedule actually change?
 
 ``xla_flags`` accepts a preset name (:data:`PRESETS`), a
-``"flag=value flag2=value2"`` string, or a dict. The
+``"flag=value flag2=value2"`` string, a dict, or ``False`` (hard off —
+no flags, no env overlay, no default; the A/B control spelling). The
 ``PADDLE_TPU_XLA_FLAGS`` env var overlays (and wins over) the per-call
 value, so a runner can A/B a training script without editing it.
+Scan-stepped programs that pass nothing default to
+:data:`DEFAULT_SCAN_PRESET` when :func:`backend_accepts` says the
+backend registers it — the double-buffered ZeRO pipeline is built for
+that scheduler, and the smoke CPU (which rejects ``xla_tpu_*``
+options) probes once and stays unflagged.
 
 Flags ride ``jax.jit(..., compiler_options=...)``. XLA validates them at
 the FIRST CALL (or AOT compile), not at ``jit()`` time, and rejects
@@ -33,10 +39,20 @@ propagates.
 """
 import os
 
-__all__ = ["PRESETS", "ENV_VAR", "parse_flags", "env_flags", "merge",
-           "resolve", "jit", "FlaggedJit"]
+__all__ = ["PRESETS", "ENV_VAR", "DEFAULT_SCAN_PRESET", "parse_flags",
+           "env_flags", "merge", "resolve", "backend_accepts", "jit",
+           "FlaggedJit"]
 
 ENV_VAR = "PADDLE_TPU_XLA_FLAGS"
+
+# Preset a scan-compiled step program gets BY DEFAULT when the caller
+# passed no xla_flags and the backend registers the options (see
+# backend_accepts): the double-buffered ZeRO pipeline emits its
+# collectives early precisely so the latency-hiding scheduler can sink
+# them under compute — on backends with the scheduler, shipping the
+# pipeline without the flags would measure the serial schedule. Opt out
+# per program with ``xla_flags=False`` (the A/B control spelling).
+DEFAULT_SCAN_PRESET = "latency-hiding"
 
 # Named flag bundles for the standard A/Bs. The tpu-prefixed options
 # only exist on TPU backends (falling back on CPU is the designed
@@ -114,7 +130,15 @@ def merge(*flag_dicts):
 def resolve(xla_flags):
     """Normalize a ``to_static(xla_flags=...)`` value — ``None``, a
     preset name, a flag string, or a dict — and overlay the env var
-    (env wins: the runner doing the A/B outranks the script)."""
+    (env wins: the runner doing the A/B outranks the script).
+
+    ``False`` (or the strings ``"none"``/``"off"``) is the hard off
+    switch: no flags, no env overlay, and no scan-body default — the
+    spelling an A/B driver uses for its control arm, where "the runner
+    outranks the script" must not re-arm the treatment."""
+    if xla_flags is False or (isinstance(xla_flags, str)
+                              and xla_flags.lower() in ("none", "off")):
+        return {}
     if xla_flags is None:
         base = {}
     elif isinstance(xla_flags, dict):
@@ -132,6 +156,34 @@ def resolve(xla_flags):
 def _is_unknown_flag_error(exc):
     msg = str(exc)
     return "No such compile option" in msg or "Unknown flag" in msg
+
+
+_BACKEND_ACCEPTS = {}  # flag-set key -> bool, cached per process
+
+
+def backend_accepts(flags):
+    """Whether the current backend registers these compile options,
+    judged ONCE per process per flag set by compiling a trivial flagged
+    program. The scan-body default preset consults this before
+    attaching itself: an explicit ``xla_flags=`` request never probes
+    (FlaggedJit's per-program fallback records honest provenance
+    instead), but a DEFAULT that the backend is known to reject would
+    only buy every program a doomed first compile."""
+    if not flags:
+        return True
+    key = tuple(sorted((k, str(v)) for k, v in flags.items()))
+    if key not in _BACKEND_ACCEPTS:
+        import jax
+        import jax.numpy as jnp
+        try:
+            jax.jit(lambda x: x + 1,
+                    compiler_options=dict(flags))(jnp.float32(0))
+            _BACKEND_ACCEPTS[key] = True
+        except Exception as e:
+            if not _is_unknown_flag_error(e):
+                raise
+            _BACKEND_ACCEPTS[key] = False
+    return _BACKEND_ACCEPTS[key]
 
 
 def _log_fallback(flags, exc):

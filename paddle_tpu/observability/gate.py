@@ -39,7 +39,7 @@ _LOWER_BETTER_UNITS = {"ms", "s", "ns", "us", "MB", "MiB", "GB", "bytes"}
 # each record: overlap efficiency (hidden/total) can only improve
 # upward; exposed collective fraction only downward. An explicit
 # per-record "direction" still outranks these.
-_HIGHER_BETTER_SUFFIXES = ("_overlap_efficiency",)
+_HIGHER_BETTER_SUFFIXES = ("_overlap_efficiency", "_schedulable_overlap")
 _LOWER_BETTER_SUFFIXES = ("_exposed_collective_frac",)
 
 

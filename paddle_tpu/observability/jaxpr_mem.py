@@ -74,12 +74,25 @@ def _sub_jaxprs(eqn):
     return out
 
 
-def jaxpr_peak_bytes(jaxpr):
+def jaxpr_peak_bytes(jaxpr, alias_io=False):
     """Sequential-liveness high-water bytes of one jaxpr: inputs are
     resident throughout their live range, each equation adds its outputs
     plus its internal (recursive) working set, and a value frees after
     its last consumer. Program order is the jaxpr's — the order the
-    trace executed and the order a barrier-honoring scheduler keeps."""
+    trace executed and the order a barrier-honoring scheduler keeps.
+
+    ``alias_io=True`` models input→output buffer donation: a jaxpr
+    output born at an equation where a same-shaped, same-dtyped input
+    has already had its last use is written into that input's buffer
+    (XLA's ``donate_argnums`` aliasing at the jit boundary, and the
+    in-place carry of a compiled while loop). Without it a donated
+    carry — every ZeRO flat store threaded through the scan — is
+    double-counted at the boundary equation (the dying input and the
+    output physically share one buffer). Off by default so handmade
+    jaxprs meter under the plain convention; the program knows whether
+    it donates (``StaticFunction`` passes its own donation flag), and
+    the model propagates into scan/while bodies where carry aliasing
+    is unconditional in XLA."""
     jaxpr = getattr(jaxpr, "jaxpr", jaxpr)  # accept ClosedJaxpr
 
     def _vars(atoms):
@@ -99,12 +112,44 @@ def jaxpr_peak_bytes(jaxpr):
     for v in _vars(jaxpr.outvars):
         last_use[v] = n_eqns  # outputs live to the end
 
-    live = 0
-    for v in _vars(list(jaxpr.invars) + list(jaxpr.constvars)):
-        live += _size(v)
+    inputs = _vars(list(jaxpr.invars) + list(jaxpr.constvars))
+
+    # Buffer handoff for donation: pair each produced boundary output
+    # (in birth order) with a same-shape/dtype input whose last use
+    # precedes its birth; the donor then frees just BEFORE the birth
+    # equation (its buffer becomes the output's), never double-counted.
+    handoff = {}  # birth eqn index -> [donor vars released there]
+    handed_off = set()
+    if alias_io:
+        input_ids = {id(v) for v in inputs}
+        birth = {}
+        for i, eqn in enumerate(jaxpr.eqns):
+            for v in _vars(eqn.outvars):
+                birth.setdefault(id(v), i)
+        pool = {}
+        for v in inputs:
+            aval = v.aval
+            key = (getattr(aval, "shape", None), str(getattr(aval, "dtype", "")))
+            pool.setdefault(key, []).append(v)
+        for v in _vars(jaxpr.outvars):
+            if id(v) in input_ids or id(v) not in birth:
+                continue  # pass-through outputs already share the buffer
+            b = birth[id(v)]
+            aval = v.aval
+            key = (getattr(aval, "shape", None), str(getattr(aval, "dtype", "")))
+            donor = next((d for d in pool.get(key, [])
+                          if last_use.get(d, -1) <= b), None)
+            if donor is not None:
+                pool[key].remove(donor)  # one donor funds one output
+                handoff.setdefault(b, []).append(donor)
+                handed_off.add(id(donor))  # released via handoff, not the walk
+
+    live = sum(_size(v) for v in inputs)
     peak = live
 
     for i, eqn in enumerate(jaxpr.eqns):
+        for donor in handoff.get(i, ()):
+            live -= _size(donor)
         inner = 0
         for sub in _sub_jaxprs(eqn):
             # the sub-jaxpr's boundary values ARE the equation's operands
@@ -113,33 +158,39 @@ def jaxpr_peak_bytes(jaxpr):
             sub_j = getattr(sub, "jaxpr", sub)
             base = sum(_size(v) for v in _vars(list(sub_j.invars)
                                                + list(sub_j.constvars)))
-            inner = max(inner, max(0, jaxpr_peak_bytes(sub_j) - base))
+            inner = max(inner, max(0, jaxpr_peak_bytes(sub_j, alias_io=alias_io)
+                                   - base))
         born = sum(_size(v) for v in _vars(eqn.outvars))
         peak = max(peak, live + born + inner)
         live += born
         for v in _vars(list(eqn.invars) + list(eqn.outvars)):
-            if last_use.get(v, -1) <= i:
+            if id(v) not in handed_off and last_use.get(v, -1) <= i:
                 live -= _size(v)
     return peak
 
 
-def jaxpr_peak_stats(closed_jaxpr):
+def jaxpr_peak_stats(closed_jaxpr, alias_io=False):
     """``{"peak_bytes", "argument_bytes", "output_bytes", "eqns"}`` for a
     traced program: the liveness high-water plus the boundary sizes that
-    contextualize it."""
+    contextualize it. ``alias_io`` records whether donation aliasing was
+    modeled (see :func:`jaxpr_peak_bytes`)."""
     jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
     return {
-        "peak_bytes": jaxpr_peak_bytes(jaxpr),
+        "peak_bytes": jaxpr_peak_bytes(jaxpr, alias_io=alias_io),
         "argument_bytes": sum(_size(v) for v in jaxpr.invars),
         "output_bytes": sum(_size(v) for v in jaxpr.outvars),
         "eqns": len(jaxpr.eqns),
+        "alias_io": bool(alias_io),
     }
 
 
-def traced_peak_stats(fn, *abstract_args):
+def traced_peak_stats(fn, *abstract_args, alias_io=False):
     """Trace ``fn`` on ShapeDtypeStruct twins and meter the jaxpr —
     the entry point ``StaticFunction.traced_memory_stats()`` uses with
-    each compiled entry's captured example args."""
+    each compiled entry's captured example args. The caller passes
+    ``alias_io=True`` when the program donates its state (to_static's
+    default), so carried stores meter as the in-place updates XLA
+    actually compiles them to."""
     import jax
     closed = jax.make_jaxpr(fn)(*abstract_args)
-    return jaxpr_peak_stats(closed)
+    return jaxpr_peak_stats(closed, alias_io=alias_io)

@@ -33,6 +33,36 @@ against on real hardware. The pairing/interleaving math itself is
 backend-independent and pinned by seeded async-HLO fixtures in
 tests/test_overlap.py.
 
+Because measured efficiency is 0.0 on every sync-schedule backend, the
+analyzer also reports a backend-independent **schedulable-overlap
+score**: for every collective (sync ops included), walk FORWARD in
+emission order to its first real consumer — taint-following through
+zero-cost aliases (``get-tuple-element``/``tuple``/``bitcast``/the
+``-done`` half) and through cheap data-movement ops
+(slice/pad/concatenate/reshape/convert...), which forward the taint
+without crediting compute — and sum the independent compute emitted in
+between. ``schedulable_hidden = min(collective_ns, available)`` prices
+how much of the collective a latency-hiding scheduler COULD bury given
+this emission order, which is what the ZeRO-3 double-buffered prefetch
+restructure changes: the serial on-demand step scores 0.0 (every
+collective is consumer-adjacent), the pipelined step scores > 0 even
+where XLA:CPU executes synchronously.
+
+The authoritative source for that emission order is the TRACED JAXPR
+(:func:`schedulable_stats`), not the compiled text: XLA's
+StableHLO→HLO conversion re-sorts instructions into dependency
+postorder and the CPU scheduler re-serializes them consumer-adjacent,
+so the compiled dump destroys exactly the evidence the score measures.
+The jaxpr is the program the framework wrote — the same structural
+source the jaxpr-liveness memory meter trusts — and
+``StaticFunction.overlap_stats()`` splices the jaxpr-derived score
+into its report when the traced program is available. The text-order
+walk remains as the fallback for standalone HLO dumps (honest there
+too: it reports what the final schedule left hideable). That makes the
+restructure value-gateable on the CPU smoke mesh
+(``*_schedulable_overlap`` rows, direction up) while the
+measured-efficiency re-capture waits on TPU time.
+
 Cost-model assumptions (all overridable per call, recorded in the
 result's ``assumptions``): the schedule is the only evidence — no
 measured wall-times (pass a profiler trace to ``tools/overlap_view.py``
@@ -47,7 +77,8 @@ import re
 from .hlo_bytes import (COLLECTIVE_HLO_OPS, _axis_name, _comp_multipliers,
                         _group_size, _shape_bytes)
 
-__all__ = ["overlap_stats", "export_overlap_stats", "attribute_program",
+__all__ = ["overlap_stats", "schedulable_stats", "export_overlap_stats",
+           "attribute_program",
            "DEFAULT_LINK_GBPS", "DEFAULT_HBM_GBPS", "DEFAULT_PEAK_FLOPS",
            "RING_FACTORS"]
 
@@ -91,6 +122,37 @@ _ZERO_COST_OPS = {
     "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
     "after-all", "partition-id", "replica-id", "domain", "opt-barrier",
     "optimization-barrier",
+}
+
+# cheap data-movement/layout ops (HLO spelling): in the schedulable
+# walk these neither end a hiding window (a tainted slice just unpacks
+# the collective's result — it forwards the taint) nor count as hiding
+# material when independent (crediting a pad/concatenate as "compute"
+# would let the serial step's grad-flattening prep masquerade as
+# overlap headroom)
+_MOVEMENT_OPS = {
+    "slice", "dynamic-slice", "dynamic-update-slice", "pad",
+    "concatenate", "reshape", "broadcast", "convert", "transpose",
+    "copy", "reverse", "reduce-precision",
+}
+
+# the same class in jaxpr-primitive spelling, for schedulable_stats
+_MOVEMENT_PRIMS = {
+    "slice", "dynamic_slice", "dynamic_update_slice", "pad",
+    "concatenate", "reshape", "broadcast_in_dim", "squeeze",
+    "expand_dims", "convert_element_type", "transpose", "copy", "rev",
+    "bitcast_convert_type", "split", "device_put", "sharding_constraint",
+    "stop_gradient", "reduce_precision",
+}
+
+# jaxpr collective primitive -> the HLO op name the cost model prices
+_COLLECTIVE_PRIMS = {
+    "all_gather": "all-gather",
+    "psum_scatter": "reduce-scatter",
+    "reduce_scatter": "reduce-scatter",
+    "psum": "all-reduce",
+    "all_to_all": "all-to-all",
+    "ppermute": "collective-permute",
 }
 
 
@@ -220,6 +282,37 @@ class _CostModel:
         return total
 
 
+def _schedulable_available(model, instrs, operand_sets, idx, done_idx=None):
+    """Between-compute AVAILABLE to hide the collective at ``idx``:
+    walk forward in schedule order until its first real consumer,
+    summing ``compute_ns`` of independent instructions. The collective's
+    result names are a taint set; zero-cost and data-movement ops (and
+    the async ``-done`` half) consuming a tainted name forward the
+    taint instead of ending the window; independent movement ops earn
+    no credit; other collectives contribute zero hiding (the cost
+    model's standing assumption). No consumer in this computation (the
+    result leaves via the root) extends the window to the end."""
+    taint = {instrs[idx]["name"]}
+    if done_idx is not None:
+        taint.add(instrs[done_idx]["name"])
+    avail = 0.0
+    for j in range(idx + 1, len(instrs)):
+        ins = instrs[j]
+        base, phase = _collective_kind(ins["opcode"])
+        movement = (ins["opcode"] in _ZERO_COST_OPS
+                    or ins["opcode"] in _MOVEMENT_OPS
+                    or (base is not None and phase == "done"))
+        if operand_sets[j] & taint:
+            if not movement:
+                break  # first real consumer: the hiding window ends
+            taint.add(ins["name"])
+            continue
+        if base is not None or movement:
+            continue  # no hiding credit from collectives or data moves
+        avail += model.compute_ns(ins)
+    return avail
+
+
 def _pair_bytes(start, done):
     """Payload bytes of an async pair, billed once: the largest single
     shape on either line (the -start result tuple repeats the operand
@@ -240,15 +333,25 @@ def overlap_stats(hlo_text, mesh=None, link_gbps=DEFAULT_LINK_GBPS,
                                           collective time),
          "exposed_collective_frac": exposed/total (1.0 when sync-only),
          "hidden_ns": ..., "exposed_ns": ..., "collective_ns": ...,
+         "schedulable_overlap": schedulable_hidden/total — the
+                                backend-independent score: how much
+                                collective time the EMISSION ORDER
+                                leaves hideable (0.0 for the serial
+                                consumer-adjacent ZeRO step, > 0 for
+                                the prefetch-pipelined one, even on a
+                                sync-schedule backend),
+         "schedulable_ns": trip-weighted schedulable hidden time,
          "async_pairs_total": N, "sync_total": M,
          "backend_sync_schedule": True when collectives exist but the
                                   scheduler emitted zero async pairs
                                   (the XLA:CPU finding),
          "per_op": {op: {"hidden_ns", "exposed_ns", "collective_ns",
-                         "efficiency"}},
+                         "efficiency", "schedulable_ns",
+                         "schedulable"}},
          "pairs": [per-collective records: op/axis/phase/name/
                    computation/count/collective_ns/overlap_ns/
-                   hidden_ns/exposed_ns],
+                   hidden_ns/exposed_ns/schedulable_available_ns/
+                   schedulable_hidden_ns],
          "assumptions": {...}}
 
     ``per_execution=True`` (the default — exposure is a per-step cost)
@@ -272,6 +375,8 @@ def overlap_stats(hlo_text, mesh=None, link_gbps=DEFAULT_LINK_GBPS,
             m = _OPERAND_NAME_RE.search(instr["rest"])
             if m is not None:
                 done_by_start.setdefault(m.group(1), idx)
+        operand_sets = [frozenset(_OPERAND_NAME_RE.findall(i["rest"]))
+                        for i in instrs]
         for idx, instr in enumerate(instrs):
             base, phase = _collective_kind(instr["opcode"])
             if base is None or phase == "done":
@@ -281,6 +386,7 @@ def overlap_stats(hlo_text, mesh=None, link_gbps=DEFAULT_LINK_GBPS,
             rec = {"op": base, "axis": axis, "name": instr["name"],
                    "computation": comp_name, "count": weight,
                    "index": idx}
+            done_idx = None
             if phase == "start" and instr["name"] in done_by_start:
                 done_idx = done_by_start[instr["name"]]
                 done = instrs[done_idx]
@@ -301,30 +407,44 @@ def overlap_stats(hlo_text, mesh=None, link_gbps=DEFAULT_LINK_GBPS,
                 rec.update(phase="sync", bytes=nbytes,
                            collective_ns=coll_ns, overlap_ns=0.0,
                            hidden_ns=0.0, exposed_ns=coll_ns)
+            avail = _schedulable_available(model, instrs, operand_sets,
+                                           idx, done_idx)
+            rec["schedulable_available_ns"] = avail
+            rec["schedulable_hidden_ns"] = min(rec["collective_ns"],
+                                               avail)
             pairs.append(rec)
 
     hidden = sum(p["hidden_ns"] * p["count"] for p in pairs)
     exposed = sum(p["exposed_ns"] * p["count"] for p in pairs)
     total = hidden + exposed
+    schedulable = sum(p["schedulable_hidden_ns"] * p["count"]
+                      for p in pairs)
     n_async = sum(p["count"] for p in pairs if p["phase"] == "async")
     n_sync = sum(p["count"] for p in pairs if p["phase"] == "sync")
     per_op = {}
     for p in pairs:
         slot = per_op.setdefault(p["op"], {"hidden_ns": 0.0,
                                            "exposed_ns": 0.0,
-                                           "collective_ns": 0.0})
+                                           "collective_ns": 0.0,
+                                           "schedulable_ns": 0.0})
         slot["hidden_ns"] += p["hidden_ns"] * p["count"]
         slot["exposed_ns"] += p["exposed_ns"] * p["count"]
         slot["collective_ns"] += p["collective_ns"] * p["count"]
+        slot["schedulable_ns"] += p["schedulable_hidden_ns"] * p["count"]
     for slot in per_op.values():
         slot["efficiency"] = (slot["hidden_ns"] / slot["collective_ns"]
                               if slot["collective_ns"] else 0.0)
+        slot["schedulable"] = (slot["schedulable_ns"]
+                               / slot["collective_ns"]
+                               if slot["collective_ns"] else 0.0)
     return {
         "collective_overlap_efficiency": hidden / total if total else 0.0,
         "exposed_collective_frac": exposed / total if total else 1.0,
         "hidden_ns": hidden,
         "exposed_ns": exposed,
         "collective_ns": total,
+        "schedulable_overlap": schedulable / total if total else 0.0,
+        "schedulable_ns": schedulable,
         "async_pairs_total": n_async,
         "sync_total": n_sync,
         "backend_sync_schedule": bool(pairs) and n_async == 0,
@@ -336,6 +456,188 @@ def overlap_stats(hlo_text, mesh=None, link_gbps=DEFAULT_LINK_GBPS,
                         "cost_model": "static schedule estimate; no "
                                       "measured wall-times; collectives "
                                       "do not hide each other"},
+    }
+
+
+def _aval_bytes(v):
+    """Array bytes of one jaxpr atom's aval (0 for abstract tokens)."""
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def _sub_jaxprs(eqn):
+    """Inner jaxprs of one equation (scan/while/pjit/cond/custom-vjp),
+    via duck typing: any param that is or wraps a jaxpr."""
+    for key, val in eqn.params.items():
+        inner = getattr(val, "jaxpr", None)
+        if inner is not None and hasattr(inner, "eqns"):
+            yield inner
+        elif hasattr(val, "eqns"):
+            yield val
+        elif key == "branches":
+            for b in val:
+                yield getattr(b, "jaxpr", b)
+
+
+def _eqn_compute_ns(eqn, hbm_gbps, peak_flops):
+    """Roofline-ish cost of one jaxpr equation, mirroring the HLO cost
+    model: dot/conv by the geometric-mean FLOP heuristic, everything
+    else one FLOP per output element; collectives and data-movement ops
+    score 0; call-like equations recurse."""
+    import jax
+    prim = eqn.primitive.name
+    if prim in _COLLECTIVE_PRIMS or prim in _MOVEMENT_PRIMS:
+        return 0.0
+    subs = list(_sub_jaxprs(eqn))
+    if subs:
+        return sum(_eqn_compute_ns(e, hbm_gbps, peak_flops)
+                   for s in subs for e in s.eqns)
+    out_bytes = sum(_aval_bytes(v) for v in eqn.outvars)
+    out_elems = sum(
+        int(math.prod(getattr(v.aval, "shape", ()) or (1,)))
+        for v in eqn.outvars if hasattr(v, "aval"))
+    if prim in ("dot_general", "conv_general_dilated"):
+        a = math.prod(eqn.invars[0].aval.shape or (1,)) \
+            if eqn.invars else 1
+        b = math.prod(eqn.invars[1].aval.shape or (1,)) \
+            if len(eqn.invars) > 1 else a
+        flops = 2.0 * math.sqrt(max(a, 1) * max(b, 1)
+                                * max(out_elems, 1))
+    else:
+        flops = float(out_elems)
+    nbytes = out_bytes + sum(
+        _aval_bytes(v) for v in eqn.invars
+        if isinstance(v, jax.core.Var))
+    return max(nbytes / hbm_gbps, flops / (peak_flops / 1e9))
+
+
+def _prim_group_size(eqn, mesh):
+    """Participant count of one collective equation from its axis-name
+    params and the mesh shape (falls back to 2, like the text model)."""
+    names = eqn.params.get("axis_name", eqn.params.get("axes", ()))
+    if not isinstance(names, (tuple, list)):
+        names = (names,)
+    size = 1
+    shape = dict(getattr(mesh, "shape", {}) or {}) if mesh is not None \
+        else {}
+    for n in names:
+        size *= int(shape.get(n, 0)) or 0
+    return size if size > 1 else 2
+
+
+def schedulable_stats(fun, example_args, mesh=None,
+                      link_gbps=DEFAULT_LINK_GBPS,
+                      hbm_gbps=DEFAULT_HBM_GBPS,
+                      peak_flops=DEFAULT_PEAK_FLOPS):
+    """Backend-independent schedulable-overlap score of a traceable
+    step function, measured on its JAXPR — the emission order the
+    framework wrote, before XLA's StableHLO→HLO conversion re-sorts
+    instructions into dependency postorder and the backend scheduler
+    re-serializes them (both of which erase exactly the structure this
+    score measures; see the module docstring).
+
+    Per collective equation: walk forward in emission order to its
+    first real consumer — data-movement ops forward the taint with no
+    compute credit, other collectives contribute nothing — and sum the
+    independent compute in between. ``hidden = min(collective_ns,
+    available)``; scan-body equations weigh by the scan length. Returns
+    ``{"schedulable_overlap", "schedulable_ns", "collective_ns",
+    "pairs": [...], "per_op": {...}, "source": "traced-jaxpr",
+    "assumptions": {...}}``.
+
+    ``fun`` may be a plain callable, a ``jax.jit`` wrapper, or the
+    ``xla_flags.FlaggedJit`` wrapper ``to_static`` builds (unwrapped
+    via its ``_fun``); ``example_args`` are the abstract or concrete
+    arguments of one call."""
+    import jax
+    inner = getattr(fun, "_fun", fun)
+    jaxpr = jax.make_jaxpr(inner)(*example_args)
+
+    pairs = []
+
+    def walk(jx, weight):
+        eqns = jx.eqns
+        for idx, eqn in enumerate(eqns):
+            prim = eqn.primitive.name
+            w = weight * (int(eqn.params.get("length", 1))
+                          if prim == "scan" else 1)
+            for sub in _sub_jaxprs(eqn):
+                walk(sub, w)
+            base = _COLLECTIVE_PRIMS.get(prim)
+            if base is None:
+                continue
+            nbytes = max(
+                [_aval_bytes(v) for v in list(eqn.outvars) + [
+                    i for i in eqn.invars if isinstance(i, jax.core.Var)
+                ]] or [0])
+            group = _prim_group_size(eqn, mesh)
+            factor = RING_FACTORS.get(base, lambda _: 1.0)(group)
+            coll_ns = nbytes * factor / link_gbps
+            taint = {v for v in eqn.outvars
+                     if isinstance(v, jax.core.Var)}
+            avail = 0.0
+            for j in range(idx + 1, len(eqns)):
+                nxt = eqns[j]
+                p2 = nxt.primitive.name
+                tainted = any(iv in taint for iv in nxt.invars
+                              if isinstance(iv, jax.core.Var))
+                if p2 in _MOVEMENT_PRIMS:
+                    if tainted:
+                        taint.update(v for v in nxt.outvars
+                                     if isinstance(v, jax.core.Var))
+                    continue
+                if tainted:
+                    break  # first real consumer ends the window
+                if p2 in _COLLECTIVE_PRIMS:
+                    continue  # collectives do not hide each other
+                avail += _eqn_compute_ns(nxt, hbm_gbps, peak_flops)
+            axis_names = eqn.params.get("axis_name",
+                                        eqn.params.get("axes", ()))
+            if not isinstance(axis_names, (tuple, list)):
+                axis_names = (axis_names,)
+            pairs.append({
+                "op": base,
+                "axis": ",".join(str(a) for a in axis_names) or None,
+                "bytes": nbytes, "count": weight,
+                "collective_ns": coll_ns,
+                "available_ns": avail,
+                "hidden_ns": min(coll_ns, avail),
+            })
+
+    walk(jaxpr.jaxpr, 1)
+    total = sum(p["collective_ns"] * p["count"] for p in pairs)
+    hidden = sum(p["hidden_ns"] * p["count"] for p in pairs)
+    per_op = {}
+    for p in pairs:
+        slot = per_op.setdefault(p["op"], {"collective_ns": 0.0,
+                                           "schedulable_ns": 0.0})
+        slot["collective_ns"] += p["collective_ns"] * p["count"]
+        slot["schedulable_ns"] += p["hidden_ns"] * p["count"]
+    for slot in per_op.values():
+        slot["schedulable"] = (slot["schedulable_ns"]
+                               / slot["collective_ns"]
+                               if slot["collective_ns"] else 0.0)
+    return {
+        "schedulable_overlap": hidden / total if total else 0.0,
+        "schedulable_ns": hidden,
+        "collective_ns": total,
+        "pairs": sorted(pairs, key=lambda p: -p["collective_ns"]),
+        "per_op": per_op,
+        "source": "traced-jaxpr",
+        "assumptions": {"link_gbps": link_gbps, "hbm_gbps": hbm_gbps,
+                        "peak_flops": peak_flops,
+                        "cost_model": "static jaxpr emission-order "
+                                      "estimate; data-movement ops "
+                                      "forward taint with no credit; "
+                                      "collectives do not hide each "
+                                      "other"},
     }
 
 
@@ -353,6 +655,8 @@ def export_overlap_stats(stats, program=None):
                                  program=program) if program else "")
     set_gauge("collective_overlap_efficiency" + prog_labels,
               stats["collective_overlap_efficiency"])
+    set_gauge("collective_schedulable_overlap" + prog_labels,
+              stats["schedulable_overlap"])
     set_gauge("collective_async_pairs_total" + prog_labels,
               stats["async_pairs_total"])
     set_gauge("collective_sync_total" + prog_labels,
@@ -382,6 +686,7 @@ def export_overlap_stats(stats, program=None):
         runlog.event(
             "collective_overlap", program=program,
             efficiency=stats["collective_overlap_efficiency"],
+            schedulable=stats["schedulable_overlap"],
             exposed_frac=stats["exposed_collective_frac"],
             hidden_ns=stats["hidden_ns"], exposed_ns=stats["exposed_ns"],
             async_pairs=stats["async_pairs_total"],

@@ -105,6 +105,10 @@ class _FlatStore:
         self.tensor.persistable = True
         self.tensor._mark_stateful()
         self.pending = []
+        # eager-write notification: the ZeRO-3 prefetch slot is a derived
+        # cache of the bucket-0 param store and must track out-of-band
+        # writes (load_state_dict, user set_value)
+        self.on_flush = None
 
     def flush(self):
         if not self.pending:
@@ -131,6 +135,9 @@ class _FlatStore:
                     buf, NamedSharding(mesh, self.tensor.pspec))
         self.tensor._value = buf
         self.pending = []
+        if self.on_flush is not None \
+                and not isinstance(buf, jax.core.Tracer):
+            self.on_flush()
 
 
 class _ZeroBucket:
@@ -451,7 +458,8 @@ class Optimizer:
 
     # -- ZeRO-1/2 sharded step --------------------------------------------
     def _zero_enable(self, axis=None, mesh=None, stage=1,
-                     comm_buffer_mb=None, last_comm_buffer_mb=None):
+                     comm_buffer_mb=None, last_comm_buffer_mb=None,
+                     prefetch=None):
         """Partition this optimizer's state for ZeRO data parallelism over
         one mesh axis: moments (and fp32 masters under multi_precision)
         move into per-bucket flat [rows, 1024] stores sharded 1/degree per
@@ -476,7 +484,21 @@ class Optimizer:
         back only the local shard rows — per-chip param + optimizer HBM is
         O(params/degree). Stages 2/3 also allocate a sharded per-bucket
         gradient accumulator ridden by ``to_static(accumulate_steps=a)``
-        windows. Returns the number of accumulator views sharded."""
+        windows. Returns the number of accumulator views sharded.
+
+        ``prefetch`` (default on) selects the latency-hiding step
+        schedule: the sharded update software-pipelines each bucket's
+        ``psum_scatter`` ahead of the previous bucket's update math, and
+        stage 3 double-buffers the parameter gathers — bucket i+1's
+        ``all_gather`` issues while bucket i computes, with bucket 0
+        arriving through a full-bucket prefetch carry slot that the
+        step's tail refills for step N+1 (warm-started across scan
+        iterations and accumulation windows). Collective payloads and
+        per-bucket math are unchanged — only the emission order moves —
+        so the pipelined step stays bitwise-equal to the serial one;
+        ``prefetch=False`` keeps the on-demand serial schedule (the A/B
+        control). The slot costs one full bucket of parameter bytes on
+        the carry."""
         from jax.sharding import PartitionSpec
         from ..core import state as state_mod
         from ..distributed import bucketing, parallel_env
@@ -487,7 +509,9 @@ class Optimizer:
                     and int(stage) == self._zero["stage"]
                     and (comm_buffer_mb is None
                          or float(comm_buffer_mb)
-                         == self._zero["comm_buffer_mb"]))
+                         == self._zero["comm_buffer_mb"])
+                    and (prefetch is None
+                         or bool(prefetch) == self._zero["prefetch"]))
             if not same:
                 raise RuntimeError(
                     f"ZeRO already enabled with axis="
@@ -666,12 +690,34 @@ class Optimizer:
             _drop(store.tensor)
         self._flat_stores = {}
         n_sharded = sum(len(sd) for sd in stores)
+        prefetch_on = bool(prefetch) if prefetch is not None else True
         self._zero = {
             "axis": axis, "mesh": mesh, "stage": int(stage),
             "degree": degree, "buckets": buckets, "stores": stores,
             "slots": slots, "n_sharded": n_sharded,
             "comm_buffer_mb": float(comm_buffer_mb),
+            "prefetch": prefetch_on,
         }
+        if int(stage) == 3 and prefetch_on:
+            # the double-buffer carry slot: bucket 0's FULL [rows, 1024]
+            # flat rows, replicated per rank, riding the donated scan
+            # carry (carry-optional: a program that never steps this
+            # optimizer skips it). The step's tail all_gather refills it
+            # for step N+1, so the first bucket's params are already
+            # resident when the next forward starts — one bucket of
+            # parameter bytes is the whole memory cost.
+            slot_t = Tensor(jnp.zeros((buckets[0].rows, _FLAT_LANES),
+                                      buckets[0].param_dtype))
+            slot_t.persistable = True
+            slot_t.name = "zero3_prefetch_slot"
+            slot_t._ledger_category = "zero_prefetch"
+            slot_t._carry_optional = True
+            slot_t._mark_stateful()
+            self._zero["prefetch_slot"] = slot_t
+            # eager writers of the bucket-0 param store (state_dict
+            # loads, user set_value) invalidate the cached gather
+            stores[0]["param"].on_flush = self._zero3_prefetch_refresh
+            self._zero3_prefetch_refresh()
         return n_sharded
 
     def _zero_state_bytes(self):
@@ -713,66 +759,124 @@ class Optimizer:
                 g = jax.lax.pmean(g, axis)
             p._grad = g
 
+    def _zero3_prefetch_refresh(self):
+        """Re-derive the stage-3 prefetch carry slot from the bucket-0
+        param store. Eager writers go through here (enable-time init,
+        checkpoint restore, out-of-band ``set_value`` via the store's
+        ``on_flush``); inside a traced step the tail of ``_zero_step``
+        refreshes the slot in-trace instead, so a tracer-valued store
+        is left alone."""
+        cfg = self._zero
+        if (not cfg or cfg["stage"] != 3 or not cfg["prefetch"]
+                or "prefetch_slot" not in cfg):
+            return
+        val = cfg["stores"][0]["param"].tensor._value
+        if isinstance(val, jax.core.Tracer):
+            return
+        from jax.sharding import NamedSharding, PartitionSpec
+        cfg["prefetch_slot"]._value = jax.device_put(
+            val, NamedSharding(cfg["mesh"], PartitionSpec()))
+
     def _zero3_materialize(self):
         """to_static step hook (registered at stage-3 enable): arm LAZY
         just-in-time parameter materialization — the first in-trace read
-        of any param in a bucket triggers one ``all_gather`` of that
-        bucket's sharded flat store and installs full-value overrides for
-        every param it covers, consumed by forward/backward and dropped
-        when the step body ends. Laziness keeps unrelated programs free:
-        a trace that never touches this model's params issues no gathers
-        and never reads the stores (they stay skipped state instead of
-        being threaded into someone else's compiled step). The gathered
-        full parameters exist only inside the step; the donated carry
-        holds 1/degree shards."""
+        of any param in a bucket installs full-value overrides for every
+        param the bucket covers, consumed by forward/backward and
+        dropped when the step body ends. Laziness keeps unrelated
+        programs free: a trace that never touches this model's params
+        issues no gathers and never reads the stores (they stay skipped
+        state instead of being threaded into someone else's compiled
+        step). The gathered full parameters exist only inside the step;
+        the donated carry holds 1/degree shards.
+
+        With ``prefetch`` on (the default) the gathers are
+        double-buffered instead of on-demand: bucket 0's full rows
+        arrive through the warm-started prefetch carry slot (no gather
+        at all — the previous step's tail already issued it), and
+        materializing bucket i immediately issues bucket i+1's
+        ``all_gather`` into a pending buffer, so each gather is emitted
+        BEFORE the compute that consumes bucket i — the between-compute
+        the latency-hiding scheduler needs. Payloads and values are
+        identical to the serial schedule (``all_gather`` of the same
+        shard rows), so the step stays bitwise-equal. An out-of-order
+        first read (bucket j before j-1) falls back to an on-demand
+        gather for that bucket."""
         from ..distributed import parallel_env
         cfg = self._zero
         if cfg is None or cfg["stage"] != 3:
             return None
         axis, degree = cfg["axis"], cfg["degree"]
+        prefetch = cfg["prefetch"]
+        buckets, stores = cfg["buckets"], cfg["stores"]
+        pending = {}  # bucket index -> prefetched full rows (per trace)
 
-        def make_gather(zb, sdict):
+        def full_rows(sdict):
+            dp_mode = parallel_env.current_dp_axis() == axis
+            bound = dp_mode and parallel_env.axis_bound(axis)
+            shard = sdict["param"].tensor._value
+            if bound:
+                return jax.lax.all_gather(shard, axis, axis=0,
+                                          tiled=True)
+            if dp_mode:
+                # abstract analysis trace: shape-only stand-in
+                return jnp.concatenate([shard] * degree, axis=0)
+            # GSPMD/eager: the store tracer/array is global
+            return shard
+
+        def make_gather(i, zb, sdict):
             def gather():
                 dp_mode = parallel_env.current_dp_axis() == axis
-                bound = dp_mode and parallel_env.axis_bound(axis)
-                shard = sdict["param"].tensor._value
-                if bound:
-                    full = jax.lax.all_gather(shard, axis, axis=0,
-                                              tiled=True)
-                elif dp_mode:
-                    # abstract analysis trace: shape-only stand-in
-                    full = jnp.concatenate([shard] * degree, axis=0)
-                else:
-                    # GSPMD/eager: the store tracer/array is global
-                    full = shard
+                use_pf = prefetch and dp_mode
+                full = pending.pop(i, None) if use_pf else None
+                if full is None:
+                    if use_pf and i == 0:
+                        # warm start: step N-1's tail (or the eager
+                        # refresh) left bucket 0 gathered on the carry
+                        full = cfg["prefetch_slot"]._value
+                    else:
+                        full = full_rows(sdict)
                 for p, seg in zip(zb.params, zb.unflatten(full)):
                     slot = p.__dict__["_zero3_slot"]
                     if (slot.out_dtype is not None
                             and seg.dtype != slot.out_dtype):
                         seg = seg.astype(slot.out_dtype)
                     p.__dict__["_zero3_ov"] = seg
+                if use_pf and i + 1 < len(buckets) \
+                        and (i + 1) not in pending:
+                    nxt = buckets[i + 1]
+                    if nxt.params[0].__dict__.get("_zero3_lazy") \
+                            is not None:
+                        # bucket i+1 not yet materialized: issue its
+                        # gather now, while bucket i's compute runs
+                        pending[i + 1] = full_rows(stores[i + 1])
             return gather
 
         touched = []
-        for zb, sdict in zip(cfg["buckets"], cfg["stores"]):
-            gather = make_gather(zb, sdict)
+        for i, (zb, sdict) in enumerate(zip(buckets, stores)):
+            gather = make_gather(i, zb, sdict)
             for p in zb.params:
                 p.__dict__["_zero3_lazy"] = gather
                 touched.append(p)
 
         def cleanup():
+            pending.clear()
             for p in touched:
                 p.__dict__.pop("_zero3_ov", None)
                 p.__dict__.pop("_zero3_lazy", None)
         return cleanup
 
     def _zero_reduced_shard(self, zb, axis, degree, bound, dp_mode,
-                            constrain=None):
+                            constrain=None, defer_mean=False):
         """One bucket's gradient reduction, shared by the boundary step
         and the accumulation fold (they MUST agree on these semantics):
         flatten the current per-param grads (f32; zeros for absent) into
         the bucket layout and hand back this rank's mean-reduced
-        [rows/degree, 1024] shard plus the per-param presence flags."""
+        [rows/degree, 1024] shard plus the per-param presence flags.
+
+        ``defer_mean=True`` returns the raw scatter SUM instead (the
+        manual-axis branches only — GSPMD grads arrive pre-reduced):
+        the pipelined step divides by ``degree`` later, so the
+        collective's first consumer is not emitted adjacent to it."""
         from ..core.selected_rows import SelectedRows
         vals, present = [], []
         for p, shape in zip(zb.params, zb.shapes):
@@ -791,10 +895,14 @@ class Optimizer:
         gfull = zb.flatten(vals)
         if bound:
             gred = jax.lax.psum_scatter(
-                gfull, axis, scatter_dimension=0, tiled=True) / degree
+                gfull, axis, scatter_dimension=0, tiled=True)
+            if not defer_mean:
+                gred = gred / degree
         elif dp_mode:
             # abstract analysis trace: rank-0-shaped stand-in
-            gred = zb.shard_of(gfull, axis, bound=False) / degree
+            gred = zb.shard_of(gfull, axis, bound=False)
+            if not defer_mean:
+                gred = gred / degree
         else:
             # GSPMD/eager world: gradients are already globally reduced;
             # the constraint shards the update compute (and lets the
@@ -886,15 +994,30 @@ class Optimizer:
             return zb.shard_of(v, axis, bound) if dp_mode else v
 
         clip = self._grad_clip
-        # pass 1: reduce every bucket (the collectives issue back-to-back
-        # so XLA can overlap bucket i's reduction with bucket i+1's
-        # producers), fold in the accumulation window, track grad
-        # presence, shard finiteness and the global-norm square sums
-        reduced, all_ok, sq_sum = [], None, None
-        for zb, sdict in zip(cfg["buckets"], cfg["stores"]):
-            gred, present = self._zero_reduced_shard(
+        prefetch = cfg.get("prefetch", False)
+
+        def _rs_bucket(zb, sdict):
+            """Just the collective half of one bucket's reduction: the
+            psum_scatter that produces this rank's raw reduced shard.
+            Kept free of any elementwise follow-up (the mean divide
+            included, via ``defer_mean``) so the pipelined schedule can
+            issue it early — every op that would consume the result
+            immediately lives in :func:`_norm_bucket`."""
+            return self._zero_reduced_shard(
                 zb, axis, degree, bound, dp_mode,
-                constrain=lambda v: _constrain(v, shard_spec))
+                constrain=lambda v: _constrain(v, shard_spec),
+                defer_mean=True)
+
+        def _norm_bucket(sdict, gred):
+            """Mean divide + accumulation-window fold + pending-scaler/
+            window scaling of one reduced shard — the elementwise tail
+            of the bucket's gradient production, deferred to just
+            before the update in the pipelined schedule (same
+            per-bucket op order either way, so values are untouched)."""
+            if dp_mode:
+                # the deferred half of the scatter-mean (the GSPMD
+                # branch returns grads already reduced, nothing to do)
+                gred = gred / degree
             if use_gacc:
                 gacc = sdict["gacc"].tensor._value
                 if not dp_mode:
@@ -906,15 +1029,36 @@ class Optimizer:
                 gred = gred * pending_inv_scale
             if accum_a > 1:
                 gred = gred / accum_a
-            if scaler_pending and pending_found is None:
-                ok = jnp.all(jnp.isfinite(gred))
-                all_ok = ok if all_ok is None else (all_ok & ok)
-            if isinstance(clip, ClipGradByGlobalNorm):
-                s = jnp.sum(jnp.square(gred))
-                sq_sum = s if sq_sum is None else sq_sum + s
-            reduced.append((gred, present))
+            return gred
 
-        clip_scale = None
+        def _reduce_bucket(zb, sdict):
+            """One bucket's complete gradient production (collective +
+            fold/scale), emitted adjacently — the serial schedule."""
+            gred, present = _rs_bucket(zb, sdict)
+            return _norm_bucket(sdict, gred), present
+
+        # A cross-bucket reduction over the reduced shards (global-norm
+        # clip, or shard-derived overflow detection) is a barrier: every
+        # bucket's psum_scatter must land before any update math can
+        # start, so those configs keep the two-pass schedule. Without
+        # one, the reduce/update loop software-pipelines: bucket i+1's
+        # reduction issues BEFORE bucket i's update math, giving the
+        # scheduler real compute to hide each collective behind.
+        barrier = (isinstance(clip, ClipGradByGlobalNorm)
+                   or (scaler_pending and pending_found is None))
+
+        clip_scale, all_ok, sq_sum = None, None, None
+        reduced = None
+        if barrier:
+            reduced = [_reduce_bucket(zb, sdict)
+                       for zb, sdict in zip(cfg["buckets"], cfg["stores"])]
+            for gred, _present in reduced:
+                if scaler_pending and pending_found is None:
+                    ok = jnp.all(jnp.isfinite(gred))
+                    all_ok = ok if all_ok is None else (all_ok & ok)
+                if isinstance(clip, ClipGradByGlobalNorm):
+                    s = jnp.sum(jnp.square(gred))
+                    sq_sum = s if sq_sum is None else sq_sum + s
         if sq_sum is not None:
             if bound:  # each rank holds 1/degree of the rows: psum completes
                 sq_sum = jax.lax.psum(sq_sum, axis)
@@ -934,10 +1078,11 @@ class Optimizer:
             self._step_count._value = jnp.where(found_inf, prev_step,
                                                 self._step_count._value)
 
-        # pass 2: shard-local clip/decay + update, then publish params
-        n_bytes = 0
-        for zb, sdict, (gred, present) in zip(cfg["buckets"], cfg["stores"],
-                                              reduced):
+        # shard-local clip/decay + update of one bucket, then publish its
+        # params (stage 3: write the local shard rows; stage <=2: gather)
+        n_bytes = [0]
+
+        def _apply_bucket(zb, sdict, gred, present):
             if clip_scale is not None:
                 gred = gred * clip_scale
             elif isinstance(clip, ClipGradByValue):
@@ -1033,15 +1178,33 @@ class Optimizer:
                 sdict["gacc"].tensor._value = (
                     z if dp_mode else _constrain(z, shard_spec))
             if stage == 3:
-                # no re-gather: the refreshed rows stay sharded in the
-                # param store (the next step's materialize hook gathers
-                # from the carried shard) — full params never re-enter
-                # the carry
+                # no consumer-side re-gather: the refreshed rows stay
+                # sharded in the param store (the next step's
+                # materialize hook covers the full value) — full params
+                # never re-enter the carry
                 new_store = (new_p if new_p.dtype == pstore.tensor.dtype
                              else new_p.astype(pstore.tensor.dtype))
                 pstore.tensor._value = (
                     new_store if dp_mode
                     else _constrain(new_store, shard_spec))
+                if prefetch and zb.index == 0 \
+                        and "prefetch_slot" in cfg:
+                    # tail of the double buffer: gather the refreshed
+                    # bucket-0 rows NOW, while the remaining buckets'
+                    # update math still runs — step N+1's forward reads
+                    # the slot off the carry instead of gathering.
+                    # Deterministic all_gather of the same rows a fresh
+                    # gather would move: bitwise-identical, one step
+                    # early.
+                    if bound:
+                        nxt = jax.lax.all_gather(new_store, axis,
+                                                 axis=0, tiled=True)
+                    elif dp_mode:  # analysis stand-in: shape only
+                        nxt = jnp.concatenate([new_store] * degree,
+                                              axis=0)
+                    else:
+                        nxt = _constrain(new_store, repl_spec)
+                    cfg["prefetch_slot"]._value = nxt
                 for p in zb.params:
                     p._grad = None
             else:
@@ -1065,9 +1228,36 @@ class Optimizer:
                         # rank-divergent and would poison a replicated
                         # carry)
                         p._grad = None
-            n_bytes += zb.rows * _FLAT_LANES * 4
+            n_bytes[0] += zb.rows * _FLAT_LANES * 4
+
+        if barrier or not prefetch:
+            # two-pass serial schedule: reduce every bucket, then update
+            # every bucket (the pre-pipeline emission order; also the
+            # ``prefetch=False`` A/B control)
+            if reduced is None:
+                reduced = [_reduce_bucket(zb, sdict)
+                           for zb, sdict in zip(cfg["buckets"],
+                                                cfg["stores"])]
+            for zb, sdict, (gred, present) in zip(
+                    cfg["buckets"], cfg["stores"], reduced):
+                _apply_bucket(zb, sdict, gred, present)
+        else:
+            # double-buffered reduce/update pipeline: rs(b0), then for
+            # each bucket i issue rs(b_{i+1}) BEFORE update(b_i) — the
+            # reduction of the next bucket rides the update math of the
+            # current one. Per-bucket dataflow is untouched (no bucket
+            # reads another's shard), so the emission reorder cannot
+            # change a single value.
+            items = list(zip(cfg["buckets"], cfg["stores"]))
+            nxt = _rs_bucket(*items[0])
+            for i, (zb, sdict) in enumerate(items):
+                gred, present = nxt
+                nxt = (_rs_bucket(*items[i + 1])
+                       if i + 1 < len(items) else None)
+                _apply_bucket(zb, sdict, _norm_bucket(sdict, gred),
+                              present)
         monitor.stat_add("zero_steps")
-        monitor.stat_add("zero_reduced_bytes", n_bytes)
+        monitor.stat_add("zero_reduced_bytes", n_bytes[0])
         if scaler_pending:
             cfg["last_found_inf"] = found_inf
 

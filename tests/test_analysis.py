@@ -227,15 +227,20 @@ class TestCollectives:
     def test_order_mismatch(self):
         """Seeded defect 4: ranks disagree on the collective schedule."""
         p0 = self._rank_prog([("c_allreduce", "dp"), ("c_broadcast", "dp")])
+        # the SAME collectives in a different order is the precise
+        # schedule-skew diagnosis (one rank pipelined, the other not) —
+        # still an error: the wire cross-matches and deadlocks
         p1 = self._rank_prog([("c_broadcast", "dp"), ("c_allreduce", "dp")])
         fs = analysis.check_collective_order([p0, p1], mesh_axes=("dp",))
-        assert any(f.rule == "collective-order-mismatch" and
+        assert any(f.rule == "collective-schedule-skew" and
                    f.severity == "error" for f in fs)
-        # axis skew at the same position is also a mismatch
+        # axis skew at the same position is a genuine divergence (the
+        # multisets differ) — NOT collapsed into schedule skew
         p2 = self._rank_prog([("c_allreduce", "mp"), ("c_broadcast", "dp")])
         fs = analysis.check_collective_order([p0, p2],
                                              mesh_axes=("dp", "mp"))
         assert any(f.rule == "collective-order-mismatch" for f in fs)
+        assert not any(f.rule == "collective-schedule-skew" for f in fs)
         # length skew deadlocks too
         p3 = self._rank_prog([("c_allreduce", "dp")])
         fs = analysis.check_collective_order([p0, p3], mesh_axes=("dp",))
@@ -246,6 +251,64 @@ class TestCollectives:
         progs = [self._rank_prog(seq), self._rank_prog(seq)]
         assert analysis.check_collective_order(progs,
                                                mesh_axes=("dp",)) == []
+
+    def test_pipelined_twin_order_and_skew(self):
+        """The prefetch-pipelined zero3 twin: identical pipelined ranks
+        verify clean; a serial rank mixed with a pipelined rank is
+        flagged (different collective count — the prefetch twin carries
+        the tail re-gather); and the twin's recorded sequence scores
+        every stamped payload as schedulable, strictly above the serial
+        twin's."""
+        from paddle_tpu.analysis import ladder
+        from paddle_tpu.analysis.collectives import sequence_overlap_score
+        piped = [p for p, _t in ladder._zero3_prefetch_ranks()]
+        assert analysis.check_collective_order(
+            piped, mesh_axes=("dp",)) == []
+        serial = [p for p, _t in ladder._zero3_ranks()]
+        fs = analysis.check_collective_order([serial[0], piped[0]],
+                                             mesh_axes=("dp",))
+        assert any(f.severity == "error" for f in fs)
+        s_piped = sequence_overlap_score(piped[0])
+        s_serial = sequence_overlap_score(serial[0])
+        assert s_piped["schedulable_overlap"] == 1.0
+        assert (s_serial["schedulable_overlap"]
+                < s_piped["schedulable_overlap"])
+        # every pipelined collective names its emission-order slack
+        assert all(rec["schedulable"]
+                   for rec in s_piped["per_collective"])
+
+    def test_schedule_skew_same_count(self):
+        """Equal counts but permuted payloads — the exact one-rank-
+        pipelined shape — collapses into the single skew diagnosis
+        instead of positional bucket-mismatch noise."""
+        from paddle_tpu import static
+        from paddle_tpu.core.dispatch import call_op
+
+        def _prog(order):
+            prog = static.Program()
+            with static.program_guard(prog):
+                g = static.data("grad", [4], "float32")
+                out = g
+                for name, nbytes in order:
+                    def _c(v):
+                        return v
+                    _c._collective_axis = "dp"
+                    _c._collective_nbytes = nbytes
+                    out = call_op(_c, out, op_name=name)
+                paddle.sum(out)
+            return prog
+
+        serial = _prog([("c_allgather", 512), ("c_reducescatter", 256)])
+        piped = _prog([("c_reducescatter", 256), ("c_allgather", 512)])
+        fs = analysis.check_collective_order([serial, piped],
+                                             mesh_axes=("dp",))
+        assert [f.rule for f in fs] == ["collective-schedule-skew"]
+        # a genuinely divergent bucket layout stays a bucket finding
+        other = _prog([("c_allgather", 999), ("c_reducescatter", 256)])
+        fs = analysis.check_collective_order([serial, other],
+                                             mesh_axes=("dp",))
+        assert any(f.rule == "collective-order-mismatch" for f in fs)
+        assert not any(f.rule == "collective-schedule-skew" for f in fs)
 
     def test_unknown_axis(self):
         p = self._rank_prog([("c_allreduce", "mp")])
@@ -907,7 +970,8 @@ class TestLadderAndCLI:
         assert fs == []
         assert set(summary) == {"resnet", "gpt", "bert", "detection",
                                 "hbm_cache", "ctr", "remat", "serving",
-                                "allreduce", "zero1", "zero3"}
+                                "allreduce", "zero1", "zero3",
+                                "zero3_prefetch"}
 
     def test_cli_source_mode(self):
         r = subprocess.run(

@@ -323,6 +323,31 @@ def test_env_overlay_wins(monkeypatch):
         xla_flags.PRESETS["no-latency-hiding"]
 
 
+def test_resolve_false_is_hard_off(monkeypatch):
+    """False / "none" / "off" mean NO flags — and unlike None, the env
+    overlay does not re-arm them (the A/B control arm must stay the
+    control even under a runner's PADDLE_TPU_XLA_FLAGS)."""
+    assert xla_flags.resolve(False) == {}
+    assert xla_flags.resolve("none") == {}
+    assert xla_flags.resolve("off") == {}
+    monkeypatch.setenv(xla_flags.ENV_VAR, "xla_x=9")
+    assert xla_flags.resolve(False) == {}
+    assert xla_flags.resolve(None) == {"xla_x": 9}
+
+
+def test_backend_accepts_probes_once():
+    """The scan-default probe: CPU rejects the xla_tpu_* preset (judged
+    by one trivial flagged compile), accepts an empty set trivially,
+    and caches the verdict per flag set."""
+    preset = xla_flags.PRESETS["latency-hiding"]
+    assert xla_flags.backend_accepts(preset) is False
+    key = tuple(sorted((k, str(v)) for k, v in preset.items()))
+    assert xla_flags._BACKEND_ACCEPTS[key] is False
+    assert xla_flags.backend_accepts({}) is True
+    assert xla_flags.backend_accepts(
+        {"xla_cpu_enable_xprof_traceme": True}) is True
+
+
 def test_flagged_jit_unknown_flag_fallback():
     fj = xla_flags.jit(lambda x: x * 2,
                        xla_flags={"xla_tpu_enable_latency_hiding_scheduler":
@@ -427,11 +452,44 @@ def test_static_function_xla_flags_provenance(_mesh):
 
 def test_static_function_no_flags_provenance(_mesh):
     one, x, y = _zero3_step()
-    step = paddle.jit.to_static(one, scan_steps=2, dp_axis="dp")
+    # xla_flags=False: the explicit opt-out (scan programs otherwise
+    # DEFAULT to the latency-hiding preset where the backend takes it)
+    step = paddle.jit.to_static(one, scan_steps=2, dp_axis="dp",
+                                xla_flags=False)
     step(x, y)
     prov = step.xla_flags()
     assert prov == {"flags": {}, "applied": False,
                     "fallback_error": None}
+    assert step._xla_flags_default_pending is False
+
+
+def test_scan_default_latency_hiding_preset(_mesh, monkeypatch):
+    """A scan program with no xla_flags defaults to the latency-hiding
+    preset exactly when the backend registers it: on this CPU host the
+    probe says no and the program compiles unflagged; with the probe
+    forced to yes the preset attaches and provenance reports it."""
+    one, x, y = _zero3_step()
+    step = paddle.jit.to_static(one, scan_steps=2, dp_axis="dp")
+    assert step._xla_flags_default_pending is True
+    step(x, y)  # first build resolves the default via the probe
+    assert step._xla_flags_default_pending is False
+    assert step.xla_flags()["flags"] == {}  # CPU rejects xla_tpu_*
+
+    monkeypatch.setattr(xla_flags, "backend_accepts", lambda flags: True)
+    one2, x2, y2 = _zero3_step()
+    step2 = paddle.jit.to_static(one2, scan_steps=2, dp_axis="dp")
+    assert step2._xla_flags_default_pending is True
+    step2(x2, y2)
+    prov = step2.xla_flags()
+    assert prov["flags"] == xla_flags.PRESETS["latency-hiding"]
+    assert prov["applied"] is False  # ...and the compile still fell back
+    # an explicit empty-ish request (False) or env flags suppress it
+    step3 = paddle.jit.to_static(lambda v: v, scan_steps=2)
+    assert step3._xla_flags_default_pending is True
+    monkeypatch.setenv(xla_flags.ENV_VAR, "xla_x=1")
+    step4 = paddle.jit.to_static(lambda v: v, scan_steps=2)
+    assert step4._xla_flags_default_pending is False
+    assert step4._xla_flags == {"xla_x": 1}
 
 
 # -- gate direction pins ---------------------------------------------------
@@ -516,6 +574,42 @@ def test_overlap_view_diff_shape(tmp_path, capsys):
     row = [l for l in lines if l.startswith("step")][0]
     assert "+1.000" in row  # 0.0 -> 1.0 efficiency
     assert "0->1" in row  # async pair appeared
+
+
+def test_overlap_view_diff_schedulable_delta(tmp_path, capsys):
+    """Seeded prefetch-on/off captures: --diff must surface the
+    schedulable-overlap delta per entry — for HLO-priced entries from
+    ``schedulable_overlap``, and for ladder-twin entries (identity
+    stand-in collectives, nothing priced) from the record-level
+    ``sequence_schedulable`` the captures carry."""
+    ov = _overlap_view()
+    sa = overlap.overlap_stats(SYNC_HLO)
+    sb = overlap.overlap_stats(ASYNC_FULL_HLO)
+    twin = {"collective_overlap_efficiency": 0.0, "exposed_ns": 0.0,
+            "exposed_collective_frac": 1.0, "async_pairs_total": 0,
+            "sync_total": 0}
+    a = {"programs": {"step": sa,
+                      "zero3_twin": dict(twin, sequence_schedulable=0.5)}}
+    b = {"programs": {"step": sb,
+                      "zero3_twin": dict(twin, sequence_schedulable=1.0)}}
+    pa, pb = tmp_path / "off.json", tmp_path / "on.json"
+    pa.write_text(json.dumps(a))
+    pb.write_text(json.dumps(b))
+    rc = ov.main(["--diff", str(pa), str(pb)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    header = out.splitlines()[1]
+    assert "sched(A)" in header and "d_sched" in header
+    step = [l for l in out.splitlines() if l.startswith("step")][0]
+    d = sb["schedulable_overlap"] - sa["schedulable_overlap"]
+    assert f"{d:+.3f}" in step
+    twin_row = [l for l in out.splitlines()
+                if l.startswith("zero3_twin")][0]
+    assert "0.500" in twin_row and "1.000" in twin_row
+    assert "+0.500" in twin_row
+    # the plain table view carries the sched column too
+    assert "sched" in ov.format_program_table(
+        {"zero3_twin": dict(twin, sequence_schedulable=1.0)})
 
 
 def test_overlap_view_out_capture_roundtrip(tmp_path, capsys):

@@ -45,7 +45,7 @@ def _mlp(bf16=False):
 
 
 def _build(zero_stage, k, bf16, comm_buffer_mb=None, seed=11,
-           accumulate=None, grad_clip=None):
+           accumulate=None, grad_clip=None, prefetch=None):
     paddle.seed(seed)
     m = _mlp(bf16)
     opt = paddle.optimizer.AdamW(parameters=m.parameters(),
@@ -54,7 +54,7 @@ def _build(zero_stage, k, bf16, comm_buffer_mb=None, seed=11,
                                  grad_clip=grad_clip)
     if zero_stage:
         opt._zero_enable(axis="dp", stage=zero_stage,
-                         comm_buffer_mb=comm_buffer_mb)
+                         comm_buffer_mb=comm_buffer_mb, prefetch=prefetch)
 
     def one(xb, yb):
         loss = nn.functional.cross_entropy(m(xb), yb)
@@ -215,7 +215,11 @@ def test_zero_partition_and_verifier():
 def test_verifier_flags_rank_divergent_bucket_order():
     """Two rank programs whose reduce-scatter sequences agree on op kind
     and axis but not payload (swapped bucket layout) must be flagged —
-    that skew cross-matches different buckets on the wire."""
+    that skew cross-matches different buckets on the wire. Swapped
+    buckets are a pure permutation of the same collective multiset, so
+    the checker diagnoses it as collective-schedule-skew (a
+    deterministic reorder, e.g. pipelining enabled on one rank only)
+    rather than raw per-position mismatches."""
     from paddle_tpu import analysis, static
     from paddle_tpu.core.dispatch import call_op
 
@@ -240,8 +244,16 @@ def test_verifier_flags_rank_divergent_bucket_order():
     bad = analysis.check_collective_order(
         [rank_prog([4096, 1024]), rank_prog([1024, 4096])],
         mesh_axes=("dp",))
+    assert any(f.rule == "collective-schedule-skew" and
+               f.severity == "error" for f in bad)
+    # a genuinely divergent layout (different payload multiset) still
+    # reports the per-position mismatch, not a schedule reorder
+    bad2 = analysis.check_collective_order(
+        [rank_prog([4096, 1024]), rank_prog([4096, 999])],
+        mesh_axes=("dp",))
     assert any(f.rule == "collective-order-mismatch" and
-               "bucket" in f.message for f in bad)
+               "bucket" in f.message for f in bad2)
+    assert not any(f.rule == "collective-schedule-skew" for f in bad2)
 
 
 def test_zero_with_grad_scaler_parity():
@@ -503,11 +515,15 @@ def test_zero3_param_residency_and_carry():
 
 
 def test_zero3_hlo_ag_fwd_rs_pattern():
-    """Stage-3 compiled HLO: params all-gather JUST-IN-TIME before the
-    forward matmuls, the gradient reduce-scatter follows them, and no
-    all-gather trails the update (refreshed params stay sharded)."""
+    """Stage-3 compiled HLO, serial schedule (prefetch=False): params
+    all-gather JUST-IN-TIME before the forward matmuls, the gradient
+    reduce-scatter follows them, and no all-gather trails the update
+    (refreshed params stay sharded). The pipelined default moves that
+    gather to the tail of the previous iteration — so the body's first
+    all-gather lands AFTER the reduce-scatter — without changing the
+    per-execution collective counts."""
     k = 2
-    s3, _m, opt = _build(3, k, bf16=False)
+    s3, _m, opt = _build(3, k, bf16=False, prefetch=False)
     x, y = _batches(k)
     s3(x, y)
     hlo = s3.hlo_text()
@@ -524,6 +540,19 @@ def test_zero3_hlo_ag_fwd_rs_pattern():
     assert stats["all-gather"]["count"] == n_buckets * k
     assert stats["reduce-scatter"]["count"] == n_buckets * k
     assert stats.get("all-reduce", {"bytes": 0})["bytes"] <= 8 * k
+    # pipelined twin: the prefetch slot is warmed by a tail gather, so
+    # the loop body now ENDS with an all-gather (it feeds the NEXT
+    # iteration's forward) while the collective budget stays identical
+    sp, _mp, optp = _build(3, k, bf16=False, seed=11)
+    sp(x, y)
+    hlop = sp.hlo_text()
+    bodyp = max((c for c in hlop.split("\n\n") if "reduce-scatter" in c),
+                key=len, default=hlop)
+    assert bodyp.rindex("all-gather") > bodyp.index("reduce-scatter")
+    statsp = {s["op"]: s for s in sp.collective_stats(per_execution=True)}
+    assert statsp["all-gather"]["count"] == stats["all-gather"]["count"]
+    assert statsp["reduce-scatter"]["count"] == \
+        stats["reduce-scatter"]["count"]
 
 
 def test_accumulation_matches_big_batch():

@@ -100,9 +100,15 @@ def main(argv=None):
                                              + s["async_pairs_total"])
                          else f"{s['collective_overlap_efficiency']:.2f}")
                         for s in overlap_attr.get(name, [])]
+            # record-level schedulable score (emission-order slack the
+            # stamped collective sequence leaves hideable) — nonzero
+            # only for twins that carry collectives; the pipelined
+            # zero3_prefetch twin is the one that should read 1.00
+            scheds = [f"{s.get('sequence_schedulable', 0.0):.2f}"
+                      for s in overlap_attr.get(name, [])]
             print(f"ladder[{name}]: {len(op_counts)} program(s), "
                   f"ops={op_counts}, hbm_peak={peaks}, "
-                  f"overlap={overlaps}")
+                  f"overlap={overlaps}, sched={scheds}")
     if run_source:
         from paddle_tpu.analysis import lint_source
         findings.extend(lint_source(paths=args.source or None))

@@ -18,8 +18,9 @@ Sources (pick one):
     # analyze a compiled HLO dump (e.g. StaticFunction.hlo_text())
     python tools/overlap_view.py --hlo step.hlo
 
-    # flag A/B: efficiency / exposed-time deltas between two captures
-    # (the latency-hiding-scheduler on-vs-off evidence view)
+    # flag A/B: efficiency / schedulable-overlap / exposed-time deltas
+    # between two captures (the latency-hiding on-vs-off evidence view;
+    # d_sched moves even on sync-schedule backends where d_eff cannot)
     python tools/overlap_view.py --diff off.json on.json
 
     # record a capture for a later --diff
@@ -44,7 +45,19 @@ BAR_WIDTH = 32
 
 SUMMARY_KEYS = ("collective_overlap_efficiency", "exposed_collective_frac",
                 "hidden_ns", "exposed_ns", "collective_ns",
+                "schedulable_overlap", "schedulable_ns",
                 "async_pairs_total", "sync_total", "backend_sync_schedule")
+
+
+def _schedulable(s):
+    """An entry's schedulable-overlap score: the compiled-schedule score
+    when the schedule priced any collectives, else the record-level
+    sequence score ladder captures carry (``sequence_schedulable`` — the
+    twins' identity stand-ins never lower to HLO collectives, so only
+    the recorded op stream can show their emission-order slack)."""
+    if s.get("sync_total", 0) + s.get("async_pairs_total", 0):
+        return s.get("schedulable_overlap", 0.0)
+    return s.get("sequence_schedulable", s.get("schedulable_overlap", 0.0))
 
 
 def _render(rows):
@@ -111,16 +124,17 @@ def format_gantt(stats, label=""):
 def format_program_table(programs):
     """Summary table over ``{entry: stats}``; ``"error"`` records render
     as ERR rows (an unattributable twin must stay visible)."""
-    rows = [["entry", "efficiency", "exposed_frac", "exposed_us",
+    rows = [["entry", "efficiency", "sched", "exposed_frac", "exposed_us",
              "async", "sync", "sync_schedule"]]
     for entry in sorted(programs):
         s = programs[entry]
         if "error" in s:
             rows.append([entry, "ERR: " + str(s["error"])[:60],
-                         "", "", "", "", ""])
+                         "", "", "", "", "", ""])
             continue
         rows.append([entry,
                      f"{s['collective_overlap_efficiency']:.3f}",
+                     f"{_schedulable(s):.3f}",
                      f"{s['exposed_collective_frac']:.3f}",
                      f"{s['exposed_ns'] / 1e3:.2f}",
                      str(s["async_pairs_total"]), str(s["sync_total"]),
@@ -130,21 +144,29 @@ def format_program_table(programs):
 
 def format_program_diff(progs_a, progs_b):
     """Per-entry flag A/B deltas (B minus A): efficiency up and exposed
-    time down is the latency-hiding win; entries on one side only diff
+    time down is the measured latency-hiding win, and ``d_sched`` is the
+    schedulable-overlap delta — the backend-independent evidence that
+    the EMISSION ORDER changed (the prefetch-pipelined arm rises above
+    the serial arm's score even when a sync-schedule backend keeps both
+    measured efficiencies at 0.0). Entries on one side only diff
     against zero."""
-    rows = [["entry", "eff(A)", "eff(B)", "d_eff", "exposed_us(A)",
-             "exposed_us(B)", "d_exposed_us", "async(A->B)"]]
+    rows = [["entry", "eff(A)", "eff(B)", "d_eff", "sched(A)", "sched(B)",
+             "d_sched", "exposed_us(A)", "exposed_us(B)", "d_exposed_us",
+             "async(A->B)"]]
     for entry in sorted(set(progs_a) | set(progs_b)):
         a = progs_a.get(entry, {})
         b = progs_b.get(entry, {})
         if "error" in a or "error" in b:
-            rows.append([entry, "ERR", "ERR", "", "", "", "", ""])
+            rows.append([entry, "ERR", "ERR", "", "", "", "", "", "", "",
+                         ""])
             continue
         ea = a.get("collective_overlap_efficiency", 0.0)
         eb = b.get("collective_overlap_efficiency", 0.0)
+        sa, sb = _schedulable(a), _schedulable(b)
         xa = a.get("exposed_ns", 0.0) / 1e3
         xb = b.get("exposed_ns", 0.0) / 1e3
         rows.append([entry, f"{ea:.3f}", f"{eb:.3f}", f"{eb - ea:+.3f}",
+                     f"{sa:.3f}", f"{sb:.3f}", f"{sb - sa:+.3f}",
                      f"{xa:.2f}", f"{xb:.2f}", f"{xb - xa:+.2f}",
                      f"{a.get('async_pairs_total', 0)}->"
                      f"{b.get('async_pairs_total', 0)}"])
