@@ -1225,7 +1225,8 @@ def main():
             json.dump({"results": results}, f, indent=1)
     if args.write_baseline:
         # a perf baseline is only meaningful for programs the static
-        # analyzer accepts: verify the ladder's program miniatures first
+        # analyzer accepts: verify the ladder's program miniatures —
+        # including the shardcheck sharding/collective-budget rules —
         # and refuse to pin from an unverified ladder (tools/
         # lint_program.py --ladder is the standalone front-end)
         from paddle_tpu.analysis import errors, format_findings, ladder

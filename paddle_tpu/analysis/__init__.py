@@ -43,6 +43,10 @@ from .dtype_check import check_dtypes  # noqa: F401
 from .findings import (ERROR, INFO, WARNING, Finding,  # noqa: F401
                        VerifyError, errors, format_findings)
 from .lint import lint_program, lint_source  # noqa: F401
+from .shardcheck import (check_collective_budget,  # noqa: F401
+                         check_program_sharding, check_sharding,
+                         check_zero_residency, infer_zero_layout,
+                         predict_collective_budget, program_shard_stats)
 from .verifier import check_graph  # noqa: F401
 
 __all__ = [
@@ -51,6 +55,9 @@ __all__ = [
     "check_static_function", "check_collectives", "check_collective_order",
     "collective_sequence", "lint_program", "lint_source",
     "check_concurrency", "lockwatch",
+    "check_sharding", "check_collective_budget", "check_program_sharding",
+    "check_zero_residency", "infer_zero_layout",
+    "predict_collective_budget", "program_shard_stats",
     "set_debug", "debug_enabled",
 ]
 
@@ -76,12 +83,15 @@ def debug_enabled():
 def _export(findings):
     """Findings ride the shared counter registry (always on — verification
     is never a hot path) so scrapes see rule-level totals next to the
-    runtime profile."""
+    runtime profile. Labels render through ``format_labels`` so the
+    per-metric cardinality guard caps a runaway rule/severity blowup the
+    same way it caps every other labeled series."""
+    from ..observability.export import format_labels
     _monitor.stat_add("analysis_runs", 1)
     for f in findings:
         _monitor.stat_add(
-            'analysis_findings{rule="%s",severity="%s"}'
-            % (f.rule, f.severity), 1)
+            "analysis_findings" + format_labels(
+                "analysis_findings", rule=f.rule, severity=f.severity), 1)
 
 
 def verify(program, targets=None, donated=None, mesh_axes=None,

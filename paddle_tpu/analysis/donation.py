@@ -134,4 +134,9 @@ def check_static_function(sfn):
                 "dp-sharded scan carry — per-rank partial gradients of "
                 "sharded state cannot reassemble at the carry boundary; "
                 "consume them inside the step (opt.step + clear_grad)"))
+    # sharding & collective-budget analysis rides the same entry point:
+    # donation leaks, shard_map pspec propagation, and (when a ZeRO
+    # layout is active) the compiled collective-budget diff
+    from .shardcheck import check_sharding
+    findings.extend(check_sharding(sfn))
     return findings

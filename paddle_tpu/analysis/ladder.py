@@ -13,7 +13,7 @@ timings are only meaningful for programs the verifier accepts.
 """
 
 __all__ = ["LADDER_BUILDERS", "build_ladder_programs", "verify_ladder",
-           "attribute_memory", "attribute_overlap"]
+           "attribute_memory", "attribute_overlap", "attribute_sharding"]
 
 
 def _resnet_like():
@@ -411,6 +411,7 @@ def verify_ladder(configs=None, mesh_axes=("dp",), memory=True,
     from .collectives import check_collective_order
     from .dtype_check import check_dtypes
     from .findings import ERROR, Finding
+    from .shardcheck import check_program_sharding
     from ..observability.memory import (MemoryAttributionError,
                                         attribute_program)
 
@@ -430,6 +431,7 @@ def verify_ladder(configs=None, mesh_axes=("dp",), memory=True,
             _tag(name, verify(prog, targets=targets, mesh_axes=mesh_axes))
             _tag(name, check_dtypes(prog))
             _tag(name, lint(prog))
+            _tag(name, check_program_sharding(prog, mesh_axes=mesh_axes))
             if memory:
                 try:
                     attribute_program(prog, targets)
@@ -463,6 +465,26 @@ def attribute_memory(configs=None, programs=None):
             except MemoryAttributionError as e:
                 rows.append({"error": str(e)[:300]})
         out[name] = rows
+    return out
+
+
+def attribute_sharding(configs=None, programs=None, mesh_axes=("dp",)):
+    """Stamped-collective sharding summary of every ladder twin
+    (``analysis.shardcheck.program_shard_stats``): ``{config: [stats
+    per program]}`` — the source of ``lint_program --ladder``'s
+    ``shard=`` column. Record-level and cheap (no compile): each row is
+    the per-axis multiset of the twin's stamped collectives, so a twin
+    whose schedule silently drops its republishing all-gather is visible
+    in the table as well as in :func:`verify_ladder`'s
+    ``collective-budget-mismatch`` finding."""
+    from .shardcheck import program_shard_stats
+
+    out = {}
+    if programs is None:
+        programs = build_ladder_programs(configs)
+    for name, pairs in programs.items():
+        out[name] = [program_shard_stats(prog, mesh_axes=mesh_axes)
+                     for prog, _targets in pairs]
     return out
 
 

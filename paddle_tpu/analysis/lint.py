@@ -41,7 +41,8 @@ Two surfaces:
     multi-process path must fail loudly instead
     (``distributed.pod.PodRuntime.barrier`` raises
     ``BarrierTimeoutError`` naming the absent ranks). Scanned by
-    default over ``distributed/`` (``BARRIER_PATHS``).
+    default over ``distributed/``, ``serving/`` and
+    ``checkpoint/multihost.py`` (``BARRIER_PATHS``).
   * ``raw-remat-outside-policy``: a direct ``jax.remat`` /
     ``jax.checkpoint`` call in model/layer code. Which activations are
     worth saving — and whether saved residuals park in device or pinned
@@ -62,7 +63,8 @@ Two surfaces:
     ``fleet/elastic.py``'s relaunch path share it). Per-item fan-outs
     (one spawn per trainer in a ``for t in trainers`` loop) are not
     retry loops and are exempt. Scanned by default over
-    ``distributed/`` + ``fleet/elastic.py`` (``RESPAWN_PATHS``).
+    ``distributed/`` + ``fleet/elastic.py`` + ``serving/``
+    (``RESPAWN_PATHS``).
 
 Deliberate violations carry the structured suppression comment the
 concurrency pass introduced (``# lint: <rule-or-prefix> <reason>`` on
@@ -109,6 +111,7 @@ RPC_PATHS = (
     os.path.join("paddle_tpu", "distributed", "ps", "retry.py"),
     os.path.join("paddle_tpu", "distributed", "ps", "communicator.py"),
     os.path.join("paddle_tpu", "distributed", "ps", "graph.py"),
+    os.path.join("paddle_tpu", "distributed", "ps", "async_cache.py"),
     os.path.join("paddle_tpu", "distributed", "fleet", "elastic.py"),
     os.path.join("paddle_tpu", "distributed", "pod.py"),
 )
@@ -133,6 +136,8 @@ SPAN_PATHS = (
 # directories expand recursively to every .py file at scan time
 BARRIER_PATHS = (
     os.path.join("paddle_tpu", "distributed"),
+    os.path.join("paddle_tpu", "serving"),
+    os.path.join("paddle_tpu", "checkpoint", "multihost.py"),
     os.path.join("paddle_tpu", "testing", "virtual_pod.py"),
 )
 
@@ -148,6 +153,7 @@ _BARRIER_TIMEOUT_HINTS = ("timeout", "deadline")
 RESPAWN_PATHS = (
     os.path.join("paddle_tpu", "distributed"),
     os.path.join("paddle_tpu", "distributed", "fleet", "elastic.py"),
+    os.path.join("paddle_tpu", "serving"),
     os.path.join("paddle_tpu", "testing", "virtual_pod.py"),
 )
 

@@ -569,11 +569,28 @@ class StaticFunction:
                     get_jitted(), ex, mesh=mesh, **cost_kwargs)
             return aux[key]
 
+        def traced_jaxpr():
+            # the traced program itself (pre-XLA), for structural
+            # analyzers that walk equations rather than prices — the
+            # sharding checker (analysis.shardcheck) propagates
+            # shard_map pspecs over exactly this view
+            ex = aux.get("example_args")
+            if ex is None:
+                raise RuntimeError(
+                    "program has not executed yet; run the step once "
+                    "before asking for its traced jaxpr")
+            if "jaxpr" not in aux:
+                fun = get_jitted()
+                inner = getattr(fun, "_fun", fun)
+                aux["jaxpr"] = jax.make_jaxpr(inner)(*ex)
+            return aux["jaxpr"]
+
         aux["capture"] = capture
         aux["hlo_text"] = hlo_text
         aux["memory_stats"] = memory_stats
         aux["traced_stats"] = traced_stats
         aux["schedulable_stats"] = schedulable_stats
+        aux["traced_jaxpr"] = traced_jaxpr
         return aux
 
     def hlo_text(self):
@@ -912,6 +929,13 @@ class StaticFunction:
                                if getattr(state_items[i][1],
                                           "_carry_optional", False)],
             "dp_axis": None,
+            "donate": bool(self._donate),
+            "state_meta": {uids[i]: {
+                "name": getattr(state_items[i][1], "name", None),
+                "category": getattr(state_items[i][1],
+                                    "_ledger_category", None),
+                "pspec": state_items[i][1].pspec,
+            } for i in range(n)},
         }
 
         # direct Tensor references per partition: the per-call hot path
@@ -1261,6 +1285,13 @@ class StaticFunction:
             "dp_axis": dp_axis,
             "scan_steps": k,
             "accumulate_steps": a,
+            "donate": bool(self._donate),
+            "state_meta": {uids[i]: {
+                "name": getattr(state_items[i][1], "name", None),
+                "category": getattr(state_items[i][1],
+                                    "_ledger_category", None),
+                "pspec": state_specs[i],
+            } for i in range(n)},
         }
 
         carry_ts = [state_items[i][1] for i in carry_val_idx]
@@ -1387,7 +1418,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         return lambda fn: to_static(fn, input_spec=input_spec,
                                     scan_steps=scan_steps, dp_axis=dp_axis,
                                     accumulate_steps=accumulate_steps,
-                                    xla_flags=xla_flags)
+                                    xla_flags=xla_flags, **kwargs)
     if isinstance(function, StaticFunction):
         return function
     # Layers: wrap forward, keep the layer object semantics
@@ -1398,13 +1429,13 @@ def to_static(function=None, input_spec=None, build_strategy=None,
                                         scan_steps=scan_steps,
                                         dp_axis=dp_axis,
                                         accumulate_steps=accumulate_steps,
-                                        xla_flags=xla_flags)
+                                        xla_flags=xla_flags, **kwargs)
         layer.forward = static_forward
         return layer
     return StaticFunction(function, input_spec, scan_steps=scan_steps,
                           dp_axis=dp_axis,
                           accumulate_steps=accumulate_steps,
-                          xla_flags=xla_flags)
+                          xla_flags=xla_flags, **kwargs)
 
 
 class InputSpec:

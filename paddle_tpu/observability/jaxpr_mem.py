@@ -27,6 +27,10 @@ view where it is meaningful.
 """
 import numpy as np
 
+from .jaxpr_walk import jaxpr_vars as _vars
+from .jaxpr_walk import last_use_map as _last_use_map
+from .jaxpr_walk import sub_jaxprs as _sub_jaxprs
+
 __all__ = ["aval_bytes", "jaxpr_peak_bytes", "jaxpr_peak_stats",
            "traced_peak_stats"]
 
@@ -54,26 +58,6 @@ def _size(var):
     return aval_bytes(var.aval)
 
 
-def _sub_jaxprs(eqn):
-    """Every sub-jaxpr an equation owns (scan/while/cond bodies, remat
-    regions, pjit calls, custom-vjp closures) — recursion descends into
-    each so an equation's footprint includes its internal working set."""
-    out = []
-    for v in eqn.params.values():
-        # ClosedJaxpr (pjit, remat2, custom_jvp/vjp call_jaxpr, scan)
-        if hasattr(v, "jaxpr") and hasattr(v, "consts"):
-            out.append(v.jaxpr)
-        elif hasattr(v, "eqns") and hasattr(v, "invars"):
-            out.append(v)  # open Jaxpr (cond branches list items below)
-        elif isinstance(v, (list, tuple)):
-            for w in v:
-                if hasattr(w, "jaxpr") and hasattr(w, "consts"):
-                    out.append(w.jaxpr)
-                elif hasattr(w, "eqns") and hasattr(w, "invars"):
-                    out.append(w)
-    return out
-
-
 def jaxpr_peak_bytes(jaxpr, alias_io=False):
     """Sequential-liveness high-water bytes of one jaxpr: inputs are
     resident throughout their live range, each equation adds its outputs
@@ -95,22 +79,7 @@ def jaxpr_peak_bytes(jaxpr, alias_io=False):
     is unconditional in XLA."""
     jaxpr = getattr(jaxpr, "jaxpr", jaxpr)  # accept ClosedJaxpr
 
-    def _vars(atoms):
-        seen, out = set(), []
-        for a in atoms:
-            if hasattr(a, "aval") and not hasattr(a, "val"):  # Var, not Literal
-                if id(a) not in seen:
-                    seen.add(id(a))
-                    out.append(a)
-        return out
-
-    last_use = {}
-    n_eqns = len(jaxpr.eqns)
-    for i, eqn in enumerate(jaxpr.eqns):
-        for v in _vars(eqn.invars):
-            last_use[v] = i
-    for v in _vars(jaxpr.outvars):
-        last_use[v] = n_eqns  # outputs live to the end
+    last_use = _last_use_map(jaxpr)  # outputs live to the end
 
     inputs = _vars(list(jaxpr.invars) + list(jaxpr.constvars))
 

@@ -1,0 +1,75 @@
+"""Shared jaxpr traversal primitives.
+
+Three analyzers walk the traced (pre-XLA) jaxpr of a step program: the
+liveness memory meter (:mod:`jaxpr_mem`), the schedulable-overlap scorer
+(:mod:`overlap`), and the sharding-propagation checker
+(:mod:`paddle_tpu.analysis.shardcheck`). Each needs the same three
+primitives — find the sub-jaxprs an equation owns, enumerate the Vars of
+an atom list without double-counting, and know where every value dies —
+and each used to carry its own copy. This module is the single
+implementation they share; the duck typing (anything that is or wraps an
+object with ``eqns``) is deliberate so jax version drift in the concrete
+classes (ClosedJaxpr vs Jaxpr, branch lists, custom-vjp closures) does
+not fork the walkers again.
+"""
+
+__all__ = ["sub_jaxprs", "jaxpr_vars", "last_use_map"]
+
+
+def _as_jaxpr(v):
+    """The OPEN jaxpr behind ``v``: a ClosedJaxpr's ``.jaxpr``, a bare
+    Jaxpr itself, else None."""
+    inner = getattr(v, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    if hasattr(v, "eqns") and hasattr(v, "invars"):
+        return v
+    return None
+
+
+def sub_jaxprs(eqn):
+    """Every sub-jaxpr an equation owns (scan/while/cond bodies, remat
+    regions, pjit calls, custom-vjp closures, shard_map bodies) as OPEN
+    jaxprs — recursion into each makes an equation's analysis include
+    its internal region. Branch lists (``cond``) and any other
+    list-of-jaxprs param are flattened."""
+    out = []
+    for v in eqn.params.values():
+        j = _as_jaxpr(v)
+        if j is not None:
+            out.append(j)
+        elif isinstance(v, (list, tuple)):
+            for w in v:
+                j = _as_jaxpr(w)
+                if j is not None:
+                    out.append(j)
+    return out
+
+
+def jaxpr_vars(atoms):
+    """The Vars among ``atoms`` (Literals dropped), deduplicated by
+    identity, order preserved — one entry per distinct buffer even when
+    an equation reads the same value twice."""
+    seen, out = set(), []
+    for a in atoms:
+        if hasattr(a, "aval") and not hasattr(a, "val"):  # Var, not Literal
+            if id(a) not in seen:
+                seen.add(id(a))
+                out.append(a)
+    return out
+
+
+def last_use_map(jaxpr):
+    """``{var: equation index of its last consumer}`` for one (open)
+    jaxpr; outvars map to ``len(eqns)`` — they stay live to the region
+    boundary. The index convention matches the liveness walk: a value
+    whose ``last_use`` is ``<= i`` is dead after equation ``i``."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    last_use = {}
+    n_eqns = len(jaxpr.eqns)
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in jaxpr_vars(eqn.invars):
+            last_use[v] = i
+    for v in jaxpr_vars(jaxpr.outvars):
+        last_use[v] = n_eqns
+    return last_use

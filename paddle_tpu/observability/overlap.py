@@ -76,6 +76,7 @@ import re
 
 from .hlo_bytes import (COLLECTIVE_HLO_OPS, _axis_name, _comp_multipliers,
                         _group_size, _shape_bytes)
+from .jaxpr_walk import sub_jaxprs as _sub_jaxprs
 
 __all__ = ["overlap_stats", "schedulable_stats", "export_overlap_stats",
            "attribute_program",
@@ -470,20 +471,6 @@ def _aval_bytes(v):
     for d in shape:
         n *= int(d)
     return n * dtype.itemsize
-
-
-def _sub_jaxprs(eqn):
-    """Inner jaxprs of one equation (scan/while/pjit/cond/custom-vjp),
-    via duck typing: any param that is or wraps a jaxpr."""
-    for key, val in eqn.params.items():
-        inner = getattr(val, "jaxpr", None)
-        if inner is not None and hasattr(inner, "eqns"):
-            yield inner
-        elif hasattr(val, "eqns"):
-            yield val
-        elif key == "branches":
-            for b in val:
-                yield getattr(b, "jaxpr", b)
 
 
 def _eqn_compute_ns(eqn, hbm_gbps, peak_flops):

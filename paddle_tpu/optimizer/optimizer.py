@@ -738,6 +738,35 @@ class Optimizer:
                    for zb, sdict in zip(cfg["buckets"], cfg["stores"])
                    for sd in sdict.values())
 
+    def zero_layout(self):
+        """Bucket-layout metadata of the active ZeRO config, or ``None``
+        when ZeRO is off — the structured description the sharding
+        checker (``paddle_tpu.analysis.shardcheck``) budgets collectives
+        against: one all-gather / reduce-scatter pair per bucket per
+        window is a claim about exactly these buckets. Keys: ``stage``,
+        ``axis``, ``degree``, ``n_buckets``, ``prefetch``,
+        ``comm_buffer_mb``, ``bucket_rows`` (full flat rows per bucket),
+        ``shard_rows`` (per-rank rows per bucket), ``store_names``
+        (flat-store tensor names, ``zero_<slot>_b<bucket>``), and
+        ``state_bytes`` (per-rank bytes, ``_zero_state_bytes``)."""
+        cfg = self._zero
+        if cfg is None:
+            return None
+        names = [sd.tensor.name for sdict in cfg["stores"]
+                 for sd in sdict.values()]
+        if "prefetch_slot" in cfg:
+            names.append(cfg["prefetch_slot"].name)
+        return {
+            "stage": cfg["stage"], "axis": cfg["axis"],
+            "degree": cfg["degree"], "n_buckets": len(cfg["buckets"]),
+            "prefetch": cfg["prefetch"],
+            "comm_buffer_mb": cfg["comm_buffer_mb"],
+            "bucket_rows": [zb.rows for zb in cfg["buckets"]],
+            "shard_rows": [zb.shard_rows for zb in cfg["buckets"]],
+            "store_names": names,
+            "state_bytes": self._zero_state_bytes(),
+        }
+
     def _reduce_dp_grads(self, axis):
         """The replicated (non-ZeRO) control under a manual dp axis: one
         full-tensor pmean per parameter gradient — exactly the per-param

@@ -203,6 +203,12 @@ def test_prefetch_keeps_collective_counts():
     for op in ("all-gather", "reduce-scatter"):
         assert on[op]["count"] == off[op]["count"], (op, off[op], on[op])
         assert on[op]["bytes"] == off[op]["bytes"], (op, off[op], on[op])
+    # both schedules sit exactly on shardcheck's predicted budget (the
+    # predictor models the warm-slot elision, so prefetch=True is not
+    # just "same as serial" but independently priced)
+    from paddle_tpu.analysis import check_collective_budget
+    assert check_collective_budget(s_off) == []
+    assert check_collective_budget(s_on) == []
 
 
 def test_prefetch_slot_carry_and_verifier():

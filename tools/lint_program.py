@@ -64,6 +64,7 @@ def main(argv=None):
         import jax
         jax.config.update("jax_platforms", "cpu")
         from paddle_tpu.analysis import ERROR, Finding, ladder
+        from paddle_tpu.analysis.shardcheck import format_shard_stats
         from paddle_tpu.observability import memory as mem
         configs = args.configs.split(",") if args.configs else None
         # build the twins once, verify without the built-in attribution
@@ -82,6 +83,10 @@ def main(argv=None):
                     findings.append(Finding(
                         "memory-attribution-failed", ERROR,
                         f"[{name}] program {pi}: {s['error']}"))
+        # record-level sharding summary: the stamped collective multiset
+        # per twin, rendered as the shard= column (shardcheck's budget
+        # findings already rode in through verify_ladder)
+        shard_attr = ladder.attribute_sharding(programs=programs)
         # overlap attribution rides the same contract: a verified twin
         # whose schedule cannot be parsed/priced refuses the ladder
         overlap_attr = ladder.attribute_overlap(programs=programs)
@@ -106,9 +111,12 @@ def main(argv=None):
             # zero3_prefetch twin is the one that should read 1.00
             scheds = [f"{s.get('sequence_schedulable', 0.0):.2f}"
                       for s in overlap_attr.get(name, [])]
+            shards = [format_shard_stats(s)
+                      for s in shard_attr.get(name, [])]
             print(f"ladder[{name}]: {len(op_counts)} program(s), "
                   f"ops={op_counts}, hbm_peak={peaks}, "
-                  f"overlap={overlaps}, sched={scheds}")
+                  f"overlap={overlaps}, sched={scheds}, "
+                  f"shard={shards}")
     if run_source:
         from paddle_tpu.analysis import lint_source
         findings.extend(lint_source(paths=args.source or None))

@@ -28,6 +28,7 @@ MODULES = [
     "paddle_tpu.analysis",
     "paddle_tpu.analysis.concurrency",
     "paddle_tpu.analysis.lockwatch",
+    "paddle_tpu.analysis.shardcheck",
     "paddle_tpu.amp",
     "paddle_tpu.io",
     "paddle_tpu.metric",
